@@ -1,0 +1,22 @@
+"""nice-tpu: a TPU-native distributed search framework for square-cube pandigitals.
+
+A brand-new framework with the capabilities of wasabipesto/nice: the per-number
+niceness check (big-int square+cube, base-b digit extraction, digit-set
+uniqueness, filter cascade) is a batched fixed-width integer JAX/Pallas kernel,
+vmapped over a whole field range and sharded across TPU chips, beside the same
+checkout -> process -> submit control plane (HTTP API, field ledger DB, claim
+queues, submission verification, consensus).
+
+Layer map (mirrors reference SURVEY.md section 1):
+  L0 core/      domain types, base-range math, stats, consensus
+  L1 ops/       compute engines: scalar oracle, jnp vector engine, Pallas TPU
+                kernels, filter cascade (residue / LSD / stride / MSD-prefix)
+  L2 client/    HTTP transport with retry/backoff
+  L3 server/    field ledger DB + claim engine + queues
+  L4 client/server/jobs/daemon binaries
+  parallel/     device mesh, collectives, host pipeline
+"""
+
+__version__ = "0.1.0"
+
+CLIENT_VERSION = __version__

@@ -1,0 +1,22 @@
+"""Core domain: shared types, constants, and pure number-theory math (L0)."""
+
+from nice_tpu.core.constants import (
+    CLAIM_DURATION_HOURS,
+    CLIENT_REQUEST_TIMEOUT_SECS,
+    DETAILED_SEARCH_MAX_FIELD_SIZE,
+    DOWNSAMPLE_CUTOFF_PERCENT,
+    NEAR_MISS_CUTOFF_PERCENT,
+    SAVE_TOP_N_NUMBERS,
+)
+from nice_tpu.core.types import (
+    DataToClient,
+    DataToServer,
+    FieldResults,
+    FieldSize,
+    NiceNumber,
+    NiceNumberSimple,
+    SearchMode,
+    UniquesDistribution,
+    UniquesDistributionSimple,
+    ValidationData,
+)

@@ -1,0 +1,107 @@
+"""Valid search interval per base, from number theory.
+
+A number n is a candidate in base b only when digits_b(n^2) + digits_b(n^3) == b.
+That constraint pins n to one contiguous interval per base, derived from the
+b % 5 case analysis (reference common/src/base_range.rs:14-32). Python ints are
+arbitrary precision, so one implementation covers every base (the reference
+needs separate Natural/u128 variants).
+
+A corollary used heavily by the TPU engine (see ops/limbs.py): within the valid
+interval the digit counts of n^2 and n^3 are individually *exact* constants:
+
+    b % 5 == 0 (k=b//5): digits(n^2) = 2k,   digits(n^3) = 3k
+    b % 5 == 2:          digits(n^2) = 2k+1, digits(n^3) = 3k+1
+    b % 5 == 3:          digits(n^2) = 2k+1, digits(n^3) = 3k+2
+    b % 5 == 4:          digits(n^2) = 2k+2, digits(n^3) = 3k+2
+
+which lets device kernels use fixed-trip-count digit extraction with no
+leading-zero masking.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from nice_tpu.core.types import FieldSize
+
+
+def floor_root(x: int, n: int) -> int:
+    """Exact integer floor(x ** (1/n)) for x >= 0, n >= 1."""
+    if x < 0:
+        raise ValueError("floor_root of negative number")
+    if n == 1 or x in (0, 1):
+        return x
+    if n == 2:
+        return math.isqrt(x)
+    # Newton's method on integers, starting from an over-estimate.
+    r = 1 << -(-x.bit_length() // n)  # 2^ceil(bits/n) >= x^(1/n)
+    while True:
+        nxt = ((n - 1) * r + x // r ** (n - 1)) // n
+        if nxt >= r:
+            break
+        r = nxt
+    # r is now floor or at most one too high; correct downward.
+    while r**n > x:
+        r -= 1
+    return r
+
+
+def ceiling_root(x: int, n: int) -> int:
+    """Exact integer ceil(x ** (1/n))."""
+    r = floor_root(x, n)
+    return r if r**n == x else r + 1
+
+
+def get_base_range(base: int) -> Optional[tuple[int, int]]:
+    """Half-open [start, end) of valid n for a base, or None when empty.
+
+    Mirrors reference base_range.rs:14-32 (b % 5 case analysis).
+    """
+    b = base
+    k = base // 5
+    m = base % 5
+    if m == 0:
+        return (ceiling_root(b ** (3 * k - 1), 3), b**k)
+    if m == 1:
+        return None
+    if m == 2:
+        return (b**k, ceiling_root(b ** (3 * k + 1), 3))
+    if m == 3:
+        return (
+            ceiling_root(b ** (3 * k + 1), 3),
+            ceiling_root(b ** (2 * k + 1), 2),
+        )
+    if m == 4:
+        return (
+            ceiling_root(b ** (2 * k + 1), 2),
+            ceiling_root(b ** (3 * k + 2), 3),
+        )
+    return None
+
+
+def get_base_range_field(base: int) -> Optional[FieldSize]:
+    """get_base_range as a FieldSize (reference base_range.rs:43-54)."""
+    r = get_base_range(base)
+    if r is None:
+        return None
+    return FieldSize(r[0], r[1])
+
+
+def sqube_digit_counts(base: int) -> tuple[int, int]:
+    """Exact (digits(n^2), digits(n^3)) for every n in the base's valid range.
+
+    See the module docstring derivation; counts always sum to `base`.
+    Raises for bases with an empty range (b % 5 == 1).
+    """
+    k = base // 5
+    m = base % 5
+    if m == 0:
+        return (2 * k, 3 * k)
+    if m == 2:
+        return (2 * k + 1, 3 * k + 1)
+    if m == 3:
+        return (2 * k + 1, 3 * k + 2)
+    if m == 4:
+        return (2 * k + 2, 3 * k + 2)
+    raise ValueError(f"base {base} has no valid range (base % 5 == 1)")

@@ -1,0 +1,48 @@
+"""Field consensus: pick the canonical submission and the new check level.
+
+Mirrors reference common/src/consensus.rs:13-73. Submissions are grouped by
+their (sorted distribution, sorted numbers) content; the largest group wins and
+its earliest submission becomes canon; check_level = group size + 1, capped at
+255. Zero submissions resets canon and caps check_level at 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nice_tpu.core import distribution_stats, number_stats
+from nice_tpu.core.types import (
+    FieldRecord,
+    SubmissionCandidate,
+    SubmissionRecord,
+)
+
+
+def evaluate_consensus(
+    field: FieldRecord, submissions: list[SubmissionRecord]
+) -> tuple[Optional[SubmissionRecord], int]:
+    """Return (canon submission or None, new check_level)."""
+    if not submissions:
+        return (None, min(field.check_level, 1))
+    if len(submissions) == 1:
+        return (submissions[0], 2)
+
+    groups: dict[SubmissionCandidate, list[SubmissionRecord]] = {}
+    for sub in submissions:
+        if sub.distribution is None:
+            raise ValueError(
+                f"No distribution found in detailed submission #{sub.submission_id}"
+            )
+        distribution = distribution_stats.shrink_distribution(sub.distribution)
+        distribution.sort(key=lambda d: d.num_uniques)
+        numbers = number_stats.shrink_numbers(sub.numbers)
+        numbers.sort(key=lambda n: n.number)
+        key = SubmissionCandidate(
+            distribution=tuple(distribution), numbers=tuple(numbers)
+        )
+        groups.setdefault(key, []).append(sub)
+
+    majority_group = max(groups.values(), key=len)
+    first_submission = min(majority_group, key=lambda s: s.submit_time)
+    check_level = min(len(majority_group) + 1, 255)
+    return (first_submission, check_level)

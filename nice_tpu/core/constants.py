@@ -1,0 +1,27 @@
+"""Global constants shared across the framework.
+
+Values match the reference (common/src/lib.rs:33-42, number_stats.rs:5) so that
+results, wire formats, and server policies are interchangeable.
+"""
+
+# Fraction of base digits that must be unique for a number to be recorded as a
+# "near miss" (reference lib.rs:34). Kept as a float; the cutoff computation in
+# number_stats replicates the reference's f32 rounding semantics exactly.
+NEAR_MISS_CUTOFF_PERCENT = 0.9
+
+# Minimum fraction of a chunk that must be checked before downsampled stats are
+# published for it (reference lib.rs:35).
+DOWNSAMPLE_CUTOFF_PERCENT = 0.2
+
+# A claim expires (and the field becomes claimable again) after this many hours
+# (reference lib.rs:36). Lease-based recovery: no heartbeats anywhere.
+CLAIM_DURATION_HOURS = 1
+
+# HTTP client request timeout (reference lib.rs:37).
+CLIENT_REQUEST_TIMEOUT_SECS = 5
+
+# Detailed runners never get a field larger than this (reference lib.rs:39-42).
+DETAILED_SEARCH_MAX_FIELD_SIZE = 1_000_000_000
+
+# Cap on nice-number lists kept after aggregation (reference number_stats.rs:5).
+SAVE_TOP_N_NUMBERS = 10_000

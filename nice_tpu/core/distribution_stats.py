@@ -1,0 +1,84 @@
+"""Histogram expand/shrink/aggregate and niceness mean/stdev.
+
+Mirrors reference common/src/distribution_stats.rs. All derived floats use
+numpy float32 to match the reference's f32 arithmetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nice_tpu.core.types import (
+    SubmissionRecord,
+    UniquesDistribution,
+    UniquesDistributionSimple,
+)
+
+
+def expand_distribution(
+    distributions: list[UniquesDistributionSimple], base: int
+) -> list[UniquesDistribution]:
+    """Add niceness/density stats (reference distribution_stats.rs:12-27)."""
+    total_count = sum(d.count for d in distributions)
+    assert total_count > 0
+    base_f32 = np.float32(base)
+    total_f32 = np.float32(total_count)
+    return [
+        UniquesDistribution(
+            num_uniques=d.num_uniques,
+            count=d.count,
+            niceness=float(np.float32(d.num_uniques) / base_f32),
+            density=float(np.float32(d.count) / total_f32),
+        )
+        for d in distributions
+    ]
+
+
+def shrink_distribution(
+    distribution: list[UniquesDistribution],
+) -> list[UniquesDistributionSimple]:
+    """Strip derived stats (reference distribution_stats.rs:94-102)."""
+    return [
+        UniquesDistributionSimple(num_uniques=d.num_uniques, count=d.count)
+        for d in distribution
+    ]
+
+
+def downsample_distributions(
+    submissions: list[SubmissionRecord], base: int
+) -> list[UniquesDistribution]:
+    """Aggregate counts per num_uniques across submissions
+    (reference distribution_stats.rs:32-67)."""
+    counter = [
+        UniquesDistributionSimple(num_uniques=n, count=0) for n in range(base + 1)
+    ]
+    for sub in submissions:
+        if sub.distribution is None:
+            continue
+        for dist in sub.distribution:
+            if 0 <= dist.num_uniques <= base:
+                old = counter[dist.num_uniques]
+                counter[dist.num_uniques] = UniquesDistributionSimple(
+                    num_uniques=old.num_uniques, count=old.count + dist.count
+                )
+    return expand_distribution(counter[1:], base)
+
+
+def mean_stdev_from_distribution(
+    distribution: list[UniquesDistribution],
+) -> tuple[float, float]:
+    """f32 mean and stdev of niceness weighted by count
+    (reference distribution_stats.rs:75-90)."""
+    count = sum(d.count for d in distribution)
+    assert count > 0
+    mean = np.float32(0.0)
+    stdev = np.float32(0.0)
+    for d in distribution:
+        c = np.float32(d.count)
+        nice = np.float32(d.niceness)
+        mean = np.float32(mean + nice * c)
+        stdev = np.float32(stdev + c * np.float32(nice * nice))
+    count_f = np.float32(count)
+    mean = np.float32(mean / count_f)
+    stdev = np.float32(np.sqrt(np.float32(stdev / count_f - np.float32(mean * mean))))
+    return (float(mean), float(stdev))

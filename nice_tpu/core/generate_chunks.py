@@ -1,0 +1,21 @@
+"""Group fields into ~100 analytics chunks (reference generate_chunks.rs:20-62)."""
+
+from __future__ import annotations
+
+import math
+
+from nice_tpu.core.types import FieldSize
+
+TARGET_NUM_CHUNKS = 100.0
+
+
+def group_fields_into_chunks(fields: list[FieldSize]) -> list[FieldSize]:
+    """Group consecutive fields into at most TARGET_NUM_CHUNKS chunks."""
+    if not fields:
+        raise ValueError("fields must not be empty")
+    num_fields_per_chunk = math.ceil(len(fields) / TARGET_NUM_CHUNKS)
+    chunks: list[FieldSize] = []
+    for i in range(0, len(fields), num_fields_per_chunk):
+        group = fields[i : i + num_fields_per_chunk]
+        chunks.append(FieldSize(group[0].range_start, group[-1].range_end))
+    return chunks

@@ -1,0 +1,17 @@
+"""Split a base range into searchable fields (reference generate_fields.rs:14-34)."""
+
+from __future__ import annotations
+
+from nice_tpu.core.types import FieldSize
+
+
+def break_range_into_fields(min_: int, max_: int, size: int) -> list[FieldSize]:
+    """Break [min_, max_) into half-open fields of width `size` (last smaller)."""
+    fields: list[FieldSize] = []
+    start = min_
+    end = min_
+    while end < max_:
+        end = min(start + size, max_)
+        fields.append(FieldSize(start, end))
+        start = end
+    return fields

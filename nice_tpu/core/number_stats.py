@@ -1,0 +1,52 @@
+"""Near-miss cutoffs and nice-number list expand/shrink/downsample.
+
+Mirrors reference common/src/number_stats.rs. The cutoff computation replicates
+the reference's f32 arithmetic bit-for-bit (numpy float32), because e.g.
+10 * 0.9_f32 rounds to exactly 9.0 while naive float64 gives 9.000000000000002
+-> different floor at some bases would change which numbers are recorded.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from nice_tpu.core.constants import NEAR_MISS_CUTOFF_PERCENT, SAVE_TOP_N_NUMBERS
+from nice_tpu.core.types import NiceNumber, NiceNumberSimple, SubmissionRecord
+
+
+def get_near_miss_cutoff(base: int) -> int:
+    """floor(base as f32 * 0.9f32): numbers with MORE uniques than this are saved
+    (reference number_stats.rs:15-17)."""
+    return int(math.floor(float(np.float32(base) * np.float32(NEAR_MISS_CUTOFF_PERCENT))))
+
+
+def expand_numbers(numbers: list[NiceNumberSimple], base: int) -> list[NiceNumber]:
+    """Add derived stats (reference number_stats.rs:23-34). niceness is f32."""
+    base_f32 = np.float32(base)
+    return [
+        NiceNumber(
+            number=n.number,
+            num_uniques=n.num_uniques,
+            base=base,
+            niceness=float(np.float32(n.num_uniques) / base_f32),
+        )
+        for n in numbers
+    ]
+
+
+def shrink_numbers(numbers: list[NiceNumber]) -> list[NiceNumberSimple]:
+    """Strip derived stats (reference number_stats.rs:57-65)."""
+    return [NiceNumberSimple(number=n.number, num_uniques=n.num_uniques) for n in numbers]
+
+
+def downsample_numbers(submissions: list[SubmissionRecord]) -> list[NiceNumber]:
+    """Aggregate all submissions' numbers; keep the top 10k by num_uniques
+    (reference number_stats.rs:39-53; stable sort preserves insertion order for
+    ties, matching Rust's sort_by)."""
+    all_numbers: list[NiceNumber] = []
+    for sub in submissions:
+        all_numbers.extend(sub.numbers)
+    all_numbers.sort(key=lambda n: n.num_uniques, reverse=True)
+    return all_numbers[:SAVE_TOP_N_NUMBERS]

@@ -1,0 +1,342 @@
+"""Shared domain types.
+
+Dataclass equivalents of the reference wire/DB structs (common/src/lib.rs:44-323)
+with identical field names, so JSON payloads are interchangeable between this
+framework and the reference's clients/servers. u128 values are plain Python
+ints (arbitrary precision); JSON serialisation emits them as numbers, matching
+serde_json's u128 handling.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Iterator, Optional
+
+
+class SearchMode(str, enum.Enum):
+    """Search modes supported by server and client (reference lib.rs:46-52)."""
+
+    DETAILED = "Detailed"
+    NICEONLY = "Niceonly"
+
+    def __str__(self) -> str:  # display parity: "Detailed" / "Nice-only"
+        return "Detailed" if self is SearchMode.DETAILED else "Nice-only"
+
+
+class FieldClaimStrategy(enum.Enum):
+    """How the server picks a field for a claim (reference lib.rs:64-71)."""
+
+    NEXT = "Next"
+    RANDOM = "Random"
+    THIN = "Thin"
+
+
+@dataclass(frozen=True)
+class FieldSize:
+    """Half-open search range [range_start, range_end) (reference lib.rs:85-153)."""
+
+    range_start: int
+    range_end: int
+
+    def __post_init__(self) -> None:
+        if not self.range_start < self.range_end:
+            raise ValueError(
+                "Range has invalid bounds, range_start must be < range_end "
+                "(half-open interval)"
+            )
+
+    @property
+    def range_size(self) -> int:
+        return self.range_end - self.range_start
+
+    def first(self) -> int:
+        return self.range_start
+
+    def last(self) -> int:
+        return self.range_end - 1
+
+    def start(self) -> int:
+        return self.range_start
+
+    def end(self) -> int:
+        return self.range_end
+
+    def size(self) -> int:
+        return self.range_end - self.range_start
+
+    def range_iter(self) -> Iterator[int]:
+        return iter(range(self.range_start, self.range_end))
+
+    def chunks(self, chunk_size: int) -> list["FieldSize"]:
+        """Break the range into half-open chunks of at most chunk_size."""
+        out = []
+        start = self.range_start
+        while start < self.range_end:
+            end = min(start + chunk_size, self.range_end)
+            out.append(FieldSize(start, end))
+            start = end
+        return out
+
+
+@dataclass(frozen=True)
+class UniquesDistributionSimple:
+    """One histogram bucket: count of numbers with num_uniques unique digits."""
+
+    num_uniques: int
+    count: int
+
+
+@dataclass(frozen=True)
+class UniquesDistribution:
+    """Extended histogram bucket with derived stats (reference lib.rs:173-179)."""
+
+    num_uniques: int
+    count: int
+    niceness: float
+    density: float
+
+
+@dataclass(frozen=True)
+class NiceNumberSimple:
+    """A notably nice number (reference lib.rs:182-186)."""
+
+    number: int
+    num_uniques: int
+
+
+@dataclass(frozen=True)
+class NiceNumber:
+    """Extended nice number with derived stats (reference lib.rs:189-195)."""
+
+    number: int
+    num_uniques: int
+    base: int
+    niceness: float
+
+
+@dataclass
+class DataToClient:
+    """A field sent to the client for processing (reference lib.rs:252-258)."""
+
+    claim_id: int
+    base: int
+    range_start: int
+    range_end: int
+    range_size: int
+
+    def to_field_size(self) -> FieldSize:
+        return FieldSize(self.range_start, self.range_end)
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "DataToClient":
+        return DataToClient(
+            claim_id=int(d["claim_id"]),
+            base=int(d["base"]),
+            range_start=int(d["range_start"]),
+            range_end=int(d["range_end"]),
+            range_size=int(d["range_size"]),
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "claim_id": self.claim_id,
+            "base": self.base,
+            "range_start": self.range_start,
+            "range_end": self.range_end,
+            "range_size": self.range_size,
+        }
+
+
+@dataclass
+class DataToServer:
+    """Compiled results sent to the server (reference lib.rs:262-268)."""
+
+    claim_id: int
+    username: str
+    client_version: str
+    unique_distribution: Optional[list[UniquesDistributionSimple]]
+    nice_numbers: list[NiceNumberSimple]
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "claim_id": self.claim_id,
+            "username": self.username,
+            "client_version": self.client_version,
+            "unique_distribution": None
+            if self.unique_distribution is None
+            else [
+                {"num_uniques": d.num_uniques, "count": d.count}
+                for d in self.unique_distribution
+            ],
+            "nice_numbers": [
+                {"number": n.number, "num_uniques": n.num_uniques}
+                for n in self.nice_numbers
+            ],
+        }
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "DataToServer":
+        dist = d.get("unique_distribution")
+        return DataToServer(
+            claim_id=int(d["claim_id"]),
+            username=str(d["username"]),
+            client_version=str(d["client_version"]),
+            unique_distribution=None
+            if dist is None
+            else [
+                UniquesDistributionSimple(int(x["num_uniques"]), int(x["count"]))
+                for x in dist
+            ],
+            nice_numbers=[
+                NiceNumberSimple(int(x["number"]), int(x["num_uniques"]))
+                for x in d.get("nice_numbers", [])
+            ],
+        )
+
+
+@dataclass
+class ValidationData:
+    """Field info plus canonical results for the self-check endpoint
+    (reference lib.rs:274-282)."""
+
+    base: int
+    field_id: int
+    range_start: int
+    range_end: int
+    range_size: int
+    unique_distribution: list[UniquesDistributionSimple]
+    nice_numbers: list[NiceNumberSimple]
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "ValidationData":
+        return ValidationData(
+            base=int(d["base"]),
+            field_id=int(d["field_id"]),
+            range_start=int(d["range_start"]),
+            range_end=int(d["range_end"]),
+            range_size=int(d["range_size"]),
+            unique_distribution=[
+                UniquesDistributionSimple(int(x["num_uniques"]), int(x["count"]))
+                for x in d["unique_distribution"]
+            ],
+            nice_numbers=[
+                NiceNumberSimple(int(x["number"]), int(x["num_uniques"]))
+                for x in d["nice_numbers"]
+            ],
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "base": self.base,
+            "field_id": self.field_id,
+            "range_start": self.range_start,
+            "range_end": self.range_end,
+            "range_size": self.range_size,
+            "unique_distribution": [
+                {"num_uniques": d_.num_uniques, "count": d_.count}
+                for d_ in self.unique_distribution
+            ],
+            "nice_numbers": [
+                {"number": n.number, "num_uniques": n.num_uniques}
+                for n in self.nice_numbers
+            ],
+        }
+
+
+@dataclass(frozen=True)
+class FieldResults:
+    """Results of processing a field or chunk (reference lib.rs:319-323)."""
+
+    distribution: tuple[UniquesDistributionSimple, ...]
+    nice_numbers: tuple[NiceNumberSimple, ...]
+
+
+@dataclass
+class FieldRecord:
+    """A field row from the DB ledger (reference lib.rs:236-247)."""
+
+    field_id: int
+    base: int
+    chunk_id: Optional[int]
+    range_start: int
+    range_end: int
+    range_size: int
+    last_claim_time: Optional[datetime]
+    canon_submission_id: Optional[int]
+    check_level: int
+    prioritize: bool
+
+
+@dataclass
+class ClaimRecord:
+    """A claim log row (reference lib.rs:286-292)."""
+
+    claim_id: int
+    field_id: int
+    search_mode: SearchMode
+    claim_time: datetime
+    user_ip: str
+
+
+@dataclass
+class SubmissionRecord:
+    """A validated submission row (reference lib.rs:296-309)."""
+
+    submission_id: int
+    claim_id: int
+    field_id: int
+    search_mode: SearchMode
+    submit_time: datetime
+    elapsed_secs: float
+    username: str
+    user_ip: str
+    client_version: str
+    disqualified: bool
+    distribution: Optional[list[UniquesDistribution]]
+    numbers: list[NiceNumber]
+
+
+@dataclass(frozen=True)
+class SubmissionCandidate:
+    """Submission stripped of metadata, used as the consensus hash key
+    (reference lib.rs:312-316)."""
+
+    distribution: tuple[UniquesDistributionSimple, ...]
+    numbers: tuple[NiceNumberSimple, ...]
+
+
+@dataclass
+class BaseRecord:
+    """Aggregate per-base analytics row (reference lib.rs:198-211)."""
+
+    base: int
+    range_start: int
+    range_end: int
+    range_size: int
+    checked_detailed: int
+    checked_niceonly: int
+    minimum_cl: int
+    niceness_mean: Optional[float]
+    niceness_stdev: Optional[float]
+    distribution: list[UniquesDistribution] = field(default_factory=list)
+    numbers: list[NiceNumber] = field(default_factory=list)
+
+
+@dataclass
+class ChunkRecord:
+    """Aggregate per-chunk analytics row (reference lib.rs:214-228)."""
+
+    chunk_id: int
+    base: int
+    range_start: int
+    range_end: int
+    range_size: int
+    checked_detailed: int
+    checked_niceonly: int
+    minimum_cl: int
+    niceness_mean: Optional[float]
+    niceness_stdev: Optional[float]
+    distribution: list[UniquesDistribution] = field(default_factory=list)
+    numbers: list[NiceNumber] = field(default_factory=list)

@@ -1,0 +1,1 @@
+"""Compute engine (L1): scalar oracle, filter cascade, vector/TPU engines."""

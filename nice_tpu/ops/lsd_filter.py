@@ -1,0 +1,56 @@
+"""LSD filter: valid last-k-digit suffixes mod b^k.
+
+The last k digits of n determine the last k digits of n^2 and n^3. A suffix is
+invalid when any digit of (n^2 mod b^k) collides with any digit of
+(n^3 mod b^k) — a guaranteed duplicate. Mirrors reference
+common/src/lsd_filter.rs:67-238.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+
+def _extract_digits(value: int, base: int, num_digits: int) -> set[int]:
+    """Unique digits among the low `num_digits` digits, stopping at zero
+    (reference lsd_filter.rs:132-148: always inserts the first digit)."""
+    digits = set()
+    remaining = value
+    for _ in range(num_digits):
+        remaining, d = divmod(remaining, base)
+        digits.add(d)
+        if remaining == 0:
+            break
+    return digits
+
+
+@lru_cache(maxsize=None)
+def get_valid_lsds(base: int) -> tuple[int, ...]:
+    """Single-digit filter: LSDs where n^2 and n^3 end in different digits
+    (reference lsd_filter.rs:67-121)."""
+    out = []
+    for lsd in range(base):
+        if (lsd * lsd) % base != (lsd * lsd * lsd) % base:
+            out.append(lsd)
+    return tuple(out)
+
+
+@lru_cache(maxsize=None)
+def get_valid_multi_lsd_bitmap(base: int, k: int) -> tuple[bool, ...]:
+    """bitmap[s] == True when suffix s (mod b^k) can produce a nice number
+    (reference lsd_filter.rs:174-224)."""
+    modulus = base**k
+    bitmap = [False] * modulus
+    for suffix in range(modulus):
+        sq = (suffix * suffix) % modulus
+        cb = (suffix * suffix * suffix) % modulus
+        sq_digits = _extract_digits(sq, base, k)
+        cb_digits = _extract_digits(cb, base, k)
+        if sq_digits.isdisjoint(cb_digits):
+            bitmap[suffix] = True
+    return tuple(bitmap)
+
+
+def get_recommended_k(base: int) -> int:
+    """Locked to 1 in the reference after benchmarking (lsd_filter.rs:234-238)."""
+    return 1

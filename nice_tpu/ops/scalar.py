@@ -1,0 +1,103 @@
+"""Scalar reference engine — the exact oracle every other backend diffs against.
+
+Python ints are arbitrary-precision, so one implementation covers every base
+(the reference needs u128 / U256 / malachite tiers: client_process.rs:47-71,
+222-253). This is the trusted implementation in the differential-test strategy
+(reference test pattern: fixed-width paths vs malachite, SURVEY.md section 4):
+scalar <-> jnp vector engine <-> Pallas kernels must agree bit-for-bit.
+
+Also used directly by the server for submission verification
+(reference api/src/main.rs:352-358) and by `--validate`.
+"""
+
+from __future__ import annotations
+
+from nice_tpu.core import number_stats
+from nice_tpu.core.types import (
+    FieldResults,
+    FieldSize,
+    NiceNumberSimple,
+    UniquesDistributionSimple,
+)
+
+
+def get_num_unique_digits(num: int, base: int) -> int:
+    """Number of unique digits in (n^2, n^3) written in base b.
+
+    A number is nice iff this equals b (reference client_process.rs:47-143).
+    """
+    indicator = 0
+    squared = num * num
+    cubed = squared * num
+    n = squared
+    while n != 0:
+        n, d = divmod(n, base)
+        indicator |= 1 << d
+    n = cubed
+    while n != 0:
+        n, d = divmod(n, base)
+        indicator |= 1 << d
+    return indicator.bit_count()
+
+
+def get_is_nice(num: int, base: int) -> bool:
+    """Early-exit duplicate check (reference client_process.rs:222-413)."""
+    indicator = 0
+    squared = num * num
+    n = squared
+    while n != 0:
+        n, d = divmod(n, base)
+        bit = 1 << d
+        if indicator & bit:
+            return False
+        indicator |= bit
+    n = squared * num
+    while n != 0:
+        n, d = divmod(n, base)
+        bit = 1 << d
+        if indicator & bit:
+            return False
+        indicator |= bit
+    return True
+
+
+def process_range_detailed(range_: FieldSize, base: int) -> FieldResults:
+    """Full histogram + near-miss list for a half-open range
+    (reference client_process.rs:150-191)."""
+    nice_list_cutoff = number_stats.get_near_miss_cutoff(base)
+    histogram = [0] * (base + 2)
+    nice_numbers: list[NiceNumberSimple] = []
+
+    for num in range_.range_iter():
+        num_uniques = get_num_unique_digits(num, base)
+        histogram[num_uniques] += 1
+        if num_uniques > nice_list_cutoff:
+            nice_numbers.append(
+                NiceNumberSimple(number=num, num_uniques=num_uniques)
+            )
+
+    distribution = tuple(
+        UniquesDistributionSimple(num_uniques=i, count=histogram[i])
+        for i in range(1, base + 1)
+    )
+    return FieldResults(distribution=distribution, nice_numbers=tuple(nice_numbers))
+
+
+def process_range_niceonly(
+    range_: FieldSize, base: int, stride_table=None
+) -> FieldResults:
+    """Nice-number-only search with the full filter cascade
+    (reference client_process.rs:439-465): recursive MSD range subdivision,
+    then CRT stride iteration with early-exit checks."""
+    from nice_tpu.ops import msd_filter, stride_filter
+
+    if stride_table is None:
+        stride_table = stride_filter.get_stride_table(base, 1)
+
+    valid_msd_ranges = msd_filter.get_valid_ranges(range_, base)
+
+    nice_list: list[NiceNumberSimple] = []
+    for sub_range in valid_msd_ranges:
+        nice_list.extend(stride_table.iterate_range(sub_range, base))
+
+    return FieldResults(distribution=(), nice_numbers=tuple(nice_list))

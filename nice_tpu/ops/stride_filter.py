@@ -1,0 +1,137 @@
+"""CRT stride iteration: combine residue (mod b-1) and LSD (mod b^k) filters.
+
+Instead of testing filters per candidate, precompute the valid residues of the
+combined modulus M = (b-1) * b^k (gcd(b-1, b^k) = 1) and jump candidate to
+candidate with a gap table — zero per-candidate filter cost. Mirrors reference
+common/src/stride_filter.rs:20-155.
+
+The table also powers the TPU niceonly kernel's dense candidate enumeration:
+candidate g maps to B0 + (g // R) * M + valid_residues[g % R] (the reference
+GPU's index-arithmetic trick, nice_kernels.cu:452-457), which device kernels
+compute branch-free.
+"""
+
+from __future__ import annotations
+
+import bisect
+from functools import lru_cache
+
+import numpy as np
+
+from nice_tpu.core.types import FieldSize, NiceNumberSimple
+from nice_tpu.ops import lsd_filter, residue_filter
+from nice_tpu.ops.scalar import get_is_nice
+
+
+class StrideTable:
+    """Precomputed valid residues mod M = (b-1) * b^k, plus gap table."""
+
+    def __init__(self, base: int, k: int):
+        b_minus_1 = base - 1
+        b_k = base**k
+        self.base = base
+        self.k = k
+        self.modulus = b_minus_1 * b_k
+
+        residue_set = np.array(residue_filter.get_residue_filter(base), dtype=np.int64)
+        lsd_bitmap = np.array(
+            lsd_filter.get_valid_multi_lsd_bitmap(base, k), dtype=bool
+        )
+
+        r = np.arange(self.modulus, dtype=np.int64)
+        passes_residue = np.isin(r % b_minus_1, residue_set)
+        passes_lsd = lsd_bitmap[r % b_k]
+        valid = np.nonzero(passes_residue & passes_lsd)[0]
+
+        self.valid_residues: list[int] = [int(v) for v in valid]
+        n = len(self.valid_residues)
+        self.gap_table: list[int] = [
+            (
+                self.valid_residues[i + 1] - self.valid_residues[i]
+                if i + 1 < n
+                else self.modulus - self.valid_residues[i] + self.valid_residues[0]
+            )
+            for i in range(n)
+        ]
+
+    @property
+    def num_residues(self) -> int:
+        return len(self.valid_residues)
+
+    def first_valid_at_or_after(self, start: int) -> tuple[int, int]:
+        """Smallest valid candidate n >= start, plus its residue index
+        (reference stride_filter.rs:99-124).
+
+        Raises ValueError when the table is empty (a base whose residue filter
+        admits nothing, e.g. 15 — such bases provably contain no nice numbers;
+        callers should use num_residues == 0 as "nothing to search").
+        """
+        if not self.valid_residues:
+            raise ValueError(
+                f"base {self.base} has no valid stride residues: no number "
+                "can be nice"
+            )
+        r = start % self.modulus
+        idx = bisect.bisect_left(self.valid_residues, r)
+        if idx >= len(self.valid_residues):
+            idx = 0
+        target_r = self.valid_residues[idx]
+        if target_r >= r:
+            n = start + (target_r - r)
+        else:
+            n = start + (self.modulus - r + target_r)
+        return (n, idx)
+
+    def candidate_index(self, n: int) -> int:
+        """Global dense index g of valid candidate n: g = (n // M) * R + idx.
+
+        Inverse of candidate_at. n must be a valid candidate.
+        """
+        cycle, r = divmod(n, self.modulus)
+        idx = bisect.bisect_left(self.valid_residues, r)
+        assert (
+            idx < len(self.valid_residues) and self.valid_residues[idx] == r
+        ), "n is not a valid stride candidate"
+        return cycle * len(self.valid_residues) + idx
+
+    def candidate_at(self, g: int) -> int:
+        """Candidate value for dense index g (the P7 index-arithmetic map)."""
+        cycle, j = divmod(g, len(self.valid_residues))
+        return cycle * self.modulus + self.valid_residues[j]
+
+    def count_candidates(self, range_: FieldSize) -> int:
+        """Number of valid candidates in a half-open range, via dense indices."""
+        if not self.valid_residues:
+            return 0
+        n0, idx0 = self.first_valid_at_or_after(range_.start())
+        if n0 >= range_.end():
+            return 0
+        g0 = (n0 // self.modulus) * len(self.valid_residues) + idx0
+        n1, idx1 = self.first_valid_at_or_after(range_.end())
+        g1 = (n1 // self.modulus) * len(self.valid_residues) + idx1
+        return g1 - g0
+
+    def iterate_range(self, range_: FieldSize, base: int) -> list[NiceNumberSimple]:
+        """Gap-jump through valid candidates, early-exit nice check on each
+        (reference stride_filter.rs:139-155)."""
+        if not self.valid_residues:
+            return []
+        results: list[NiceNumberSimple] = []
+        n, idx = self.first_valid_at_or_after(range_.start())
+        end = range_.end()
+        gap_table = self.gap_table
+        num = len(gap_table)
+        while n < end:
+            if get_is_nice(n, base):
+                results.append(NiceNumberSimple(number=n, num_uniques=base))
+            n += gap_table[idx]
+            idx += 1
+            if idx == num:
+                idx = 0
+        return results
+
+
+@lru_cache(maxsize=None)
+def get_stride_table(base: int, k: int) -> StrideTable:
+    """Shared per-(base, k) table (built once per process)."""
+    return StrideTable(base, k)

@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Tests never require real TPU hardware; sharding/collective tests run on the
+virtual mesh (the analog of the reference's compile-only NVRTC device tests,
+client_process_gpu.rs:1421-1451). bench.py, not the test suite, exercises the
+real chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

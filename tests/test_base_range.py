@@ -1,0 +1,121 @@
+"""Golden-value tests for base ranges, transcribed from the reference
+(common/src/base_range.rs:62-224)."""
+
+from nice_tpu.core.base_range import (
+    ceiling_root,
+    floor_root,
+    get_base_range,
+    get_base_range_field,
+    sqube_digit_counts,
+)
+
+
+def test_roots_exact():
+    assert floor_root(0, 3) == 0
+    assert floor_root(1, 3) == 1
+    assert floor_root(7, 3) == 1
+    assert floor_root(8, 3) == 2
+    assert floor_root(26, 3) == 2
+    assert floor_root(27, 3) == 3
+    big = 10**60 + 12345
+    r = floor_root(big, 3)
+    assert r**3 <= big < (r + 1) ** 3
+    assert ceiling_root(27, 3) == 3
+    assert ceiling_root(28, 3) == 4
+    for n in (2, 3, 5, 7):
+        for x in (10**30 + 7, 2**127 - 1, 40**24, 3):
+            r = floor_root(x, n)
+            assert r**n <= x < (r + 1) ** n
+
+
+def test_base_range_small():
+    assert get_base_range(5) == (3, 5)
+    assert get_base_range(6) is None
+    assert get_base_range(7) == (7, 14)
+    assert get_base_range(8) == (16, 23)
+    assert get_base_range(9) == (27, 39)
+    assert get_base_range(10) == (47, 100)
+    assert get_base_range(20) == (58_945, 160_000)
+    assert get_base_range(30) == (234_613_921, 729_000_000)
+
+
+def test_base_range_production():
+    assert get_base_range(40) == (1_916_284_264_916, 6_553_600_000_000)
+    assert get_base_range(50) == (26_507_984_537_059_635, 97_656_250_000_000_000)
+    assert get_base_range(60) == (
+        556_029_612_114_824_200_908,
+        2_176_782_336_000_000_000_000,
+    )
+    assert get_base_range(70) == (
+        16_456_591_172_673_850_596_148_008,
+        67_822_307_284_900_000_000_000_000,
+    )
+    assert get_base_range(80) == (
+        653_245_554_420_798_943_087_177_909_799,
+        2_814_749_767_106_560_000_000_000_000_000,
+    )
+    assert get_base_range(90) == (
+        33_492_764_832_792_484_045_981_163_311_105_668,
+        150_094_635_296_999_121_000_000_000_000_000_000,
+    )
+
+
+def test_base_range_beyond_u128():
+    assert get_base_range(100) == (
+        2154434690031883721759293566519350495260,
+        10000000000000000000000000000000000000000,
+    )
+    assert get_base_range(110) == (
+        169892749571608053239273597713205371466519752,
+        814027493868397611133210000000000000000000000,
+    )
+    assert get_base_range(120) == (
+        16117196090075248994613996554363597629408239219454,
+        79496847203390844133441536000000000000000000000000,
+    )
+    assert get_base_range(121) is None
+    assert get_base_range(122) == (
+        118205024187370033135932935819405317049548439289856,
+        586258581805989694050980431834549184603056531020211,
+    )
+    assert get_base_range(123) == (
+        715085071699820536699499456671007010425915160419662,
+        1594686179043939546502781159240976178904795301633108,
+    )
+    assert get_base_range(124) == (
+        1944604500263970232242123784503740458789493393829926,
+        4342450740818512904293955173690913927483946149220889,
+    )
+    assert get_base_range(125) == (
+        5293955920339377119177015629247762262821197509765625,
+        26469779601696885595885078146238811314105987548828125,
+    )
+
+
+def test_field_variant():
+    f = get_base_range_field(10)
+    assert f is not None
+    assert (f.range_start, f.range_end) == (47, 100)
+    assert get_base_range_field(6) is None
+
+
+def test_sqube_digit_counts_exact():
+    """Verify the exact-digit-count theorem (the TPU kernel's contract) by
+    brute force at range edges for many bases."""
+
+    def ndigits(x, b):
+        n = 0
+        while x:
+            x //= b
+            n += 1
+        return n
+
+    for base in list(range(5, 45)) + [50, 62, 64, 80, 97]:
+        r = get_base_range(base)
+        if r is None:
+            continue
+        d2, d3 = sqube_digit_counts(base)
+        assert d2 + d3 == base
+        for n in (r[0], r[0] + 1, (r[0] + r[1]) // 2, r[1] - 1):
+            assert ndigits(n * n, base) == d2, (base, n)
+            assert ndigits(n * n * n, base) == d3, (base, n)

@@ -1,0 +1,196 @@
+"""Tests for stats, consensus, field/chunk generation, and benchmark configs."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from nice_tpu.core import (
+    base_range,
+    consensus,
+    distribution_stats,
+    generate_chunks,
+    generate_fields,
+    number_stats,
+)
+from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
+from nice_tpu.core.types import (
+    FieldRecord,
+    FieldSize,
+    NiceNumberSimple,
+    SearchMode,
+    SubmissionRecord,
+    UniquesDistributionSimple,
+)
+
+
+def make_submission(sub_id, distribution, numbers, when=None):
+    dist = (
+        None
+        if not distribution
+        else distribution_stats.expand_distribution(distribution, 10)
+    )
+    return SubmissionRecord(
+        submission_id=sub_id,
+        claim_id=sub_id,
+        field_id=1,
+        search_mode=SearchMode.DETAILED,
+        submit_time=when or datetime.now(timezone.utc),
+        elapsed_secs=10.0,
+        username=f"user{sub_id}",
+        user_ip="127.0.0.1",
+        client_version="1.0.0",
+        disqualified=False,
+        distribution=dist,
+        numbers=number_stats.expand_numbers(numbers, 10),
+    )
+
+
+def make_field(check_level=1):
+    return FieldRecord(
+        field_id=1,
+        base=10,
+        chunk_id=1,
+        range_start=100,
+        range_end=200,
+        range_size=100,
+        last_claim_time=None,
+        canon_submission_id=None,
+        check_level=check_level,
+        prioritize=False,
+    )
+
+
+DIST_A = [
+    UniquesDistributionSimple(num_uniques=i, count=c)
+    for i, c in [(1, 50), (2, 50)]
+]
+DIST_B = [
+    UniquesDistributionSimple(num_uniques=i, count=c)
+    for i, c in [(1, 60), (2, 40)]
+]
+NUMS_A = [NiceNumberSimple(number=69, num_uniques=10)]
+
+
+def test_consensus_no_submissions():
+    canon, cl = consensus.evaluate_consensus(make_field(check_level=5), [])
+    assert canon is None
+    assert cl == 1
+
+
+def test_consensus_single_submission():
+    sub = make_submission(1, DIST_A, NUMS_A)
+    canon, cl = consensus.evaluate_consensus(make_field(), [sub])
+    assert canon is sub
+    assert cl == 2
+
+
+def test_consensus_majority_and_earliest_wins():
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    subs = [
+        make_submission(1, DIST_A, NUMS_A, t0 + timedelta(hours=2)),
+        make_submission(2, DIST_A, NUMS_A, t0),
+        make_submission(3, DIST_B, NUMS_A, t0 + timedelta(hours=1)),
+    ]
+    canon, cl = consensus.evaluate_consensus(make_field(), subs)
+    assert canon is not None and canon.submission_id == 2  # earliest in majority
+    assert cl == 3  # group size 2 + 1
+
+
+def test_consensus_check_level_cap():
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    subs = [
+        make_submission(i, DIST_A, NUMS_A, t0 + timedelta(seconds=i))
+        for i in range(300)
+    ]
+    _, cl = consensus.evaluate_consensus(make_field(), subs)
+    assert cl == 255
+
+
+def test_consensus_missing_distribution_raises():
+    subs = [make_submission(1, [], NUMS_A), make_submission(2, [], NUMS_A)]
+    with pytest.raises(ValueError):
+        consensus.evaluate_consensus(make_field(), subs)
+
+
+def test_expand_distribution():
+    out = distribution_stats.expand_distribution(DIST_A, 10)
+    assert out[0].niceness == pytest.approx(0.1)
+    assert out[0].density == pytest.approx(0.5)
+    total = sum(d.count for d in out)
+    assert total == 100
+
+
+def test_mean_stdev():
+    dist = distribution_stats.expand_distribution(DIST_A, 10)
+    mean, stdev = distribution_stats.mean_stdev_from_distribution(dist)
+    assert mean == pytest.approx(0.15, abs=1e-6)
+    assert stdev == pytest.approx(0.05, abs=1e-6)
+
+
+def test_downsample_numbers_top_n():
+    n_over = number_stats.SAVE_TOP_N_NUMBERS + 100
+    many = [NiceNumberSimple(number=i, num_uniques=3) for i in range(1, n_over + 1)]
+    best = NiceNumberSimple(number=n_over + 1, num_uniques=9)
+    sub = make_submission(1, DIST_A, many + [best])
+    out = number_stats.downsample_numbers([sub])
+    assert len(out) == number_stats.SAVE_TOP_N_NUMBERS
+    assert out[0].number == best.number
+
+
+def test_downsample_distributions():
+    subs = [make_submission(1, DIST_A, []), make_submission(2, DIST_B, [])]
+    out = distribution_stats.downsample_distributions(subs, 10)
+    assert len(out) == 10
+    by_uniques = {d.num_uniques: d.count for d in out}
+    assert by_uniques[1] == 110
+    assert by_uniques[2] == 90
+
+
+def test_break_range_into_fields():
+    fields = generate_fields.break_range_into_fields(0, 100, 30)
+    assert [(f.range_start, f.range_end) for f in fields] == [
+        (0, 30), (30, 60), (60, 90), (90, 100),
+    ]
+    one = generate_fields.break_range_into_fields(5, 10, 100)
+    assert [(f.range_start, f.range_end) for f in one] == [(5, 10)]
+
+
+def test_group_fields_into_chunks():
+    fields = generate_fields.break_range_into_fields(0, 1000, 1)
+    chunks = generate_chunks.group_fields_into_chunks(list(fields))
+    assert len(chunks) == 100
+    assert chunks[0].range_start == 0
+    assert chunks[-1].range_end == 1000
+    # Contiguous cover
+    for a, b in zip(chunks, chunks[1:]):
+        assert a.range_end == b.range_start
+    few = generate_fields.break_range_into_fields(0, 10, 1)
+    assert len(generate_chunks.group_fields_into_chunks(list(few))) == 10
+
+
+def test_benchmark_fields():
+    f = get_benchmark_field(BenchmarkMode.BASE_TEN)
+    assert (f.base, f.range_start, f.range_end) == (10, 47, 100)
+    f = get_benchmark_field(BenchmarkMode.DEFAULT)
+    assert (f.base, f.range_start, f.range_size) == (40, 1_916_284_264_916, 10**6)
+    f = get_benchmark_field(BenchmarkMode.EXTRA_LARGE)
+    assert (f.base, f.range_size) == (40, 10**9)
+    f = get_benchmark_field(BenchmarkMode.MASSIVE)
+    assert (f.base, f.range_size) == (50, 10**13)
+    f = get_benchmark_field(BenchmarkMode.HI_BASE)
+    assert (f.base, f.range_size) == (80, 10**9)
+    f = get_benchmark_field(BenchmarkMode.MSD_EFFECTIVE)
+    assert (f.base, f.range_start) == (50, 26_507_984_537_059_635)
+    f = get_benchmark_field(BenchmarkMode.MSD_INEFFECTIVE)
+    assert (f.base, f.range_start, f.range_size) == (
+        50, 94_760_515_586_064_977, 10**7,
+    )
+
+
+def test_field_size_chunks():
+    fs = FieldSize(0, 10)
+    assert [(c.range_start, c.range_end) for c in fs.chunks(4)] == [
+        (0, 4), (4, 8), (8, 10),
+    ]
+    base = base_range.get_base_range_field(10)
+    assert base.size() == 53

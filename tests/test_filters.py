@@ -1,0 +1,158 @@
+"""Golden-value tests for the filter cascade, transcribed from the reference
+(residue_filter.rs:27-76, lsd_filter.rs:244-331, stride_filter.rs:162-246)."""
+
+from nice_tpu.core.types import FieldSize
+from nice_tpu.ops import lsd_filter, msd_filter, residue_filter
+from nice_tpu.ops.stride_filter import StrideTable
+
+
+def test_residue_filter_goldens():
+    f = residue_filter.get_residue_filter
+    assert f(10) == (0, 3, 6, 8)
+    assert f(11) == ()
+    assert f(12) == (0, 10)
+    assert f(13) == (5, 9)
+    assert f(14) == (0, 12)
+    assert f(15) == ()
+    assert f(16) == (0, 5, 9, 14)
+    assert f(17) == (7,)
+    assert f(18) == (0, 16)
+    assert f(19) == ()
+    assert f(20) == (0, 18)
+    assert f(21) == (5, 9)
+    assert f(22) == (0, 6, 14, 20)
+    assert f(23) == ()
+    assert f(24) == (0, 22)
+    assert f(25) == (2, 3, 6, 11, 14, 18)
+    assert f(26) == (0, 5, 10, 15, 20, 24)
+    assert f(27) == ()
+    assert f(28) == (0, 9, 18, 26)
+    assert f(29) == (13, 21)
+    assert f(30) == (0, 28)
+    assert f(40) == (0, 12, 26, 38)
+    assert f(50) == (0, 7, 14, 21, 28, 35, 42, 48)
+    assert f(60) == (0, 58)
+    assert f(70) == (0, 23, 45, 68)
+    assert f(80) == (0, 78)
+    assert f(90) == (0, 88)
+    assert f(100) == (0, 21, 33, 44, 54, 66, 87, 98)
+    assert f(110) == (0, 108)
+    assert f(111) == ()
+    assert f(112) == (0, 36, 74, 110)
+    assert f(113) == (7, 55)
+    assert f(114) == (0, 112)
+    assert f(115) == ()
+    assert f(116) == (0, 45, 69, 114)
+    assert f(117) == (29, 57)
+    assert f(118) == (0, 12, 26, 39, 51, 78, 90, 116)
+    assert f(119) == ()
+    assert f(120) == (0, 34, 84, 118)
+
+
+def test_lsd_filter_base10():
+    assert lsd_filter.get_valid_lsds(10) == (2, 3, 4, 7, 8, 9)
+
+
+def test_lsd_bitmap_k1_matches_single_digit():
+    for base in (10, 13, 17, 40, 50, 80):
+        bitmap = lsd_filter.get_valid_multi_lsd_bitmap(base, 1)
+        valid = tuple(i for i, v in enumerate(bitmap) if v)
+        assert valid == lsd_filter.get_valid_lsds(base)
+
+
+def test_lsd_bitmap_k2_sound():
+    """Every k=2-valid suffix must also be k=1-valid mod b, and 69's suffix
+    must survive in base 10."""
+    base = 10
+    bitmap2 = lsd_filter.get_valid_multi_lsd_bitmap(base, 2)
+    valid1 = set(lsd_filter.get_valid_lsds(base))
+    for s, ok in enumerate(bitmap2):
+        if ok:
+            assert s % base in valid1
+    assert bitmap2[69]
+
+
+def test_stride_table_base10_k1():
+    t = StrideTable(10, 1)
+    assert t.modulus == 90
+    assert len(t.valid_residues) == len(t.gap_table) > 0
+    assert sum(t.gap_table) == t.modulus
+
+
+def test_stride_table_base40_k2():
+    t = StrideTable(40, 2)
+    assert t.modulus == 62_400
+    assert 0 < len(t.valid_residues) < t.modulus
+    assert sum(t.gap_table) == t.modulus
+
+
+def test_first_valid_at_or_after():
+    t = StrideTable(10, 1)
+    n, idx = t.first_valid_at_or_after(0)
+    assert n == t.valid_residues[idx]
+    first = t.valid_residues[0]
+    n, idx = t.first_valid_at_or_after(first)
+    assert (n, idx) == (first, 0)
+    n, idx = t.first_valid_at_or_after(t.modulus + 5)
+    assert n >= t.modulus + 5
+    assert n % t.modulus == t.valid_residues[idx]
+
+
+def test_stride_iteration_finds_69():
+    t = StrideTable(10, 1)
+    results = t.iterate_range(FieldSize(60, 80), 10)
+    assert any(r.number == 69 for r in results)
+
+
+def test_candidate_index_roundtrip():
+    for base, k in ((10, 1), (40, 2), (50, 1)):
+        t = StrideTable(base, k)
+        start = 10**6 + 1
+        n, idx = t.first_valid_at_or_after(start)
+        g = t.candidate_index(n)
+        assert t.candidate_at(g) == n
+        # consecutive g enumerate the same sequence as gap jumps
+        m = n
+        for step in range(25):
+            assert t.candidate_at(g + step) == m
+            m += t.gap_table[(idx + step) % len(t.gap_table)]
+
+
+def test_count_candidates_matches_iteration():
+    t = StrideTable(10, 1)
+    rng = FieldSize(47, 1000)
+    count = t.count_candidates(rng)
+    n, idx = t.first_valid_at_or_after(47)
+    seen = 0
+    while n < 1000:
+        seen += 1
+        n += t.gap_table[idx]
+        idx = (idx + 1) % len(t.gap_table)
+    assert count == seen
+
+
+def test_msd_filter_single_value_not_skipped():
+    assert not msd_filter.has_duplicate_msd_prefix(FieldSize(69, 70), 10)
+
+
+def test_msd_filter_soundness_b10():
+    """Any range the filter skips must contain no nice numbers (69 is the only
+    nice number in base 10)."""
+    for lo in range(47, 95, 3):
+        for hi in (lo + 2, lo + 7, lo + 20):
+            hi = min(hi, 100)
+            if lo >= hi:
+                continue
+            if msd_filter.has_duplicate_msd_prefix(FieldSize(lo, hi), 10):
+                assert not (lo <= 69 < hi)
+
+
+def test_msd_recursive_covers_69():
+    ranges = msd_filter.get_valid_ranges(FieldSize(47, 100), 10)
+    assert any(r.range_start <= 69 < r.range_end for r in ranges)
+    # Output ranges are disjoint, ordered, within bounds.
+    prev_end = 47
+    for r in ranges:
+        assert r.range_start >= prev_end
+        assert r.range_end <= 100
+        prev_end = r.range_end

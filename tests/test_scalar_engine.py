@@ -1,0 +1,104 @@
+"""Golden-value tests for the scalar oracle, transcribed from the reference
+(client_process.rs:474-1168)."""
+
+import pytest
+
+from nice_tpu.core import base_range, number_stats
+from nice_tpu.core.types import FieldSize
+from nice_tpu.ops import scalar
+from nice_tpu.ops.stride_filter import StrideTable
+
+# Nonzero histogram buckets from the reference goldens.
+GOLDEN_B10 = {4: 4, 5: 5, 6: 15, 7: 20, 8: 7, 9: 1, 10: 1}
+GOLDEN_B40_10K = {
+    15: 1, 16: 2, 17: 15, 18: 68, 19: 190, 20: 423, 21: 959, 22: 1615,
+    23: 1995, 24: 1982, 25: 1438, 26: 825, 27: 349, 28: 110, 29: 26, 30: 2,
+}
+GOLDEN_B80_10K = {
+    36: 1, 37: 6, 38: 14, 39: 62, 40: 122, 41: 263, 42: 492, 43: 830,
+    44: 1170, 45: 1392, 46: 1477, 47: 1427, 48: 1145, 49: 745, 50: 462,
+    51: 242, 52: 88, 53: 35, 54: 19, 55: 7, 56: 1,
+}
+
+
+def expected_distribution(base, golden):
+    return tuple(
+        (i, golden.get(i, 0)) for i in range(1, base + 1)
+    )
+
+
+def as_tuples(distribution):
+    return tuple((d.num_uniques, d.count) for d in distribution)
+
+
+def test_get_num_unique_digits_69():
+    # 69^2 = 4761, 69^3 = 328509: all ten digits exactly once.
+    assert scalar.get_num_unique_digits(69, 10) == 10
+    assert scalar.get_is_nice(69, 10)
+    assert not scalar.get_is_nice(68, 10)
+
+
+def test_near_miss_cutoff_f32_semantics():
+    # f32(10) * f32(0.9) rounds to exactly 9.0 -> floor 9 (not 8).
+    assert number_stats.get_near_miss_cutoff(10) == 9
+    assert number_stats.get_near_miss_cutoff(40) == 36
+    assert number_stats.get_near_miss_cutoff(50) == 45
+    assert number_stats.get_near_miss_cutoff(80) == 72
+
+
+def test_process_detailed_b10():
+    br = base_range.get_base_range_field(10)
+    res = scalar.process_range_detailed(br, 10)
+    assert as_tuples(res.distribution) == expected_distribution(10, GOLDEN_B10)
+    assert [(n.number, n.num_uniques) for n in res.nice_numbers] == [(69, 10)]
+
+
+def test_process_detailed_b40_10k():
+    br = base_range.get_base_range_field(40)
+    rng = FieldSize(br.start(), br.start() + 10_000)
+    res = scalar.process_range_detailed(rng, 40)
+    assert as_tuples(res.distribution) == expected_distribution(40, GOLDEN_B40_10K)
+    assert res.nice_numbers == ()
+
+
+def test_process_detailed_b80_10k():
+    br = base_range.get_base_range_field(80)
+    rng = FieldSize(br.start(), br.start() + 10_000)
+    res = scalar.process_range_detailed(rng, 80)
+    assert as_tuples(res.distribution) == expected_distribution(80, GOLDEN_B80_10K)
+    assert res.nice_numbers == ()
+
+
+def test_process_niceonly_b10():
+    br = base_range.get_base_range_field(10)
+    res = scalar.process_range_niceonly(br, 10, StrideTable(10, 1))
+    assert res.distribution == ()
+    assert [(n.number, n.num_uniques) for n in res.nice_numbers] == [(69, 10)]
+
+
+@pytest.mark.parametrize("base", [40, 80])
+def test_process_niceonly_10k_empty(base):
+    br = base_range.get_base_range_field(base)
+    rng = FieldSize(br.start(), br.start() + 10_000)
+    res = scalar.process_range_niceonly(rng, base, StrideTable(base, 1))
+    assert res.nice_numbers == ()
+
+
+def test_niceonly_chunked_consistency():
+    # Processing [47, 147) must still find 69 (out-of-base-range tail included;
+    # reference client_process.rs:1152-1168).
+    res = scalar.process_range_niceonly(FieldSize(47, 147), 10, StrideTable(10, 1))
+    assert any(n.number == 69 for n in res.nice_numbers)
+
+
+def test_niceonly_matches_detailed_bruteforce_b20():
+    """Differential: niceonly cascade vs brute-force detailed scan on a slice
+    of base 20."""
+    br = base_range.get_base_range_field(20)
+    rng = FieldSize(br.start(), br.start() + 4_000)
+    detailed = scalar.process_range_detailed(rng, 20)
+    nice_from_detailed = sorted(
+        n.number for n in detailed.nice_numbers if n.num_uniques == 20
+    )
+    niceonly = scalar.process_range_niceonly(rng, 20, StrideTable(20, 1))
+    assert sorted(n.number for n in niceonly.nice_numbers) == nice_from_detailed

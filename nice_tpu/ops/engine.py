@@ -1,0 +1,175 @@
+"""Field-processing engine: dispatches ranges to a backend and assembles
+exact FieldResults.
+
+Backends:
+  "scalar" — the Python-int oracle (ops/scalar.py)
+  "jax"    — the vectorized fixed-width engine (ops/vector_engine.py), jitted
+             for CPU or a single TPU chip
+  (the sharded multi-chip path lives in parallel/; Pallas kernels plug in as
+   a drop-in replacement for the batch functions)
+
+The JAX backends require the range to lie inside the base's valid range (the
+fixed-width digit-extraction contract); out-of-range slivers — which occur
+only in synthetic tests, never in server fields — fall back to the scalar
+oracle per sub-range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nice_tpu.core import base_range
+from nice_tpu.core.types import (
+    FieldResults,
+    FieldSize,
+    NiceNumberSimple,
+    UniquesDistributionSimple,
+)
+from nice_tpu.ops import scalar
+from nice_tpu.ops.limbs import get_plan, int_to_limbs
+from nice_tpu.ops import vector_engine as ve
+
+# Default lanes per device batch. Large enough to amortize dispatch, small
+# enough to keep intermediates comfortably in HBM.
+DEFAULT_BATCH_SIZE = 1 << 18
+
+
+def _clamp_to_base_range(range_: FieldSize, base: int):
+    """Split range into (pre, core, post) where core is inside the base range."""
+    br = base_range.get_base_range(base)
+    if br is None:
+        return (range_, None, None)
+    lo = max(range_.start(), br[0])
+    hi = min(range_.end(), br[1])
+    if lo >= hi:
+        return (range_, None, None)
+    pre = FieldSize(range_.start(), lo) if range_.start() < lo else None
+    core = FieldSize(lo, hi)
+    post = FieldSize(hi, range_.end()) if hi < range_.end() else None
+    return (pre, core, post)
+
+
+def _split_for_jax(range_: FieldSize, base: int, scalar_fn):
+    """Clamp to the base range; run scalar_fn on out-of-range slivers.
+
+    Returns (core, sliver_results) where core may be None (range entirely
+    outside the base range — caller should go fully scalar).
+    """
+    pre, core, post = _clamp_to_base_range(range_, base)
+    slivers = [scalar_fn(part) for part in (pre, post) if part is not None]
+    return core, slivers
+
+
+def process_range_detailed(
+    range_: FieldSize,
+    base: int,
+    backend: str = "jax",
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> FieldResults:
+    """Full histogram + near-miss list, exact, any backend."""
+    if backend == "scalar":
+        return scalar.process_range_detailed(range_, base)
+    if backend != "jax":
+        raise ValueError(f"unknown backend {backend!r}")
+
+    core, slivers = _split_for_jax(
+        range_, base, lambda part: scalar.process_range_detailed(part, base)
+    )
+    if core is None:
+        return scalar.process_range_detailed(range_, base)
+
+    plan = get_plan(base)
+    hist = np.zeros(plan.base + 2, dtype=np.int64)
+    nice_numbers: list[NiceNumberSimple] = []
+    for sub in slivers:
+        for d in sub.distribution:
+            hist[d.num_uniques] += d.count
+        nice_numbers.extend(sub.nice_numbers)
+
+    start = core.start()
+    total = core.size()
+    done = 0
+    while done < total:
+        valid = min(batch_size, total - done)
+        batch_start = start + done
+        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
+        bh, nm = ve.detailed_batch(
+            plan, batch_size, start_limbs, np.int32(valid)
+        )
+        bh = np.asarray(bh, dtype=np.int64)
+        bh[0] -= batch_size - valid  # remove tail-padding lanes from bin 0
+        hist += bh
+        if int(nm) > 0:
+            uniques = np.asarray(ve.uniques_batch(plan, batch_size, start_limbs))
+            idxs = np.nonzero(uniques[:valid] > plan.near_miss_cutoff)[0]
+            for i in idxs.tolist():
+                nice_numbers.append(
+                    NiceNumberSimple(
+                        number=batch_start + i, num_uniques=int(uniques[i])
+                    )
+                )
+        done += valid
+
+    nice_numbers.sort(key=lambda n: n.number)
+    distribution = tuple(
+        UniquesDistributionSimple(num_uniques=i, count=int(hist[i]))
+        for i in range(1, base + 1)
+    )
+    return FieldResults(distribution=distribution, nice_numbers=tuple(nice_numbers))
+
+
+def process_range_niceonly(
+    range_: FieldSize,
+    base: int,
+    stride_table=None,
+    backend: str = "jax",
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> FieldResults:
+    """Nice-number search. The jax backend currently runs the dense masked
+    check over MSD-surviving sub-ranges; the stride-compacted device
+    enumeration arrives with the Pallas niceonly kernel."""
+    if backend == "scalar":
+        return scalar.process_range_niceonly(range_, base, stride_table)
+    if backend != "jax":
+        raise ValueError(f"unknown backend {backend!r}")
+
+    from nice_tpu.ops import msd_filter
+
+    core, slivers = _split_for_jax(
+        range_,
+        base,
+        lambda part: scalar.process_range_niceonly(part, base, stride_table),
+    )
+    if core is None:
+        return scalar.process_range_niceonly(range_, base, stride_table)
+
+    nice_numbers: list[NiceNumberSimple] = []
+    for sub in slivers:
+        nice_numbers.extend(sub.nice_numbers)
+
+    plan = get_plan(base)
+    for sub_range in msd_filter.get_valid_ranges(core, base):
+        start = sub_range.start()
+        total = sub_range.size()
+        done = 0
+        while done < total:
+            valid = min(batch_size, total - done)
+            batch_start = start + done
+            start_limbs = int_to_limbs(batch_start, plan.limbs_n)
+            count = int(
+                ve.niceonly_dense_batch(
+                    plan, batch_size, start_limbs, np.int32(valid)
+                )
+            )
+            if count > 0:
+                uniques = np.asarray(
+                    ve.uniques_batch(plan, batch_size, start_limbs)
+                )
+                for i in np.nonzero(uniques[:valid] == base)[0].tolist():
+                    nice_numbers.append(
+                        NiceNumberSimple(number=batch_start + i, num_uniques=base)
+                    )
+            done += valid
+
+    nice_numbers.sort(key=lambda n: n.number)
+    return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))

@@ -1,0 +1,127 @@
+"""Per-base fixed-width plans and host-side limb packing.
+
+TPUs have no native u64/u128: candidates, squares, and cubes are represented
+as vectors of u32 limbs (LSW first), with 32x32->64 products decomposed into
+16-bit halves (the VPU analog of the reference CUDA kernel's u32-limb /
+u64-accumulator scheme, nice_kernels.cu:164-179, re-derived for 32-bit
+accumulators).
+
+Everything shape-determining is precomputed here per base — limb counts, exact
+digit counts, the chunked radix divisor — and burned into the traced program
+as constants. This is the same JIT-specialize-per-(base, mode) philosophy the
+reference applies via const generics and NVRTC -D defines
+(client_process_gpu.rs:318-381): every `%`/`//` in the kernel has a
+compile-time divisor that XLA strength-reduces to multiply-shift.
+
+Digit extraction relies on the exact-digit-count theorem (core/base_range.py):
+inside a base's valid range, digits(n^2) and digits(n^3) are constants, so
+extraction runs a fixed trip count with no leading-zero ("phantom digit")
+masking — the bug class the reference fought in its GPU prefilter
+(nice_kernels.cu:46-49) simply cannot occur.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+from nice_tpu.core import base_range, number_stats
+
+
+def bits_for(value: int) -> int:
+    """Bits needed to store any integer in [0, value)."""
+    return max((value - 1).bit_length(), 1)
+
+
+def limbs_for(value: int) -> int:
+    """u32 limbs needed to store any integer in [0, value)."""
+    return (bits_for(value) + 31) // 32
+
+
+def halfwords_for(value: int) -> int:
+    """16-bit halfwords needed to store any integer in [0, value)."""
+    return (bits_for(value) + 15) // 16
+
+
+@dataclass(frozen=True)
+class BasePlan:
+    """All trace-time constants for one base's kernels."""
+
+    base: int
+    range_start: int
+    range_end: int
+    d_sq: int  # exact digit count of n^2 in the valid range
+    d_cu: int  # exact digit count of n^3
+    limbs_n: int  # u32 limbs for n
+    limbs_sq: int
+    limbs_cu: int
+    hw_sq: int  # 16-bit halfwords for n^2
+    hw_cu: int
+    chunk_e: int  # digits peeled per chunk division
+    chunk_div: int  # base ** chunk_e, <= 2^16
+    n_masks: int  # u32 digit-presence masks (ceil(base / 32))
+    near_miss_cutoff: int
+
+    @property
+    def total_digits(self) -> int:
+        return self.d_sq + self.d_cu  # == base
+
+
+@functools.lru_cache(maxsize=None)
+def get_plan(base: int) -> BasePlan:
+    r = base_range.get_base_range(base)
+    if r is None:
+        raise ValueError(f"base {base} has no valid range")
+    start, end = r
+    d_sq, d_cu = base_range.sqube_digit_counts(base)
+
+    # Largest e with base^e <= 2^16 keeps every chunk-division intermediate
+    # (rem * 2^16 + halfword < chunk_div * 2^16) inside u32.
+    chunk_e = 1
+    while base ** (chunk_e + 1) <= 1 << 16:
+        chunk_e += 1
+
+    max_n = end - 1
+    return BasePlan(
+        base=base,
+        range_start=start,
+        range_end=end,
+        d_sq=d_sq,
+        d_cu=d_cu,
+        limbs_n=limbs_for(max_n + 1),
+        limbs_sq=limbs_for(base**d_sq),
+        limbs_cu=limbs_for(base**d_cu),
+        hw_sq=halfwords_for(base**d_sq),
+        hw_cu=halfwords_for(base**d_cu),
+        chunk_div=base**chunk_e,
+        chunk_e=chunk_e,
+        n_masks=(base + 31) // 32,
+        near_miss_cutoff=number_stats.get_near_miss_cutoff(base),
+    )
+
+
+def int_to_limbs(x: int, num_limbs: int) -> np.ndarray:
+    """Pack a Python int into LSW-first u32 limbs."""
+    if x < 0 or x >= 1 << (32 * num_limbs):
+        raise ValueError(f"{x} does not fit in {num_limbs} u32 limbs")
+    return np.array(
+        [(x >> (32 * i)) & 0xFFFFFFFF for i in range(num_limbs)], dtype=np.uint32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    """Inverse of int_to_limbs (accepts any array-like of u32)."""
+    out = 0
+    for i, limb in enumerate(np.asarray(limbs, dtype=np.uint64).tolist()):
+        out |= int(limb) << (32 * i)
+    return out
+
+
+def ints_to_limbs(xs: list[int], num_limbs: int) -> np.ndarray:
+    """Pack many ints into a (len(xs), num_limbs) LSW-first u32 array."""
+    out = np.empty((len(xs), num_limbs), dtype=np.uint32)
+    for row, x in enumerate(xs):
+        out[row] = int_to_limbs(x, num_limbs)
+    return out

@@ -1,0 +1,222 @@
+"""Vectorized fixed-width niceness engine in pure jnp (uint32 lanes).
+
+This is the XLA-compiled compute graph shared (structurally) with the Pallas
+kernels: all per-base shape decisions come from ops/limbs.BasePlan and are
+trace-time constants. Values are lists of (batch,) uint32 arrays — one array
+per limb — so XLA keeps limbs in registers and fuses the whole digit pipeline.
+
+Pipeline per candidate lane (mirrors reference nice_kernels.cu:420-531, but
+mask-based instead of warp-divergent early exit):
+    n = start + iota                      (zero input transfer)
+    sq = n * n, cu = sq * n               (schoolbook 16-bit-half products)
+    digits via chunked radix extraction   (constant divisors, fixed trip count)
+    presence bitmasks -> popcount         -> num_uniques
+    histogram via bincount; near-misses extracted on a rare second pass
+
+Correctness contract: the processed range must lie inside the base's valid
+range (engine.py enforces; the exact-digit-count theorem holds there).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nice_tpu.ops.limbs import BasePlan, bits_for, halfwords_for
+
+U32 = jnp.uint32
+MASK16 = np.uint32(0xFFFF)
+
+
+# --------------------------------------------------------------------------
+# u32 limb primitives
+# --------------------------------------------------------------------------
+
+def mul32(a, b):
+    """Full 32x32 -> 64 product as (lo, hi) u32, via 16-bit halves."""
+    a_lo = a & MASK16
+    a_hi = a >> 16
+    b_lo = b & MASK16
+    b_hi = b >> 16
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    t = (ll >> 16) + (lh & MASK16) + (hl & MASK16)
+    lo = (ll & MASK16) | (t << 16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (t >> 16)
+    return lo, hi
+
+
+def _carry(flag):
+    return flag.astype(U32)
+
+
+def mul_limbs(a: list, b: list, out_len: int) -> list:
+    """Schoolbook multiply of LSW-first limb lists, truncated to out_len."""
+    zero = jnp.zeros_like(a[0])
+    out = [zero] * out_len
+    for i, ai in enumerate(a):
+        if i >= out_len:
+            break
+        carry = zero
+        for j, bj in enumerate(b):
+            k = i + j
+            if k >= out_len:
+                break
+            lo, hi = mul32(ai, bj)
+            s1 = out[k] + lo
+            c1 = _carry(s1 < lo)
+            s2 = s1 + carry
+            c2 = _carry(s2 < carry)
+            out[k] = s2
+            # hi + c1 + c2 cannot wrap: the exact column total fits in 64 bits.
+            carry = hi + c1 + c2
+        if i + len(b) < out_len:
+            out[i + len(b)] = carry
+    return out
+
+
+def add_u32(limbs: list, x) -> list:
+    """limbs + x where x is a (batch,) u32 (e.g. the lane iota)."""
+    out = []
+    carry = x
+    for limb in limbs:
+        s = limb + carry
+        carry = _carry(s < limb)
+        out.append(s)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Digit extraction (chunked radix, constant divisors)
+# --------------------------------------------------------------------------
+
+def limbs_to_halfwords_msw(limbs: list, hw_count: int) -> list:
+    """u32 limb list -> MSW-first list of 16-bit values held in u32 lanes."""
+    out = []
+    for i in range(hw_count - 1, -1, -1):
+        out.append((limbs[i // 2] >> (16 * (i % 2))) & MASK16)
+    return out
+
+
+def _divmod_halfwords(hws_msw: list, divisor: int, out_len: int):
+    """Long division of an MSW-first halfword list by a constant <= 2^16.
+
+    Every intermediate (rem * 2^16 + halfword < divisor * 2^16 <= 2^32) fits
+    in u32. Returns (quotient truncated to out_len MSW-first halfwords, rem).
+    """
+    c = np.uint32(divisor)
+    rem = jnp.zeros_like(hws_msw[0])
+    q = []
+    for h in hws_msw:
+        cur = (rem << 16) | h
+        qi = cur // c
+        rem = cur - qi * c
+        q.append(qi)
+    return q[len(q) - out_len :], rem
+
+
+def extract_digit_list(plan: BasePlan, limbs: list, num_digits: int, hw_count: int):
+    """All base digits of a value with exactly num_digits digits.
+
+    Chunked: peel chunk_e digits at a time with one multi-halfword division by
+    the constant chunk_div, then split the small remainder into single digits
+    with scalar constant divisions (reference nice_kernels.cu:203-247 chunk
+    scheme, sized for u32 instead of u64 intermediates).
+    """
+    base = np.uint32(plan.base)
+    digits = []
+    hws = limbs_to_halfwords_msw(limbs, hw_count)
+    remaining = num_digits
+    while remaining > plan.chunk_e:
+        remaining -= plan.chunk_e
+        new_hw = halfwords_for(plan.base**remaining)
+        hws, rem = _divmod_halfwords(hws, plan.chunk_div, new_hw)
+        for _ in range(plan.chunk_e):
+            digits.append(rem % base)
+            rem = rem // base
+    # Tail: value now fits in one halfword (base^remaining <= chunk_div <= 2^16).
+    assert len(hws) == 1, (plan.base, num_digits, len(hws))
+    rem = hws[0]
+    for _ in range(remaining):
+        digits.append(rem % base)
+        rem = rem // base
+    return digits
+
+
+def set_digit_masks(plan: BasePlan, masks: list, digits: list) -> list:
+    """OR each digit's presence bit into the u32 mask words."""
+    one = np.uint32(1)
+    zero = np.uint32(0)
+    for d in digits:
+        bit = jnp.left_shift(one, d & np.uint32(31))
+        if plan.n_masks == 1:
+            masks[0] = masks[0] | bit
+        else:
+            w = d >> 5
+            for wi in range(plan.n_masks):
+                masks[wi] = masks[wi] | jnp.where(w == np.uint32(wi), bit, zero)
+    return masks
+
+
+def num_uniques_lanes(plan: BasePlan, n_limbs: list):
+    """num_uniques of (n^2, n^3) for a batch of candidates given as limbs."""
+    sq = mul_limbs(n_limbs, n_limbs, plan.limbs_sq)
+    cu = mul_limbs(sq, n_limbs, plan.limbs_cu)
+    digits = extract_digit_list(plan, sq, plan.d_sq, plan.hw_sq)
+    digits += extract_digit_list(plan, cu, plan.d_cu, plan.hw_cu)
+    masks = [jnp.zeros_like(n_limbs[0]) for _ in range(plan.n_masks)]
+    masks = set_digit_masks(plan, masks, digits)
+    uniques = jax.lax.population_count(masks[0])
+    for m in masks[1:]:
+        uniques = uniques + jax.lax.population_count(m)
+    return uniques.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# Batch entry points (jitted per (base, batch_size))
+# --------------------------------------------------------------------------
+
+def _iota_lanes(plan: BasePlan, start_limbs, batch_size: int) -> list:
+    idx = jnp.arange(batch_size, dtype=U32)
+    base_limbs = [
+        jnp.broadcast_to(start_limbs[i], (batch_size,)) for i in range(plan.limbs_n)
+    ]
+    return add_u32(base_limbs, idx)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
+    """(histogram int32[base+2], near_miss_count int32) for one batch.
+
+    Lanes >= valid_count are masked into histogram bin 0 (real candidates
+    always have num_uniques >= 1).
+    """
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    uniques = num_uniques_lanes(plan, n)
+    lane = jnp.arange(batch_size, dtype=jnp.int32)
+    uniques = jnp.where(lane < valid_count, uniques, 0)
+    hist = jnp.bincount(uniques, length=plan.base + 2)
+    nm_count = jnp.sum((uniques > plan.near_miss_cutoff).astype(jnp.int32))
+    return hist, nm_count
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def uniques_batch(plan: BasePlan, batch_size: int, start_limbs):
+    """Per-lane num_uniques (rare-path extraction of near misses / nice)."""
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    return num_uniques_lanes(plan, n)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
+    """Count of fully nice lanes in a dense range batch."""
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    uniques = num_uniques_lanes(plan, n)
+    lane = jnp.arange(batch_size, dtype=jnp.int32)
+    valid = lane < valid_count
+    return jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))

@@ -1,0 +1,75 @@
+"""Benchmark runner: one JSON line for the driver.
+
+Runs the reference's extra-large benchmark (1e9 @ base 40, detailed mode —
+one production server field, BASELINE.md) end-to-end through the engine on
+the available accelerator and reports numbers/sec/chip.
+
+vs_baseline compares against the north-star per-chip target of 1.25e8
+numbers/sec/chip (BASELINE.json: 1e9 field in <1 s on a v5e-8, >50x the
+reference CUDA client).
+
+Env knobs:
+  NICE_BENCH_MODE   benchmark field (default: extra-large)
+  NICE_BENCH_BATCH  lanes per dispatch (default: 1<<24)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_NS_PER_CHIP = 1.25e8
+
+
+def main() -> int:
+    mode_name = os.environ.get("NICE_BENCH_MODE", "extra-large")
+    batch_size = int(os.environ.get("NICE_BENCH_BATCH", 1 << 24))
+
+    import jax
+
+    from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
+    from nice_tpu.ops import engine
+
+    n_chips = len(jax.devices())
+    data = get_benchmark_field(BenchmarkMode(mode_name))
+
+    # Warm-up compile on a small slice so the timed run measures throughput,
+    # not XLA compile time (same batch shape => cache hit).
+    from nice_tpu.core.types import FieldSize
+
+    warm = FieldSize(data.range_start, data.range_start + min(batch_size, 4096))
+    engine.process_range_detailed(
+        warm, data.base, backend="jax", batch_size=min(batch_size, 4096)
+    )
+    rng = data.to_field_size()
+    t0 = time.monotonic()
+    results = engine.process_range_detailed(
+        rng, data.base, backend="jax", batch_size=batch_size
+    )
+    elapsed = time.monotonic() - t0
+
+    total = sum(d.count for d in results.distribution)
+    assert total == data.range_size, (total, data.range_size)
+    value = data.range_size / elapsed / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": f"numbers/sec/chip detailed ({mode_name}, base {data.base})",
+                "value": round(value, 1),
+                "unit": "numbers/sec/chip",
+                "vs_baseline": round(value / BASELINE_NS_PER_CHIP, 3),
+                "elapsed_secs": round(elapsed, 3),
+                "range_size": data.range_size,
+                "n_chips": n_chips,
+                "near_misses": len(results.nice_numbers),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Search client: HTTP transport (L2) and the CLI binary (L4)."""

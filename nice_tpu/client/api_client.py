@@ -1,0 +1,127 @@
+"""Client HTTP transport: claim / submit / validate with retry + backoff.
+
+Stdlib-only (urllib) equivalent of the reference's reqwest wrappers
+(client_api_sync.rs:37-206): exponential backoff 2^attempt seconds, retrying
+network errors and 5xx responses; 4xx errors surface immediately with the
+server's message. A thread-pool async facade gives the overlap the reference
+gets from tokio (client_api_async.rs) without extra dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
+from nice_tpu.core.types import DataToClient, DataToServer, SearchMode, ValidationData
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_RETRIES = 10
+MAX_BACKOFF_SECS = 512
+
+
+class ApiError(Exception):
+    """Non-retryable API failure (4xx or exhausted retries)."""
+
+
+def _request_json(
+    url: str,
+    body: Optional[dict] = None,
+    timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
+) -> Any:
+    data = None
+    headers = {"Accept": "application/json"}
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else None
+
+
+def retry_request(
+    url: str,
+    body: Optional[dict] = None,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
+) -> Any:
+    """GET/POST with exponential backoff on 5xx and network errors."""
+    attempt = 0
+    while True:
+        try:
+            return _request_json(url, body, timeout)
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                detail = ""
+                try:
+                    detail = e.read().decode(errors="replace")
+                except Exception:
+                    pass
+                raise ApiError(f"HTTP {e.code} from {url}: {detail}") from e
+            err: Exception = e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            err = e
+        if attempt >= max_retries:
+            raise ApiError(f"request to {url} failed after {attempt} retries: {err}")
+        delay = min(2**attempt, MAX_BACKOFF_SECS)
+        log.warning("request failed (%s); retry %d in %ds", err, attempt + 1, delay)
+        time.sleep(delay)
+        attempt += 1
+
+
+def get_field_from_server(
+    mode: SearchMode, api_base: str, username: str, max_retries: int = DEFAULT_MAX_RETRIES
+) -> DataToClient:
+    """GET /claim/{detailed|niceonly} (reference client_api_sync.rs:104-129)."""
+    endpoint = "detailed" if mode == SearchMode.DETAILED else "niceonly"
+    url = f"{api_base}/claim/{endpoint}?username={urllib.request.quote(username)}"
+    return DataToClient.from_json(retry_request(url, max_retries=max_retries))
+
+
+def submit_field_to_server(
+    api_base: str, submit_data: DataToServer, max_retries: int = DEFAULT_MAX_RETRIES
+) -> None:
+    """POST /submit (reference client_api_sync.rs:144-172)."""
+    retry_request(f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries)
+
+
+def get_validation_data_from_server(
+    api_base: str, username: str, base: Optional[int] = None,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+) -> ValidationData:
+    """GET /claim/validate (reference client_api_sync.rs:188-206)."""
+    url = f"{api_base}/claim/validate?username={urllib.request.quote(username)}"
+    if base is not None:
+        url += f"&base={base}"
+    return ValidationData.from_json(retry_request(url, max_retries=max_retries))
+
+
+class AsyncApi:
+    """Thread-backed async facade so claim N+1 / submit N-1 overlap compute
+    (the reference's 3-stage tokio pipeline, client/src/main.rs:411-562)."""
+
+    def __init__(self, api_base: str, username: str, max_retries: int = DEFAULT_MAX_RETRIES):
+        self.api_base = api_base
+        self.username = username
+        self.max_retries = max_retries
+        self._pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="nice-api")
+
+    def claim_async(self, mode: SearchMode):
+        return self._pool.submit(
+            get_field_from_server, mode, self.api_base, self.username, self.max_retries
+        )
+
+    def submit_async(self, data: DataToServer):
+        return self._pool.submit(
+            submit_field_to_server, self.api_base, data, self.max_retries
+        )
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,308 @@
+"""nice-tpu search client CLI.
+
+The L4 binary: claim -> process -> submit against a coordination server, plus
+offline --benchmark and --validate modes. Mirrors the reference CLI surface
+(client/src/main.rs:64-116): every option is also settable via a NICE_* env
+var, CLI > env > default.
+
+Run modes (reference client/src/main.rs:295-562):
+  * single iteration: claim, process, submit
+  * --repeat: 3-stage pipeline — claim N+1 and submit N-1 overlap processing N
+  * --benchmark <mode>: offline timing on the built-in benchmark fields
+  * --validate: fetch a double-checked field + canonical results from the
+    server and diff a local recomputation against them
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+from nice_tpu import CLIENT_VERSION
+from nice_tpu.client import api_client
+from nice_tpu.core import number_stats
+from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
+from nice_tpu.core.types import (
+    DataToClient,
+    DataToServer,
+    FieldResults,
+    SearchMode,
+)
+from nice_tpu.ops import engine
+from nice_tpu.ops.stride_filter import get_stride_table
+
+log = logging.getLogger("nice_tpu.client")
+
+DEFAULT_LSD_K_VALUE = 2  # reference client/src/main.rs:19
+
+
+def _env(name: str, default):
+    return os.environ.get(f"NICE_{name}", default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nice-tpu-client",
+        description="Distributed search client for square-cube pandigitals (TPU-native)",
+    )
+    p.add_argument(
+        "mode",
+        nargs="?",
+        default=_env("MODE", "detailed"),
+        choices=["detailed", "niceonly"],
+        help="search mode (env NICE_MODE)",
+    )
+    p.add_argument(
+        "--api-base",
+        default=_env("API_BASE", "https://api.nicenumbers.net"),
+        help="API base URL (env NICE_API_BASE)",
+    )
+    p.add_argument(
+        "--username",
+        default=_env("USERNAME", "anonymous"),
+        help="username credited with submissions (env NICE_USERNAME)",
+    )
+    p.add_argument(
+        "--max-retries",
+        type=int,
+        default=int(_env("MAX_RETRIES", 10)),
+        help="HTTP retry ceiling (env NICE_MAX_RETRIES)",
+    )
+    p.add_argument(
+        "--repeat",
+        action="store_true",
+        default=bool(int(_env("REPEAT", 0))),
+        help="run forever with the 3-stage pipeline (env NICE_REPEAT)",
+    )
+    p.add_argument(
+        "--backend",
+        default=_env("BACKEND", "jax"),
+        choices=["jax", "scalar"],
+        help="compute backend (env NICE_BACKEND)",
+    )
+    p.add_argument(
+        "--batch-size",
+        type=int,
+        default=int(_env("BATCH_SIZE", engine.DEFAULT_BATCH_SIZE)),
+        help="device lanes per dispatch (env NICE_BATCH_SIZE)",
+    )
+    p.add_argument(
+        "--benchmark",
+        default=_env("BENCHMARK", None),
+        choices=[m.value for m in BenchmarkMode],
+        help="run an offline benchmark field instead of the server loop "
+        "(env NICE_BENCHMARK)",
+    )
+    p.add_argument(
+        "--validate",
+        action="store_true",
+        help="self-check against a canonical double-checked field",
+    )
+    p.add_argument(
+        "--base",
+        type=int,
+        default=None,
+        help="restrict --validate to a specific base",
+    )
+    p.add_argument(
+        "--log-level",
+        default=_env("LOG_LEVEL", "info"),
+        choices=["trace", "debug", "info", "warn", "error"],
+        help="log verbosity (env NICE_LOG_LEVEL)",
+    )
+    return p
+
+
+def process_field(
+    data: DataToClient, mode: SearchMode, backend: str, batch_size: int
+) -> tuple[FieldResults, float]:
+    """Process one field, returning results and elapsed seconds, logging the
+    reference's throughput line (client/src/main.rs:361-371)."""
+    t0 = time.monotonic()
+    rng = data.to_field_size()
+    if mode == SearchMode.DETAILED:
+        results = engine.process_range_detailed(
+            rng, data.base, backend=backend, batch_size=batch_size
+        )
+    else:
+        stride = get_stride_table(data.base, DEFAULT_LSD_K_VALUE)
+        results = engine.process_range_niceonly(
+            rng, data.base, stride_table=stride, backend=backend, batch_size=batch_size
+        )
+    elapsed = time.monotonic() - t0
+    rate = data.range_size / elapsed if elapsed > 0 else float("inf")
+    log.info(
+        "processed %s numbers in %.2fs (%s numbers/sec)",
+        f"{data.range_size:,}",
+        elapsed,
+        f"{rate:,.0f}",
+    )
+    return results, elapsed
+
+
+def compile_results(
+    data: DataToClient, results: FieldResults, mode: SearchMode, username: str
+) -> DataToServer:
+    """Build the submission payload (reference client/src/main.rs:212-254)."""
+    return DataToServer(
+        claim_id=data.claim_id,
+        username=username,
+        client_version=CLIENT_VERSION,
+        unique_distribution=(
+            list(results.distribution) if mode == SearchMode.DETAILED else None
+        ),
+        nice_numbers=list(results.nice_numbers),
+    )
+
+
+def run_benchmark(args) -> int:
+    mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
+    bench = BenchmarkMode(args.benchmark)
+    data = get_benchmark_field(bench)
+    log.info(
+        "benchmark %s: base %d, range [%d, %d) (%s numbers), mode %s, backend %s",
+        bench.value,
+        data.base,
+        data.range_start,
+        data.range_end,
+        f"{data.range_size:,}",
+        mode,
+        args.backend,
+    )
+    results, elapsed = process_field(data, mode, args.backend, args.batch_size)
+    nm_cutoff = number_stats.get_near_miss_cutoff(data.base)
+    summary = {
+        "benchmark": bench.value,
+        "base": data.base,
+        "range_size": data.range_size,
+        "mode": args.mode,
+        "backend": args.backend,
+        "elapsed_secs": round(elapsed, 4),
+        "numbers_per_sec": round(data.range_size / elapsed, 1),
+        "nice_count": sum(
+            1 for n in results.nice_numbers if n.num_uniques == data.base
+        ),
+        "near_miss_cutoff": nm_cutoff,
+        "near_misses": len(results.nice_numbers),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+def run_validate(args) -> int:
+    """Diff local recomputation against server-canonical results
+    (reference client/src/main.rs:256-292)."""
+    vdata = api_client.get_validation_data_from_server(
+        args.api_base, args.username, args.base, args.max_retries
+    )
+    log.info(
+        "validating field %d: base %d, range [%d, %d)",
+        vdata.field_id,
+        vdata.base,
+        vdata.range_start,
+        vdata.range_end,
+    )
+    data = DataToClient(
+        claim_id=0,
+        base=vdata.base,
+        range_start=vdata.range_start,
+        range_end=vdata.range_end,
+        range_size=vdata.range_size,
+    )
+    results, _ = process_field(data, SearchMode.DETAILED, args.backend, args.batch_size)
+    ok = True
+    canon_dist = {d.num_uniques: d.count for d in vdata.unique_distribution}
+    local_dist = {d.num_uniques: d.count for d in results.distribution}
+    if canon_dist != local_dist:
+        ok = False
+        for k in sorted(set(canon_dist) | set(local_dist)):
+            if canon_dist.get(k) != local_dist.get(k):
+                log.error(
+                    "distribution mismatch at %d uniques: canon=%s local=%s",
+                    k,
+                    canon_dist.get(k),
+                    local_dist.get(k),
+                )
+    canon_nums = {(n.number, n.num_uniques) for n in vdata.nice_numbers}
+    local_nums = {(n.number, n.num_uniques) for n in results.nice_numbers}
+    if canon_nums != local_nums:
+        ok = False
+        log.error(
+            "nice-number mismatch: only-canon=%s only-local=%s",
+            sorted(canon_nums - local_nums),
+            sorted(local_nums - canon_nums),
+        )
+    if ok:
+        log.info("validation passed: local results match canonical submission")
+        return 0
+    log.error("validation FAILED")
+    return 1
+
+
+def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+    data = api.claim_async(mode).result()
+    log.info(
+        "claimed field (claim %d): base %d, range [%d, %d)",
+        data.claim_id,
+        data.base,
+        data.range_start,
+        data.range_end,
+    )
+    results, _ = process_field(data, mode, args.backend, args.batch_size)
+    submission = compile_results(data, results, mode, args.username)
+    api.submit_async(submission).result()
+    log.info("submitted claim %d", data.claim_id)
+
+
+def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+    """claim N+1 || process N || submit N-1 (reference client/src/main.rs:411-562)."""
+    pending_submit = None
+    next_claim = api.claim_async(mode)
+    while True:
+        data = next_claim.result()
+        next_claim = api.claim_async(mode)  # overlap with processing
+        log.info(
+            "claimed field (claim %d): base %d, size %s",
+            data.claim_id,
+            data.base,
+            f"{data.range_size:,}",
+        )
+        results, _ = process_field(data, mode, args.backend, args.batch_size)
+        if pending_submit is not None:
+            pending_submit.result()  # surface any submit error before queueing next
+        submission = compile_results(data, results, mode, args.username)
+        pending_submit = api.submit_async(submission)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    level = {"trace": logging.DEBUG, "debug": logging.DEBUG, "info": logging.INFO,
+             "warn": logging.WARNING, "error": logging.ERROR}[args.log_level]
+    logging.basicConfig(
+        level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
+    )
+    if args.benchmark:
+        return run_benchmark(args)
+    if args.validate:
+        return run_validate(args)
+    mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
+    api = api_client.AsyncApi(args.api_base, args.username, args.max_retries)
+    try:
+        if args.repeat:
+            run_pipelined_loop(args, api, mode)
+        else:
+            run_single_iteration(args, api, mode)
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down")
+    finally:
+        api.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Idle-compute babysitter: runs clients when the machine is otherwise idle."""

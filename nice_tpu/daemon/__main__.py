@@ -1,0 +1,4 @@
+from nice_tpu.daemon.main import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,146 @@
+"""Idle-compute daemon.
+
+Watches system CPU usage (via /proc/stat — no external deps) and spawns a
+search client when the machine has been idle long enough, killing it when the
+machine gets busy and restarting it forever otherwise. Mirrors the reference
+daemon's CpuMonitor / ProcessManager split (daemon/src/main.rs:39-215).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+log = logging.getLogger("nice_tpu.daemon")
+
+
+def read_cpu_times() -> tuple[int, int]:
+    """(idle, total) jiffies from /proc/stat."""
+    with open("/proc/stat") as f:
+        parts = f.readline().split()
+    values = [int(v) for v in parts[1:]]
+    idle = values[3] + (values[4] if len(values) > 4 else 0)  # idle + iowait
+    return idle, sum(values)
+
+
+class CpuMonitor:
+    """Rolling CPU utilization sampler (reference daemon/src/main.rs:39-122)."""
+
+    def __init__(self, interval_secs: float = 5.0):
+        self.interval = interval_secs
+        self._last = read_cpu_times()
+
+    def sample(self) -> float:
+        """Blocking sample: CPU usage fraction over the interval."""
+        time.sleep(self.interval)
+        idle, total = read_cpu_times()
+        last_idle, last_total = self._last
+        self._last = (idle, total)
+        d_total = total - last_total
+        if d_total <= 0:
+            return 0.0
+        return 1.0 - (idle - last_idle) / d_total
+
+
+class ProcessManager:
+    """Spawns/stops/restarts the client (reference daemon/src/main.rs:124-215)."""
+
+    def __init__(self, client_args: list[str]):
+        self.client_args = client_args
+        self.proc: Optional[subprocess.Popen] = None
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def start(self) -> None:
+        if self.running():
+            return
+        cmd = [sys.executable, "-m", "nice_tpu.client", *self.client_args]
+        log.info("starting client: %s", " ".join(cmd))
+        self.proc = subprocess.Popen(cmd)
+
+    def stop(self) -> None:
+        if not self.running():
+            return
+        log.info("stopping client (pid %d)", self.proc.pid)
+        self.proc.send_signal(signal.SIGINT)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def reap(self) -> bool:
+        """True if the client exited since last check."""
+        if self.proc is not None and self.proc.poll() is not None:
+            log.info("client exited with code %s", self.proc.returncode)
+            self.proc = None
+            return True
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="nice-tpu-daemon")
+    p.add_argument(
+        "--min-cpu",
+        type=float,
+        default=float(os.environ.get("NICE_DAEMON_MIN_CPU", 0.3)),
+        help="spawn the client when usage stays below this fraction",
+    )
+    p.add_argument(
+        "--wait-time",
+        type=float,
+        default=float(os.environ.get("NICE_DAEMON_WAIT_TIME", 30)),
+        help="seconds of idleness required before spawning",
+    )
+    p.add_argument(
+        "--sample-interval", type=float, default=5.0, help="seconds per CPU sample"
+    )
+    p.add_argument("--log-level", default="info")
+    p.add_argument(
+        "client_args",
+        nargs="*",
+        default=["--repeat"],
+        help="arguments passed through to the client",
+    )
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    monitor = CpuMonitor(args.sample_interval)
+    manager = ProcessManager(args.client_args or ["--repeat"])
+    idle_since: Optional[float] = None
+
+    try:
+        while True:
+            usage = monitor.sample()
+            manager.reap()
+            if manager.running():
+                # While our client runs the CPU is busy by design; only stop it
+                # if something *else* is keeping the machine busy after a stop.
+                continue
+            if usage < args.min_cpu:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                if time.monotonic() - idle_since >= args.wait_time:
+                    manager.start()
+                    idle_since = None
+            else:
+                idle_since = None
+                log.debug("cpu busy (%.0f%%), holding off", usage * 100)
+    except KeyboardInterrupt:
+        log.info("interrupted; stopping client")
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+"""Scheduled batch jobs: consensus, analytics downsampling, cache refresh."""

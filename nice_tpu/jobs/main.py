@@ -1,0 +1,193 @@
+"""Scheduled batch jobs runner.
+
+Per base (reference jobs/src/main.rs:15-254):
+  1. consensus pass — for every field with detailed submissions, group
+     identical results, promote the majority group's earliest submission to
+     canon, set check_level = group size + 1 (reset to <=1 when no
+     submissions remain)
+  2. downsampling pass — per-chunk and per-base checked counts / minimum
+     check level; distribution + top-10k numbers + niceness mean/stdev only
+     when > 20% of the chunk is detailed-checked
+  3. refresh leaderboard / search-rate caches
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+from nice_tpu.core import consensus, distribution_stats, number_stats
+from nice_tpu.core.constants import DOWNSAMPLE_CUTOFF_PERCENT
+from nice_tpu.core.types import SubmissionRecord
+from nice_tpu.server.db import Db, pad
+
+log = logging.getLogger("nice_tpu.jobs")
+
+
+def run_consensus_for_base(db: Db, base: int) -> int:
+    """Returns the number of fields whose canon/check_level changed."""
+    changed = 0
+    for field in db.get_fields_with_detailed_submissions(base):
+        submissions = db.get_detailed_submissions_by_field(field.field_id)
+        canon, check_level = consensus.evaluate_consensus(field, submissions)
+        if canon is None:
+            if field.canon_submission_id is not None or field.check_level > 1:
+                log.warning(
+                    "field %d claimed checked (sub %s, CL%d) but has no"
+                    " submissions; reset to CL%d",
+                    field.field_id,
+                    field.canon_submission_id,
+                    field.check_level,
+                    check_level,
+                )
+                db.update_field_canon_and_cl(field.field_id, None, check_level)
+                changed += 1
+        elif (
+            field.canon_submission_id != canon.submission_id
+            or field.check_level != check_level
+        ):
+            db.update_field_canon_and_cl(
+                field.field_id, canon.submission_id, check_level
+            )
+            changed += 1
+    return changed
+
+
+def _chunk_stats(db: Db, base: int) -> dict[int, tuple[int, int, int]]:
+    """chunk_id -> (minimum_cl, checked_niceonly, checked_detailed):
+    niceonly counts fields at CL>=1, detailed at CL>=2 (reference
+    db_util/fields.rs:780-802)."""
+    stats: dict[int, tuple[int, int, int]] = {}
+    for field in db.get_fields_in_base(base):
+        if field.chunk_id is None:
+            continue
+        min_cl, nice, det = stats.get(field.chunk_id, (255, 0, 0))
+        min_cl = min(min_cl, field.check_level)
+        if field.check_level >= 1:
+            nice += field.range_size
+        if field.check_level >= 2:
+            det += field.range_size
+        stats[field.chunk_id] = (min_cl, nice, det)
+    return stats
+
+
+def _canon_submissions(db: Db, base: int) -> list[tuple[SubmissionRecord, int]]:
+    """(canon submission, chunk_id) for every field with one."""
+    out = []
+    for field in db.get_fields_in_base(base):
+        if field.canon_submission_id is not None:
+            try:
+                sub = db.get_submission_by_id(field.canon_submission_id)
+            except KeyError:
+                continue
+            out.append((sub, field.chunk_id))
+    return out
+
+
+def run_downsampling_for_base(db: Db, base: int) -> None:
+    stats = _chunk_stats(db, base)
+    canon = _canon_submissions(db, base)
+    subs_by_chunk: dict[int, list[SubmissionRecord]] = {}
+    all_subs: list[SubmissionRecord] = []
+    for sub, chunk_id in canon:
+        all_subs.append(sub)
+        if chunk_id is not None:
+            subs_by_chunk.setdefault(chunk_id, []).append(sub)
+
+    base_checked_niceonly = 0
+    base_checked_detailed = 0
+    base_minimum_cl = 255
+
+    for chunk in db.get_chunks_in_base(base):
+        chunk_id = chunk["id"]
+        chunk_size = int(chunk["range_size"])
+        min_cl, checked_niceonly, checked_detailed = stats.get(chunk_id, (0, 0, 0))
+        pct_detailed = checked_detailed / chunk_size if chunk_size else 0.0
+        cols = {
+            "checked_niceonly": pad(checked_niceonly),
+            "checked_detailed": pad(checked_detailed),
+            "minimum_cl": min_cl,
+        }
+        if pct_detailed > DOWNSAMPLE_CUTOFF_PERCENT:
+            subs = subs_by_chunk.get(chunk_id, [])
+            dist = distribution_stats.downsample_distributions(subs, base)
+            numbers = number_stats.downsample_numbers(subs)
+            mean, stdev = distribution_stats.mean_stdev_from_distribution(dist)
+            cols.update(
+                distribution=json.dumps([d.__dict__ for d in dist]),
+                numbers=json.dumps(
+                    [{**n.__dict__, "number": str(n.number)} for n in numbers]
+                ),
+                niceness_mean=mean,
+                niceness_stdev=stdev,
+            )
+        else:
+            cols.update(
+                distribution="[]", numbers="[]",
+                niceness_mean=None, niceness_stdev=None,
+            )
+        db.update_chunk_stats(chunk_id, **cols)
+        base_checked_niceonly += checked_niceonly
+        base_checked_detailed += checked_detailed
+        base_minimum_cl = min(base_minimum_cl, min_cl)
+
+    from nice_tpu.core import base_range
+
+    br = base_range.get_base_range(base)
+    base_size = (br[1] - br[0]) if br else 0
+    pct_detailed = base_checked_detailed / base_size if base_size else 0.0
+    cols = {
+        "checked_niceonly": pad(base_checked_niceonly),
+        "checked_detailed": pad(base_checked_detailed),
+        "minimum_cl": base_minimum_cl,
+    }
+    if pct_detailed > DOWNSAMPLE_CUTOFF_PERCENT:
+        dist = distribution_stats.downsample_distributions(all_subs, base)
+        numbers = number_stats.downsample_numbers(all_subs)
+        mean, stdev = distribution_stats.mean_stdev_from_distribution(dist)
+        cols.update(
+            distribution=json.dumps([d.__dict__ for d in dist]),
+            numbers=json.dumps(
+                [{**n.__dict__, "number": str(n.number)} for n in numbers]
+            ),
+            niceness_mean=mean,
+            niceness_stdev=stdev,
+        )
+    else:
+        cols.update(
+            distribution="[]", numbers="[]",
+            niceness_mean=None, niceness_stdev=None,
+        )
+    db.update_base_stats(base, **cols)
+
+
+def run_all(db: Db) -> None:
+    for base in db.get_bases():
+        log.info("=== BASE %d CONSENSUS ===", base)
+        changed = run_consensus_for_base(db, base)
+        log.info("consensus updated %d fields", changed)
+        log.info("=== BASE %d DOWNSAMPLING ===", base)
+        run_downsampling_for_base(db, base)
+    log.info("=== REFRESHING SEARCH CACHES ===")
+    db.refresh_search_caches()
+    log.info("search caches refreshed")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="nice-tpu-jobs")
+    p.add_argument("--db", default="nice.db")
+    p.add_argument("--log-level", default="info")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    db = Db(args.db)
+    run_all(db)
+    db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,8 @@ oracle per sub-range.
 
 from __future__ import annotations
 
+from collections import deque
+
 import numpy as np
 
 from nice_tpu.core import base_range
@@ -32,6 +34,10 @@ from nice_tpu.ops import vector_engine as ve
 # Default lanes per device batch. Large enough to amortize dispatch, small
 # enough to keep intermediates comfortably in HBM.
 DEFAULT_BATCH_SIZE = 1 << 18
+
+# Max batches in flight during pipelined dispatch: bounds live device buffers
+# (and the runtime queue) so arbitrarily large fields run in constant memory.
+DISPATCH_WINDOW = 32
 
 
 def _clamp_to_base_range(range_: FieldSize, base: int):
@@ -86,20 +92,22 @@ def process_range_detailed(
             hist[d.num_uniques] += d.count
         nice_numbers.extend(sub.nice_numbers)
 
+    # Dispatch batches asynchronously ahead of collection (the device queue
+    # executes in order while the host keeps dispatching — the reference's
+    # overlapped launch pipeline, client_process_gpu.rs:667-682). The window
+    # bounds in-flight device buffers so arbitrarily large fields run in
+    # constant memory.
     start = core.start()
     total = core.size()
-    done = 0
-    while done < total:
-        valid = min(batch_size, total - done)
-        batch_start = start + done
-        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-        bh, nm = ve.detailed_batch(
-            plan, batch_size, start_limbs, np.int32(valid)
-        )
+    pending: deque = deque()
+
+    def collect_one():
+        batch_start, valid, start_limbs, bh, nm = pending.popleft()
         bh = np.asarray(bh, dtype=np.int64)
         bh[0] -= batch_size - valid  # remove tail-padding lanes from bin 0
-        hist += bh
+        np.add(hist, bh, out=hist)
         if int(nm) > 0:
+            # Rare path: re-derive per-lane uniques for this batch only.
             uniques = np.asarray(ve.uniques_batch(plan, batch_size, start_limbs))
             idxs = np.nonzero(uniques[:valid] > plan.near_miss_cutoff)[0]
             for i in idxs.tolist():
@@ -108,7 +116,19 @@ def process_range_detailed(
                         number=batch_start + i, num_uniques=int(uniques[i])
                     )
                 )
+
+    done = 0
+    while done < total:
+        valid = min(batch_size, total - done)
+        batch_start = start + done
+        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
+        bh, nm = ve.detailed_batch(plan, batch_size, start_limbs, np.int32(valid))
+        pending.append((batch_start, valid, start_limbs, bh, nm))
+        if len(pending) >= DISPATCH_WINDOW:
+            collect_one()
         done += valid
+    while pending:
+        collect_one()
 
     nice_numbers.sort(key=lambda n: n.number)
     distribution = tuple(
@@ -148,6 +168,17 @@ def process_range_niceonly(
         nice_numbers.extend(sub.nice_numbers)
 
     plan = get_plan(base)
+    pending: deque = deque()
+
+    def collect_one():
+        batch_start, valid, start_limbs, count = pending.popleft()
+        if int(count) > 0:
+            uniques = np.asarray(ve.uniques_batch(plan, batch_size, start_limbs))
+            for i in np.nonzero(uniques[:valid] == base)[0].tolist():
+                nice_numbers.append(
+                    NiceNumberSimple(number=batch_start + i, num_uniques=base)
+                )
+
     for sub_range in msd_filter.get_valid_ranges(core, base):
         start = sub_range.start()
         total = sub_range.size()
@@ -156,20 +187,15 @@ def process_range_niceonly(
             valid = min(batch_size, total - done)
             batch_start = start + done
             start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-            count = int(
-                ve.niceonly_dense_batch(
-                    plan, batch_size, start_limbs, np.int32(valid)
-                )
+            count = ve.niceonly_dense_batch(
+                plan, batch_size, start_limbs, np.int32(valid)
             )
-            if count > 0:
-                uniques = np.asarray(
-                    ve.uniques_batch(plan, batch_size, start_limbs)
-                )
-                for i in np.nonzero(uniques[:valid] == base)[0].tolist():
-                    nice_numbers.append(
-                        NiceNumberSimple(number=batch_start + i, num_uniques=base)
-                    )
+            pending.append((batch_start, valid, start_limbs, count))
+            if len(pending) >= DISPATCH_WINDOW:
+                collect_one()
             done += valid
+    while pending:
+        collect_one()
 
     nice_numbers.sort(key=lambda n: n.number)
     return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))

@@ -189,6 +189,30 @@ def _iota_lanes(plan: BasePlan, start_limbs, batch_size: int) -> list:
     return add_u32(base_limbs, idx)
 
 
+def histogram_lanes(plan: BasePlan, uniques, valid):
+    """Exact histogram of num_uniques via one-hot accumulation. Scatter-adds
+    (jnp.bincount) serialize on TPU; a lane-aligned one-hot reduction stays on
+    the VPU (the analog of the reference kernel's per-warp shared-memory
+    histograms, nice_kernels.cu:496-530). Invalid lanes count into bin 0."""
+    u = jnp.where(valid, uniques, 0)
+    bins = jnp.arange(plan.base + 2, dtype=jnp.int32)
+    cols = 128 if u.size % 128 == 0 else 1  # lane-aligned when possible
+    u2 = u.reshape(-1, cols)
+    onehot = (u2[:, :, None] == bins[None, None, :]).astype(jnp.int32)
+    return jnp.sum(onehot, axis=(0, 1))
+
+
+def detailed_from_uniques(plan: BasePlan, uniques, valid):
+    """Shared tail of the detailed step: (histogram, near_miss_count).
+    Used by both the single-chip batch and the sharded per-device step so the
+    masking/near-miss semantics cannot diverge."""
+    hist = histogram_lanes(plan, uniques, valid)
+    nm_count = jnp.sum(
+        (valid & (uniques > plan.near_miss_cutoff)).astype(jnp.int32)
+    )
+    return hist, nm_count
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
     """(histogram int32[base+2], near_miss_count int32) for one batch.
@@ -199,10 +223,7 @@ def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
     n = _iota_lanes(plan, start_limbs, batch_size)
     uniques = num_uniques_lanes(plan, n)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
-    uniques = jnp.where(lane < valid_count, uniques, 0)
-    hist = jnp.bincount(uniques, length=plan.base + 2)
-    nm_count = jnp.sum((uniques > plan.near_miss_cutoff).astype(jnp.int32))
-    return hist, nm_count
+    return detailed_from_uniques(plan, uniques, lane < valid_count)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))

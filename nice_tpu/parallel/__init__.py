@@ -1,0 +1,1 @@
+"""Multi-chip scaling: device mesh, shard_map field processing, ICI collectives."""

@@ -1,0 +1,101 @@
+"""Device mesh and sharded field processing.
+
+The system's one long axis is the number line (reference SURVEY.md section 5:
+base range -> chunks -> fields -> processing chunks -> lanes). Multi-chip
+scaling is sequence-parallelism over that axis: a field batch is sharded
+across the mesh's "field" axis, every device derives its candidates from its
+axis index (zero input transfer), and the per-device digit-histograms are
+reduced with a psum over ICI (the TPU analog of the reference's warp -> block
+-> global -> host reduction chain, nice_kernels.cu:496-530 / P8).
+
+The control plane (HTTP checkout/submit) stays on DCN, exactly as the
+reference keeps its coordination on HTTP while compute scales on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nice_tpu.ops import vector_engine as ve
+from nice_tpu.ops.limbs import BasePlan
+
+FIELD_AXIS = "field"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or given) devices; the axis shards the number line."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (FIELD_AXIS,))
+
+
+from nice_tpu.ops.vector_engine import histogram_lanes  # re-export (shared)
+
+
+def make_sharded_detailed_step(plan: BasePlan, per_device_batch: int, mesh: Mesh):
+    """Jitted multi-chip detailed step.
+
+    Each device processes per_device_batch consecutive candidates starting at
+    start + axis_index * per_device_batch; histograms are psum-reduced over
+    ICI so every device returns the full-field histogram.
+
+    Returns fn(start_limbs u32[limbs_n], valid_count i32) ->
+    (histogram i32[base+2], near_miss_count i32), both replicated.
+    """
+
+    def device_step(start_limbs, valid_count):
+        dev = jax.lax.axis_index(FIELD_AXIS)
+        offset = dev.astype(jnp.uint32) * np.uint32(per_device_batch)
+        idx = jnp.arange(per_device_batch, dtype=jnp.uint32) + offset
+        base_limbs = [
+            jnp.broadcast_to(start_limbs[i], (per_device_batch,))
+            for i in range(plan.limbs_n)
+        ]
+        n = ve.add_u32(base_limbs, idx)
+        uniques = ve.num_uniques_lanes(plan, n)
+        valid = idx.astype(jnp.int32) < valid_count
+        hist, nm = ve.detailed_from_uniques(plan, uniques, valid)
+        hist = jax.lax.psum(hist, FIELD_AXIS)
+        nm = jax.lax.psum(nm, FIELD_AXIS)
+        return hist, nm
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_sharded_niceonly_step(plan: BasePlan, per_device_batch: int, mesh: Mesh):
+    """Jitted multi-chip niceonly (dense) step: psum'd count of fully nice
+    lanes across the mesh."""
+
+    def device_step(start_limbs, valid_count):
+        dev = jax.lax.axis_index(FIELD_AXIS)
+        offset = dev.astype(jnp.uint32) * np.uint32(per_device_batch)
+        idx = jnp.arange(per_device_batch, dtype=jnp.uint32) + offset
+        base_limbs = [
+            jnp.broadcast_to(start_limbs[i], (per_device_batch,))
+            for i in range(plan.limbs_n)
+        ]
+        n = ve.add_u32(base_limbs, idx)
+        uniques = ve.num_uniques_lanes(plan, n)
+        valid = idx.astype(jnp.int32) < valid_count
+        count = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
+        return jax.lax.psum(count, FIELD_AXIS)
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,1 @@
+"""Coordination server (L3+L4): field ledger DB, claim engine, HTTP API."""

@@ -1,0 +1,396 @@
+"""Coordination HTTP API server.
+
+Stdlib ThreadingHTTPServer equivalent of the reference's Rocket app
+(api/src/main.rs): claim endpoints with the 80/15/4/1 detailed strategy mix,
+in-memory pre-claim queues, submit-side verification that recomputes every
+submitted number with the trusted engine, /status queue depths, and a
+Prometheus /metrics exporter with per-endpoint request timing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from nice_tpu.core import distribution_stats, number_stats
+from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
+from nice_tpu.core.types import (
+    DataToClient,
+    DataToServer,
+    FieldClaimStrategy,
+    SearchMode,
+)
+from nice_tpu.ops import scalar
+from nice_tpu.server.db import Db
+from nice_tpu.server.field_queue import U128_MAX, FieldQueue
+
+log = logging.getLogger("nice_tpu.server")
+
+
+class Metrics:
+    """Per-endpoint request counters and latency sums (Prometheus text)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, int], int] = {}
+        self._time_sums: dict[str, float] = {}
+
+    def record(self, endpoint: str, status: int, elapsed: float) -> None:
+        with self._lock:
+            self._counts[(endpoint, status)] = (
+                self._counts.get((endpoint, status), 0) + 1
+            )
+            self._time_sums[endpoint] = self._time_sums.get(endpoint, 0.0) + elapsed
+
+    def render(self) -> str:
+        lines = [
+            "# HELP nice_api_requests_total Requests by endpoint and status.",
+            "# TYPE nice_api_requests_total counter",
+        ]
+        with self._lock:
+            for (endpoint, status), count in sorted(self._counts.items()):
+                lines.append(
+                    f'nice_api_requests_total{{endpoint="{endpoint}",'
+                    f'status="{status}"}} {count}'
+                )
+            lines.append(
+                "# HELP nice_api_request_seconds_total Cumulative request time."
+            )
+            lines.append("# TYPE nice_api_request_seconds_total counter")
+            for endpoint, total in sorted(self._time_sums.items()):
+                lines.append(
+                    f'nice_api_request_seconds_total{{endpoint="{endpoint}"}}'
+                    f" {total:.6f}"
+                )
+        return "\n".join(lines) + "\n"
+
+
+class ApiContext:
+    def __init__(self, db: Db):
+        self.db = db
+        self.queue = FieldQueue(db)
+        self.metrics = Metrics()
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> DataToClient:
+    """Claim-strategy mix + queue fast path (reference api/src/main.rs:66-229)."""
+    if search_mode == SearchMode.NICEONLY:
+        claim_strategy, max_check_level, max_range_size = (
+            FieldClaimStrategy.NEXT, 0, U128_MAX,
+        )
+    else:
+        roll = random.randint(1, 100)
+        if roll <= 80:
+            claim_strategy, max_check_level = FieldClaimStrategy.THIN, 1
+        elif roll <= 95:
+            claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 1
+        elif roll <= 99:
+            claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 2
+        else:
+            claim_strategy, max_check_level = FieldClaimStrategy.RANDOM, 1
+        max_range_size = DETAILED_SEARCH_MAX_FIELD_SIZE
+
+    field = None
+    if search_mode == SearchMode.NICEONLY:
+        field = ctx.queue.claim_niceonly()
+        if field is None:
+            log.warning("niceonly queue exhausted; direct database claim")
+            field = ctx.db.try_claim_field(
+                FieldClaimStrategy.NEXT, ctx.db.claim_expiry_cutoff(), 0, max_range_size
+            )
+    elif claim_strategy == FieldClaimStrategy.THIN:
+        field = ctx.queue.claim_detailed_thin()
+
+    if field is None:
+        field = ctx.db.try_claim_field(
+            claim_strategy, ctx.db.claim_expiry_cutoff(), max_check_level, max_range_size
+        )
+    if field is None:
+        # Everything is recently claimed: fall back to possibly-active fields
+        # (reference api/src/main.rs:150-168).
+        from nice_tpu.server.db import now_utc
+
+        field = ctx.db.try_claim_field(
+            FieldClaimStrategy.NEXT, now_utc(), max_check_level, max_range_size
+        )
+    if field is None:
+        raise ApiError(
+            500,
+            f"Could not find any field with maximum check level {max_check_level}"
+            f" and maximum size {max_range_size}!",
+        )
+
+    claim = ctx.db.insert_claim(field.field_id, search_mode, user_ip)
+    log.info(
+        "New Claim: mode=%s strategy=%s field=%d claim=%d",
+        search_mode,
+        claim_strategy.value,
+        field.field_id,
+        claim.claim_id,
+    )
+    return DataToClient(
+        claim_id=claim.claim_id,
+        base=field.base,
+        range_start=field.range_start,
+        range_end=field.range_end,
+        range_size=field.range_size,
+    )
+
+
+def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+    """Verify + persist a submission (reference api/src/main.rs:241-404)."""
+    data = DataToServer.from_json(payload)
+    try:
+        claim = ctx.db.get_claim_by_id(data.claim_id)
+    except KeyError as e:
+        raise ApiError(400, f"Invalid claim_id {data.claim_id}: {e}")
+    field = ctx.db.get_field_by_id(claim.field_id)
+    base = field.base
+    numbers_expanded = number_stats.expand_numbers(data.nice_numbers, base)
+
+    if claim.search_mode == SearchMode.NICEONLY:
+        # Honor system: no verification (reference api/src/main.rs:278-300).
+        ctx.db.insert_submission(
+            claim, data.username, data.client_version, user_ip, None, numbers_expanded
+        )
+        if field.check_level == 0:
+            ctx.db.update_field_canon_and_cl(
+                field.field_id, field.canon_submission_id, 1
+            )
+    else:
+        if data.unique_distribution is None:
+            raise ApiError(
+                422, "Unique distribution must be present for detailed searches."
+            )
+        distribution = data.unique_distribution
+        distribution_expanded = distribution_stats.expand_distribution(
+            distribution, base
+        )
+        dist_total = sum(d.count for d in distribution)
+        if dist_total != field.range_size:
+            raise ApiError(
+                422,
+                f"Total distribution count is incorrect (submitted {dist_total},"
+                f" range was {field.range_size}).",
+            )
+        cutoff = number_stats.get_near_miss_cutoff(base)
+        for d in distribution_expanded:
+            if d.num_uniques > cutoff:
+                count_numbers = sum(
+                    1 for n in numbers_expanded if n.num_uniques == d.num_uniques
+                )
+                if count_numbers != d.count:
+                    raise ApiError(
+                        422,
+                        f"Count of nice numbers with {d.num_uniques} uniques does"
+                        f" not match distribution (submitted {count_numbers},"
+                        f" distribution claimed {d.count}).",
+                    )
+        above_cutoff = sum(d.count for d in distribution if d.num_uniques > cutoff)
+        if len(numbers_expanded) != above_cutoff:
+            raise ApiError(
+                422,
+                f"Count of nice numbers does not match distribution (submitted"
+                f" {len(numbers_expanded)}, distribution claimed {above_cutoff}).",
+            )
+        # Server-side recomputation of every submitted number with the trusted
+        # engine (reference api/src/main.rs:350-359).
+        for n in numbers_expanded:
+            calculated = scalar.get_num_unique_digits(n.number, base)
+            if calculated != n.num_uniques:
+                raise ApiError(
+                    422,
+                    f"Unique count for {n.number} is incorrect (submitted as"
+                    f" {n.num_uniques}, server calculated {calculated}).",
+                )
+        ctx.db.insert_submission(
+            claim,
+            data.username,
+            data.client_version,
+            user_ip,
+            distribution_expanded,
+            numbers_expanded,
+        )
+        if field.check_level < 2:
+            ctx.db.update_field_canon_and_cl(
+                field.field_id, field.canon_submission_id, 2
+            )
+
+    log.info(
+        "New Submission: mode=%s field=%d claim=%d username=%s",
+        claim.search_mode,
+        claim.field_id,
+        claim.claim_id,
+        data.username,
+    )
+    return {"status": "OK"}
+
+
+NOT_FOUND_MESSAGE = (
+    "The requested resource could not be found. Available resources include"
+    " /claim/detailed, /claim/niceonly, /claim/validate, and /submit."
+)
+
+
+def make_handler(ctx: ApiContext):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging
+            log.debug("%s " + fmt, self.address_string(), *args)
+
+        def _send(self, status: int, body: dict | str, content_type="application/json"):
+            raw = (
+                json.dumps(body).encode()
+                if not isinstance(body, str)
+                else body.encode()
+            )
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(raw)))
+            # CORS fairing parity (reference helpers.rs:95-126)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
+            self.send_header("Access-Control-Allow-Headers", "Content-Type")
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _error(self, status: int, message: str):
+            self._send(status, {"error": {"code": status, "message": message}})
+
+        def _route(self, method: str):
+            t0 = time.monotonic()
+            path = urlparse(self.path).path.rstrip("/")
+            endpoint = path or "/"
+            status = 200
+            try:
+                user_ip = self.client_address[0]
+                if method == "OPTIONS":
+                    self.send_response(204)
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    self.send_header(
+                        "Access-Control-Allow-Methods", "GET, POST, OPTIONS"
+                    )
+                    self.send_header("Access-Control-Allow-Headers", "Content-Type")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if method == "GET" and path == "/claim/detailed":
+                    self._send(
+                        200, claim_helper(ctx, SearchMode.DETAILED, user_ip).to_json()
+                    )
+                elif method == "GET" and path == "/claim/niceonly":
+                    self._send(
+                        200, claim_helper(ctx, SearchMode.NICEONLY, user_ip).to_json()
+                    )
+                elif method == "GET" and path == "/claim/validate":
+                    self._send(200, ctx.db.get_validation_field().to_json())
+                elif method == "GET" and path == "/status":
+                    self._send(
+                        200,
+                        {
+                            "status": "ok",
+                            "niceonly_queue_size": ctx.queue.niceonly_queue_size(),
+                            "detailed_thin_queue_size": ctx.queue.detailed_thin_queue_size(),
+                        },
+                    )
+                elif method == "GET" and path == "/metrics":
+                    self._send(
+                        200, ctx.metrics.render(), content_type="text/plain"
+                    )
+                elif method == "POST" and path == "/submit":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError as e:
+                        raise ApiError(400, f"Invalid JSON body: {e}")
+                    self._send(200, handle_submit(ctx, payload, user_ip))
+                else:
+                    status = 404
+                    self._error(404, NOT_FOUND_MESSAGE)
+            except ApiError as e:
+                status = e.status
+                self._error(e.status, e.message)
+            except Exception as e:  # 500 with JSON body, never a stack dump
+                status = 500
+                log.exception("internal error handling %s %s", method, path)
+                self._error(500, f"Internal server error: {e}")
+            finally:
+                ctx.metrics.record(endpoint, status, time.monotonic() - t0)
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_OPTIONS(self):
+            self._route("OPTIONS")
+
+    return Handler
+
+
+def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True):
+    db = Db(db_path)
+    ctx = ApiContext(db)
+    if prefill:
+        ctx.queue.refill_niceonly()
+        ctx.queue.refill_detailed_thin()
+    server = ThreadingHTTPServer((host, port), make_handler(ctx))
+    log.info("nice-tpu API listening on %s:%d (db=%s)", host, port, db_path)
+    return server
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="nice-tpu-server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8127)
+    p.add_argument("--db", default="nice.db", help="sqlite database path")
+    p.add_argument(
+        "--init-base",
+        type=int,
+        action="append",
+        default=None,
+        help="seed fields for a base then continue serving (repeatable)",
+    )
+    p.add_argument(
+        "--field-size",
+        type=int,
+        default=1_000_000_000,
+        help="field width when seeding bases",
+    )
+    p.add_argument("--log-level", default="info")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if args.init_base:
+        db = Db(args.db)
+        for base in args.init_base:
+            n = db.seed_base(base, args.field_size)
+            log.info("seeded base %d with %d fields", base, n)
+        db.close()
+    server = serve(args.db, args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,645 @@
+"""Field-ledger data access layer and atomic claim engine.
+
+SQLite-backed (stdlib) equivalent of the reference's Diesel/Postgres layer
+(common/src/db_util/*). The SQL stays engine-portable; u128 quantities are
+stored as 40-char zero-padded decimal TEXT (lexicographic == numeric order),
+timestamps as ISO-8601 UTC TEXT.
+
+Atomicity: the reference relies on single-statement `FOR UPDATE SKIP LOCKED`
+claims (db_util/fields.rs:204-536). SQLite has a single writer, so the same
+guarantee comes from running each claim as one `BEGIN IMMEDIATE` transaction
+under a process-level lock; the claim-strategy semantics (Next / Random-pivot
+with wraparound / Thin under-explored chunk, expired-lease predicate,
+check_level = 0 special case) are preserved exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sqlite3
+import threading
+from datetime import datetime, timedelta, timezone
+from typing import Optional
+
+from nice_tpu.core import base_range, generate_chunks, generate_fields
+from nice_tpu.core.constants import CLAIM_DURATION_HOURS, DOWNSAMPLE_CUTOFF_PERCENT
+from nice_tpu.core.types import (
+    ClaimRecord,
+    FieldClaimStrategy,
+    FieldRecord,
+    NiceNumber,
+    SearchMode,
+    SubmissionRecord,
+    UniquesDistribution,
+    ValidationData,
+)
+
+U128_WIDTH = 40  # fits 2^128-1 (39 digits) with margin
+
+
+def pad(x: int) -> str:
+    """u128 -> fixed-width decimal TEXT preserving order."""
+    if x < 0:
+        raise ValueError("negative value in u128 column")
+    s = str(x)
+    if len(s) > U128_WIDTH:
+        raise ValueError(f"{x} too wide for u128 column")
+    return s.zfill(U128_WIDTH)
+
+
+def unpad(s: str) -> int:
+    return int(s)
+
+
+def ts(dt: datetime) -> str:
+    return dt.astimezone(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def parse_ts(s: Optional[str]) -> Optional[datetime]:
+    if not s:
+        return None
+    return datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ").replace(tzinfo=timezone.utc)
+
+
+def now_utc() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _dist_to_json(dist: Optional[list[UniquesDistribution]]) -> Optional[str]:
+    if dist is None:
+        return None
+    return json.dumps(
+        [
+            {
+                "num_uniques": d.num_uniques,
+                "count": d.count,
+                "niceness": d.niceness,
+                "density": d.density,
+            }
+            for d in dist
+        ]
+    )
+
+
+def _dist_from_json(s: Optional[str]) -> Optional[list[UniquesDistribution]]:
+    if s is None:
+        return None
+    return [
+        UniquesDistribution(
+            num_uniques=int(d["num_uniques"]),
+            count=int(d["count"]),
+            niceness=float(d["niceness"]),
+            density=float(d["density"]),
+        )
+        for d in json.loads(s)
+    ]
+
+
+def _numbers_to_json(numbers: list[NiceNumber]) -> str:
+    return json.dumps(
+        [
+            {
+                "number": str(n.number),
+                "num_uniques": n.num_uniques,
+                "base": n.base,
+                "niceness": n.niceness,
+            }
+            for n in numbers
+        ]
+    )
+
+
+def _numbers_from_json(s: str) -> list[NiceNumber]:
+    return [
+        NiceNumber(
+            number=int(n["number"]),
+            num_uniques=int(n["num_uniques"]),
+            base=int(n["base"]),
+            niceness=float(n["niceness"]),
+        )
+        for n in json.loads(s)
+    ]
+
+
+class Db:
+    """Thread-safe ledger handle (one connection, process-level write lock)."""
+
+    def __init__(self, path: str = None):
+        self.path = path or os.environ.get("NICE_DATABASE_PATH", "nice.db")
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            self.path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self.init_schema()
+
+    def init_schema(self) -> None:
+        schema_path = os.path.join(os.path.dirname(__file__), "schema.sql")
+        with open(schema_path) as f:
+            with self._lock:
+                self._conn.executescript(f.read())
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- seeding ----------------------------------------------------------
+
+    def seed_base(self, base: int, field_size: int = 1_000_000_000) -> int:
+        """Create the base row, fields, and chunks for a base (the reference's
+        insert_new_fields / generate_fields / generate_chunks flow). Returns
+        the number of fields created."""
+        br = base_range.get_base_range(base)
+        if br is None:
+            raise ValueError(f"base {base} has no valid range")
+        fields = generate_fields.break_range_into_fields(br[0], br[1], field_size)
+        chunks = generate_chunks.group_fields_into_chunks(list(fields))
+        with self._lock, self._txn():
+            self._conn.execute(
+                "INSERT OR REPLACE INTO bases (id, range_start, range_end, range_size)"
+                " VALUES (?, ?, ?, ?)",
+                (base, pad(br[0]), pad(br[1]), pad(br[1] - br[0])),
+            )
+            chunk_ids = []
+            for c in chunks:
+                cur = self._conn.execute(
+                    "INSERT INTO chunks (base_id, range_start, range_end, range_size)"
+                    " VALUES (?, ?, ?, ?)",
+                    (base, pad(c.range_start), pad(c.range_end), pad(c.size())),
+                )
+                chunk_ids.append((cur.lastrowid, c))
+            rows = []
+            for f in fields:
+                chunk_id = next(
+                    cid
+                    for cid, c in chunk_ids
+                    if c.range_start <= f.range_start < c.range_end
+                )
+                rows.append(
+                    (base, chunk_id, pad(f.range_start), pad(f.range_end), pad(f.size()))
+                )
+            self._conn.executemany(
+                "INSERT INTO fields (base_id, chunk_id, range_start, range_end,"
+                " range_size) VALUES (?, ?, ?, ?, ?)",
+                rows,
+            )
+        return len(fields)
+
+    # -- transactions -----------------------------------------------------
+
+    class _Txn:
+        def __init__(self, conn):
+            self.conn = conn
+
+        def __enter__(self):
+            self.conn.execute("BEGIN IMMEDIATE")
+            return self
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is None:
+                self.conn.execute("COMMIT")
+            else:
+                self.conn.execute("ROLLBACK")
+
+    def _txn(self) -> "Db._Txn":
+        return Db._Txn(self._conn)
+
+    # -- field access -----------------------------------------------------
+
+    def _row_to_field(self, row: sqlite3.Row) -> FieldRecord:
+        return FieldRecord(
+            field_id=row["id"],
+            base=row["base_id"],
+            chunk_id=row["chunk_id"],
+            range_start=unpad(row["range_start"]),
+            range_end=unpad(row["range_end"]),
+            range_size=unpad(row["range_size"]),
+            last_claim_time=parse_ts(row["last_claim_time"]),
+            canon_submission_id=row["canon_submission_id"],
+            check_level=row["check_level"],
+            prioritize=bool(row["prioritize"]),
+        )
+
+    def get_field_by_id(self, field_id: int) -> FieldRecord:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM fields WHERE id = ?", (field_id,)
+            ).fetchone()
+        if row is None:
+            raise KeyError(f"no field {field_id}")
+        return self._row_to_field(row)
+
+    def get_fields_in_base(self, base: int) -> list[FieldRecord]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM fields WHERE base_id = ? ORDER BY id ASC", (base,)
+            ).fetchall()
+        return [self._row_to_field(r) for r in rows]
+
+    def get_bases(self) -> list[int]:
+        with self._lock:
+            rows = self._conn.execute("SELECT id FROM bases ORDER BY id ASC").fetchall()
+        return [r["id"] for r in rows]
+
+    def update_field_canon_and_cl(
+        self, field_id: int, canon_submission_id: Optional[int], check_level: int
+    ) -> None:
+        with self._lock, self._txn():
+            self._conn.execute(
+                "UPDATE fields SET canon_submission_id = ?, check_level = ?"
+                " WHERE id = ?",
+                (canon_submission_id, check_level, field_id),
+            )
+
+    # -- claim engine -----------------------------------------------------
+
+    @staticmethod
+    def _cl_predicate(maximum_check_level: int) -> tuple[str, list]:
+        # check_level = 0 special case targets the partial index, mirroring
+        # the reference optimization (db_util/fields.rs:218-229).
+        if maximum_check_level == 0:
+            return "check_level = 0", []
+        return "check_level <= ?", [maximum_check_level]
+
+    def _claim_rows(
+        self,
+        where: str,
+        params: list,
+        count: int,
+        claim_time: datetime,
+    ) -> list[FieldRecord]:
+        """Single-transaction SELECT..LIMIT + UPDATE last_claim_time."""
+        with self._lock, self._txn():
+            rows = self._conn.execute(
+                f"SELECT * FROM fields WHERE {where} ORDER BY id ASC LIMIT ?",
+                (*params, count),
+            ).fetchall()
+            if rows:
+                self._conn.executemany(
+                    "UPDATE fields SET last_claim_time = ? WHERE id = ?",
+                    [(ts(claim_time), r["id"]) for r in rows],
+                )
+        return [self._row_to_field(r) for r in rows]
+
+    def try_claim_field(
+        self,
+        claim_strategy: FieldClaimStrategy,
+        maximum_timestamp: datetime,
+        maximum_check_level: int,
+        maximum_size: int,
+    ) -> Optional[FieldRecord]:
+        """Claim one field (reference db_util/fields.rs:204-484)."""
+        got = self._claim_batch(
+            claim_strategy, maximum_timestamp, maximum_check_level, maximum_size, 1
+        )
+        return got[0] if got else None
+
+    def _claim_batch(
+        self,
+        claim_strategy: FieldClaimStrategy,
+        maximum_timestamp: datetime,
+        maximum_check_level: int,
+        maximum_size: int,
+        count: int,
+    ) -> list[FieldRecord]:
+        now = now_utc()
+        cl_sql, cl_params = self._cl_predicate(maximum_check_level)
+        base_where = (
+            f"COALESCE(last_claim_time, '') <= ? AND {cl_sql} AND range_size <= ?"
+        )
+        base_params = [ts(maximum_timestamp), *cl_params, pad(maximum_size)]
+
+        if claim_strategy == FieldClaimStrategy.NEXT:
+            return self._claim_rows(base_where, base_params, count, now)
+
+        if claim_strategy == FieldClaimStrategy.RANDOM:
+            max_id = self._max_field_id()
+            if max_id == 0:
+                return []
+            pivot = random.randint(1, max_id)
+            got = self._claim_rows(
+                f"id >= ? AND {base_where}", [pivot, *base_params], count, now
+            )
+            if got:
+                return got
+            return self._claim_rows(base_where, base_params, count, now)
+
+        if claim_strategy == FieldClaimStrategy.THIN:
+            chunk_id, min_id, max_id = self._find_thin_chunk(maximum_check_level)
+            if chunk_id is None:
+                return []
+            pivot = min_id if min_id == max_id else random.randint(min_id, max_id)
+            got = self._claim_rows(
+                f"chunk_id = ? AND id >= ? AND {base_where}",
+                [chunk_id, pivot, *base_params],
+                count,
+                now,
+            )
+            if got:
+                return got
+            return self._claim_rows(
+                f"chunk_id = ? AND {base_where}", [chunk_id, *base_params], count, now
+            )
+
+        raise ValueError(f"unknown strategy {claim_strategy}")
+
+    def _max_field_id(self) -> int:
+        with self._lock:
+            row = self._conn.execute("SELECT MAX(id) AS m FROM fields").fetchone()
+        return row["m"] or 0
+
+    def _find_thin_chunk(self, maximum_check_level: int):
+        """First chunk with < DOWNSAMPLE_CUTOFF_PERCENT checked for the mode
+        (reference db_util/fields.rs:349-380); ratio computed host-side
+        because counts are u128 TEXT columns."""
+        col = "checked_niceonly" if maximum_check_level == 0 else "checked_detailed"
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT id, {col} AS checked, range_size FROM chunks ORDER BY id ASC"
+            ).fetchall()
+        for row in rows:
+            size = unpad(row["range_size"])
+            if size == 0:
+                continue
+            if unpad(row["checked"]) / size < DOWNSAMPLE_CUTOFF_PERCENT:
+                with self._lock:
+                    span = self._conn.execute(
+                        "SELECT MIN(id) AS lo, MAX(id) AS hi FROM fields"
+                        " WHERE chunk_id = ?",
+                        (row["id"],),
+                    ).fetchone()
+                if span["lo"] is None:
+                    continue
+                return row["id"], span["lo"], span["hi"]
+        return None, None, None
+
+    def bulk_claim_fields(
+        self,
+        count: int,
+        maximum_timestamp: datetime,
+        maximum_check_level: int,
+        maximum_size: int,
+    ) -> list[FieldRecord]:
+        """Claim up to count fields in one transaction for queue prefill
+        (reference db_util/fields.rs:488-536)."""
+        return self._claim_batch(
+            FieldClaimStrategy.NEXT,
+            maximum_timestamp,
+            maximum_check_level,
+            maximum_size,
+            count,
+        )
+
+    def bulk_claim_thin_fields(
+        self,
+        count: int,
+        maximum_timestamp: datetime,
+        maximum_check_level: int,
+        maximum_size: int,
+    ) -> list[FieldRecord]:
+        """Bulk claim from the first under-explored chunk
+        (reference db_util/fields.rs:544-609)."""
+        now = now_utc()
+        cl_sql, cl_params = self._cl_predicate(maximum_check_level)
+        chunk_id, _, _ = self._find_thin_chunk(maximum_check_level)
+        if chunk_id is None:
+            return []
+        where = (
+            f"chunk_id = ? AND COALESCE(last_claim_time, '') <= ? AND {cl_sql}"
+            " AND range_size <= ?"
+        )
+        return self._claim_rows(
+            where, [chunk_id, ts(maximum_timestamp), *cl_params, pad(maximum_size)],
+            count, now,
+        )
+
+    def claim_expiry_cutoff(self) -> datetime:
+        return now_utc() - timedelta(hours=CLAIM_DURATION_HOURS)
+
+    # -- claims ------------------------------------------------------------
+
+    def insert_claim(
+        self, field_id: int, search_mode: SearchMode, user_ip: str
+    ) -> ClaimRecord:
+        when = now_utc()
+        mode = "detailed" if search_mode == SearchMode.DETAILED else "niceonly"
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "INSERT INTO claims (field_id, search_mode, claim_time, user_ip)"
+                " VALUES (?, ?, ?, ?)",
+                (field_id, mode, ts(when), user_ip),
+            )
+            claim_id = cur.lastrowid
+        return ClaimRecord(
+            claim_id=claim_id,
+            field_id=field_id,
+            search_mode=search_mode,
+            claim_time=when,
+            user_ip=user_ip,
+        )
+
+    def get_claim_by_id(self, claim_id: int) -> ClaimRecord:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM claims WHERE id = ?", (claim_id,)
+            ).fetchone()
+        if row is None:
+            raise KeyError(f"no claim {claim_id}")
+        return ClaimRecord(
+            claim_id=row["id"],
+            field_id=row["field_id"],
+            search_mode=SearchMode.DETAILED
+            if row["search_mode"] == "detailed"
+            else SearchMode.NICEONLY,
+            claim_time=parse_ts(row["claim_time"]),
+            user_ip=row["user_ip"],
+        )
+
+    # -- submissions -------------------------------------------------------
+
+    def insert_submission(
+        self,
+        claim: ClaimRecord,
+        username: str,
+        client_version: str,
+        user_ip: str,
+        distribution: Optional[list[UniquesDistribution]],
+        numbers: list[NiceNumber],
+        elapsed_secs: float = 0.0,
+    ) -> int:
+        when = now_utc()
+        mode = "detailed" if claim.search_mode == SearchMode.DETAILED else "niceonly"
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "INSERT INTO submissions (claim_id, field_id, search_mode,"
+                " submit_time, elapsed_secs, username, user_ip, client_version,"
+                " disqualified, distribution, numbers)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 0, ?, ?)",
+                (
+                    claim.claim_id,
+                    claim.field_id,
+                    mode,
+                    ts(when),
+                    elapsed_secs,
+                    username,
+                    user_ip,
+                    client_version,
+                    _dist_to_json(distribution),
+                    _numbers_to_json(numbers),
+                ),
+            )
+            return cur.lastrowid
+
+    def _row_to_submission(self, row: sqlite3.Row) -> SubmissionRecord:
+        return SubmissionRecord(
+            submission_id=row["id"],
+            claim_id=row["claim_id"],
+            field_id=row["field_id"],
+            search_mode=SearchMode.DETAILED
+            if row["search_mode"] == "detailed"
+            else SearchMode.NICEONLY,
+            submit_time=parse_ts(row["submit_time"]),
+            elapsed_secs=row["elapsed_secs"],
+            username=row["username"],
+            user_ip=row["user_ip"],
+            client_version=row["client_version"],
+            disqualified=bool(row["disqualified"]),
+            distribution=_dist_from_json(row["distribution"]),
+            numbers=_numbers_from_json(row["numbers"]),
+        )
+
+    def get_submission_by_id(self, submission_id: int) -> SubmissionRecord:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM submissions WHERE id = ?", (submission_id,)
+            ).fetchone()
+        if row is None:
+            raise KeyError(f"no submission {submission_id}")
+        return self._row_to_submission(row)
+
+    def get_detailed_submissions_by_field(self, field_id: int) -> list[SubmissionRecord]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM submissions WHERE field_id = ? AND"
+                " search_mode = 'detailed' AND disqualified = 0 ORDER BY id ASC",
+                (field_id,),
+            ).fetchall()
+        return [self._row_to_submission(r) for r in rows]
+
+    def get_fields_with_detailed_submissions(self, base: int) -> list[FieldRecord]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT f.* FROM fields f JOIN submissions s"
+                " ON f.id = s.field_id WHERE f.base_id = ? AND"
+                " s.search_mode = 'detailed' ORDER BY f.id ASC",
+                (base,),
+            ).fetchall()
+        return [self._row_to_field(r) for r in rows]
+
+    # -- validation --------------------------------------------------------
+
+    def get_validation_field(self) -> ValidationData:
+        """A random double-checked field plus its canonical results
+        (reference db_util/fields.rs:611-679)."""
+        max_id = self._max_field_id()
+        if max_id == 0:
+            raise KeyError("no fields")
+        pivot = random.randint(1, max_id)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM fields WHERE id >= ? AND check_level >= 2 AND"
+                " canon_submission_id IS NOT NULL ORDER BY id ASC LIMIT 1",
+                (pivot,),
+            ).fetchone()
+            if row is None:
+                row = self._conn.execute(
+                    "SELECT * FROM fields WHERE check_level >= 2 AND"
+                    " canon_submission_id IS NOT NULL ORDER BY id ASC LIMIT 1"
+                ).fetchone()
+        if row is None:
+            raise KeyError("no double-checked field with canonical submission")
+        field = self._row_to_field(row)
+        sub = self.get_submission_by_id(field.canon_submission_id)
+        if sub.distribution is None:
+            raise ValueError("canonical submission has no distribution")
+        from nice_tpu.core import distribution_stats, number_stats
+
+        return ValidationData(
+            base=field.base,
+            field_id=field.field_id,
+            range_start=field.range_start,
+            range_end=field.range_end,
+            range_size=field.range_size,
+            unique_distribution=distribution_stats.shrink_distribution(
+                sub.distribution
+            ),
+            nice_numbers=number_stats.shrink_numbers(sub.numbers),
+        )
+
+    # -- analytics updates (jobs) -----------------------------------------
+
+    def update_chunk_stats(self, chunk_id: int, **cols) -> None:
+        self._update_stats_row("chunks", chunk_id, cols)
+
+    def update_base_stats(self, base: int, **cols) -> None:
+        self._update_stats_row("bases", base, cols)
+
+    def _update_stats_row(self, table: str, row_id: int, cols: dict) -> None:
+        sets, params = [], []
+        for key, val in cols.items():
+            sets.append(f"{key} = ?")
+            params.append(val)
+        params.append(row_id)
+        with self._lock, self._txn():
+            self._conn.execute(
+                f"UPDATE {table} SET {', '.join(sets)} WHERE id = ?", params
+            )
+
+    def get_chunks_in_base(self, base: int) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT * FROM chunks WHERE base_id = ? ORDER BY id ASC", (base,)
+            ).fetchall()
+
+    # -- caches ------------------------------------------------------------
+
+    def refresh_search_caches(self) -> None:
+        """Rebuild leaderboard + search-rate caches (reference db_util/cache.rs:3-40)."""
+        with self._lock, self._txn():
+            self._conn.execute("DELETE FROM cache_leaderboard")
+            rows = self._conn.execute(
+                "SELECT username, COUNT(*) AS subs, MAX(submit_time) AS last"
+                " FROM submissions WHERE disqualified = 0 GROUP BY username"
+            ).fetchall()
+            for r in rows:
+                checked = self._conn.execute(
+                    "SELECT f.range_size FROM submissions s JOIN fields f ON"
+                    " s.field_id = f.id WHERE s.username = ? AND s.disqualified = 0",
+                    (r["username"],),
+                ).fetchall()
+                total = sum(unpad(c["range_size"]) for c in checked)
+                self._conn.execute(
+                    "INSERT INTO cache_leaderboard (username, submissions,"
+                    " numbers_checked, last_submission) VALUES (?, ?, ?, ?)",
+                    (r["username"], r["subs"], pad(total), r["last"]),
+                )
+            self._conn.execute("DELETE FROM cache_search_rate")
+            rows = self._conn.execute(
+                "SELECT substr(submit_time, 1, 13) AS hour, search_mode,"
+                " COUNT(*) AS cnt FROM submissions GROUP BY hour, search_mode"
+            ).fetchall()
+            hours: dict[str, dict[str, int]] = {}
+            for r in rows:
+                hours.setdefault(r["hour"], {"detailed": 0, "niceonly": 0})[
+                    r["search_mode"]
+                ] = r["cnt"]
+            for hour, counts in hours.items():
+                self._conn.execute(
+                    "INSERT INTO cache_search_rate (hour, searched_detailed,"
+                    " searched_niceonly) VALUES (?, ?, ?)",
+                    (hour, pad(counts["detailed"]), pad(counts["niceonly"])),
+                )

@@ -1,0 +1,98 @@
+-- nice-tpu field ledger schema.
+-- Structure mirrors the reference (schema/schema.sql): bases -> chunks ->
+-- fields -> claims -> submissions, plus leaderboard/search-rate cache tables.
+-- Engine-portable SQL (SQLite by default; types chosen to also run on
+-- Postgres). u128 quantities are stored as 40-char zero-padded decimal TEXT so
+-- lexicographic comparison == numeric comparison (SQLite INTEGER is only i64).
+
+CREATE TABLE IF NOT EXISTS bases (
+    id              INTEGER PRIMARY KEY,
+    range_start     TEXT NOT NULL,
+    range_end       TEXT NOT NULL,
+    range_size      TEXT NOT NULL,
+    checked_detailed TEXT NOT NULL DEFAULT '0',
+    checked_niceonly TEXT NOT NULL DEFAULT '0',
+    minimum_cl      INTEGER NOT NULL DEFAULT 0,
+    niceness_mean   REAL,
+    niceness_stdev  REAL,
+    distribution    TEXT NOT NULL DEFAULT '[]',   -- JSON
+    numbers         TEXT NOT NULL DEFAULT '[]'    -- JSON
+);
+
+CREATE TABLE IF NOT EXISTS chunks (
+    id              INTEGER PRIMARY KEY AUTOINCREMENT,
+    base_id         INTEGER NOT NULL REFERENCES bases(id),
+    range_start     TEXT NOT NULL,
+    range_end       TEXT NOT NULL,
+    range_size      TEXT NOT NULL,
+    checked_detailed TEXT NOT NULL DEFAULT '0',
+    checked_niceonly TEXT NOT NULL DEFAULT '0',
+    minimum_cl      INTEGER NOT NULL DEFAULT 0,
+    niceness_mean   REAL,
+    niceness_stdev  REAL,
+    distribution    TEXT NOT NULL DEFAULT '[]',
+    numbers         TEXT NOT NULL DEFAULT '[]'
+);
+
+CREATE TABLE IF NOT EXISTS fields (
+    id              INTEGER PRIMARY KEY AUTOINCREMENT,
+    base_id         INTEGER NOT NULL REFERENCES bases(id),
+    chunk_id        INTEGER REFERENCES chunks(id),
+    range_start     TEXT NOT NULL,
+    range_end       TEXT NOT NULL,
+    range_size      TEXT NOT NULL,
+    last_claim_time TEXT,                          -- ISO-8601 UTC
+    canon_submission_id INTEGER,
+    check_level     INTEGER NOT NULL DEFAULT 0,
+    prioritize      INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE IF NOT EXISTS claims (
+    id              INTEGER PRIMARY KEY AUTOINCREMENT,
+    field_id        INTEGER NOT NULL REFERENCES fields(id),
+    search_mode     TEXT NOT NULL,                 -- 'detailed' | 'niceonly'
+    claim_time      TEXT NOT NULL,
+    user_ip         TEXT NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS submissions (
+    id              INTEGER PRIMARY KEY AUTOINCREMENT,
+    claim_id        INTEGER NOT NULL REFERENCES claims(id),
+    field_id        INTEGER NOT NULL REFERENCES fields(id),
+    search_mode     TEXT NOT NULL,
+    submit_time     TEXT NOT NULL,
+    elapsed_secs    REAL NOT NULL DEFAULT 0,
+    username        TEXT NOT NULL,
+    user_ip         TEXT NOT NULL,
+    client_version  TEXT NOT NULL,
+    disqualified    INTEGER NOT NULL DEFAULT 0,
+    distribution    TEXT,                          -- JSON or NULL (niceonly)
+    numbers         TEXT NOT NULL DEFAULT '[]'     -- JSON
+);
+
+-- Claim-path indexes (reference schema.sql:99-101): a partial index for the
+-- hot niceonly predicate and a composite for the detailed path.
+CREATE INDEX IF NOT EXISTS idx_fields_unchecked
+    ON fields(id) WHERE check_level = 0;
+CREATE INDEX IF NOT EXISTS idx_fields_claim_path
+    ON fields(check_level, last_claim_time, id);
+CREATE INDEX IF NOT EXISTS idx_fields_chunk ON fields(chunk_id);
+CREATE INDEX IF NOT EXISTS idx_fields_base ON fields(base_id);
+CREATE INDEX IF NOT EXISTS idx_claims_field ON claims(field_id);
+CREATE INDEX IF NOT EXISTS idx_submissions_field ON submissions(field_id);
+CREATE INDEX IF NOT EXISTS idx_submissions_claim ON submissions(claim_id);
+
+-- Leaderboard / search-rate caches refreshed by the jobs runner
+-- (reference schema.sql:111-131, db_util/cache.rs:3-40).
+CREATE TABLE IF NOT EXISTS cache_leaderboard (
+    username        TEXT PRIMARY KEY,
+    submissions     INTEGER NOT NULL,
+    numbers_checked TEXT NOT NULL,
+    last_submission TEXT NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS cache_search_rate (
+    hour            TEXT PRIMARY KEY,              -- ISO hour bucket
+    searched_detailed TEXT NOT NULL,
+    searched_niceonly TEXT NOT NULL
+);

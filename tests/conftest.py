@@ -9,10 +9,16 @@ real chip.
 import os
 
 # Force CPU: the session env pins JAX_PLATFORMS=axon (the real chip) which the
-# test suite must never grab — bench.py owns the chip.
+# test suite must never grab — bench.py owns the chip. The axon PJRT plugin
+# overrides the JAX_PLATFORMS env var at import time, so the env var alone is
+# not enough: jax.config.update after import is authoritative.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

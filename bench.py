@@ -10,7 +10,7 @@ reference CUDA client).
 
 Env knobs:
   NICE_BENCH_MODE   benchmark field (default: extra-large)
-  NICE_BENCH_BATCH  lanes per dispatch (default: 1<<24)
+  NICE_BENCH_BATCH  lanes per dispatch (default: 1<<28)
 """
 
 from __future__ import annotations
@@ -25,23 +25,29 @@ BASELINE_NS_PER_CHIP = 1.25e8
 
 def main() -> int:
     mode_name = os.environ.get("NICE_BENCH_MODE", "extra-large")
-    batch_size = int(os.environ.get("NICE_BENCH_BATCH", 1 << 24))
 
     import jax
+
+    # 2^28 lanes is free on TPU (the Pallas kernel derives candidates
+    # on-device, so a batch is just grid steps); the jnp fallback on other
+    # platforms materializes per-lane intermediates and needs a smaller batch.
+    default_batch = 1 << 28 if jax.default_backend() == "tpu" else 1 << 22
+    batch_size = int(os.environ.get("NICE_BENCH_BATCH", default_batch))
 
     from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
     from nice_tpu.ops import engine
 
     n_chips = len(jax.devices())
     data = get_benchmark_field(BenchmarkMode(mode_name))
+    batch_size = min(batch_size, max(1 << 18, 1 << (data.range_size - 1).bit_length()))
 
-    # Warm-up compile on a small slice so the timed run measures throughput,
-    # not XLA compile time (same batch shape => cache hit).
+    # Warm-up compile with the SAME batch shape so the timed run measures
+    # throughput, not compile time (the kernel is jitted per (base, batch)).
     from nice_tpu.core.types import FieldSize
 
-    warm = FieldSize(data.range_start, data.range_start + min(batch_size, 4096))
+    warm = FieldSize(data.range_start, data.range_start + 1)
     engine.process_range_detailed(
-        warm, data.base, backend="jax", batch_size=min(batch_size, 4096)
+        warm, data.base, backend="jax", batch_size=batch_size
     )
     rng = data.to_field_size()
     t0 = time.monotonic()

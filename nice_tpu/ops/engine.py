@@ -27,6 +27,7 @@ from nice_tpu.core.types import (
     NiceNumberSimple,
     UniquesDistributionSimple,
 )
+from nice_tpu.ops import pallas_engine as pe
 from nice_tpu.ops import scalar
 from nice_tpu.ops.limbs import get_plan, int_to_limbs
 from nice_tpu.ops import vector_engine as ve
@@ -38,6 +39,66 @@ DEFAULT_BATCH_SIZE = 1 << 18
 # Max batches in flight during pipelined dispatch: bounds live device buffers
 # (and the runtime queue) so arbitrarily large fields run in constant memory.
 DISPATCH_WINDOW = 32
+
+# Sub-batch size for the rare-path per-lane re-scan: small enough that the
+# device->host uniques transfer stays modest even when the stats batch is 2^28.
+RARE_SCAN_BATCH = 1 << 20
+
+
+def _pick_backend(plan, batch_size: int, backend: str) -> str:
+    """Resolve "jax" to the Pallas kernels when on TPU and the base/batch
+    supports them (histogram fits one 128-lane row; batch is whole blocks).
+    On other platforms "jax" resolves to the XLA-compiled jnp engine; passing
+    backend="pallas" explicitly forces the kernels (interpreter mode off-TPU,
+    used by the test suite)."""
+    if backend == "pallas":
+        if not pe.supports_base(plan):
+            raise ValueError(
+                f"base {plan.base} exceeds the Pallas stats tile (base+2 > 128)"
+            )
+        if batch_size % 128 != 0:
+            raise ValueError(f"pallas batch_size must be a multiple of 128, got {batch_size}")
+        return backend
+    if backend != "jax":
+        return backend
+    import jax
+
+    if (
+        jax.default_backend() == "tpu"
+        and pe.supports_base(plan)
+        and batch_size % pe.BLOCK_LANES == 0
+    ):
+        return "pallas"
+    return "jnp"
+
+
+def _rare_scan_uniques(plan, batch_start: int, valid: int, batch_size: int, backend: str):
+    """Yield (sub_start, uniques ndarray) slices covering [batch_start, +valid)
+    that may contain hits.
+
+    Near-miss/nice extraction is the rare path. With large stats batches the
+    full per-lane uniques array would be a huge device->host transfer (and a
+    huge materialization), so we re-probe in RARE_SCAN_BATCH sub-batches with
+    the stats entry point and only materialize per-lane uniques for
+    sub-batches that actually contain a hit (nice numbers count as near
+    misses — cutoff < base — so one probe serves both modes).
+    """
+    mod = pe if backend == "pallas" else ve
+    sub_size = min(RARE_SCAN_BATCH, batch_size)
+    probe = valid > sub_size  # single sub-batch: the caller already saw the hit
+    done = 0
+    while done < valid:
+        sub_valid = min(sub_size, valid - done)
+        sub_start = batch_start + done
+        start_limbs = int_to_limbs(sub_start, plan.limbs_n)
+        hit = True
+        if probe:
+            _, nm = mod.detailed_batch(plan, sub_size, start_limbs, np.int32(sub_valid))
+            hit = int(nm) > 0
+        if hit:
+            u = np.asarray(mod.uniques_batch(plan, sub_size, start_limbs))
+            yield sub_start, u[:sub_valid]
+        done += sub_valid
 
 
 def _clamp_to_base_range(range_: FieldSize, base: int):
@@ -75,7 +136,7 @@ def process_range_detailed(
     """Full histogram + near-miss list, exact, any backend."""
     if backend == "scalar":
         return scalar.process_range_detailed(range_, base)
-    if backend != "jax":
+    if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 
     core, slivers = _split_for_jax(
@@ -85,6 +146,8 @@ def process_range_detailed(
         return scalar.process_range_detailed(range_, base)
 
     plan = get_plan(base)
+    backend = _pick_backend(plan, batch_size, backend)
+    batch_fn = pe.detailed_batch if backend == "pallas" else ve.detailed_batch
     hist = np.zeros(plan.base + 2, dtype=np.int64)
     nice_numbers: list[NiceNumberSimple] = []
     for sub in slivers:
@@ -102,28 +165,30 @@ def process_range_detailed(
     pending: deque = deque()
 
     def collect_one():
-        batch_start, valid, start_limbs, bh, nm = pending.popleft()
-        bh = np.asarray(bh, dtype=np.int64)
+        batch_start, valid, bh, nm = pending.popleft()
+        bh = np.asarray(bh, dtype=np.int64)[: plan.base + 2]
         bh[0] -= batch_size - valid  # remove tail-padding lanes from bin 0
         np.add(hist, bh, out=hist)
         if int(nm) > 0:
-            # Rare path: re-derive per-lane uniques for this batch only.
-            uniques = np.asarray(ve.uniques_batch(plan, batch_size, start_limbs))
-            idxs = np.nonzero(uniques[:valid] > plan.near_miss_cutoff)[0]
-            for i in idxs.tolist():
-                nice_numbers.append(
-                    NiceNumberSimple(
-                        number=batch_start + i, num_uniques=int(uniques[i])
+            # Rare path: re-derive per-lane uniques around this batch only.
+            for sub_start, uniques in _rare_scan_uniques(
+                plan, batch_start, valid, batch_size, backend
+            ):
+                idxs = np.nonzero(uniques > plan.near_miss_cutoff)[0]
+                for i in idxs.tolist():
+                    nice_numbers.append(
+                        NiceNumberSimple(
+                            number=sub_start + i, num_uniques=int(uniques[i])
+                        )
                     )
-                )
 
     done = 0
     while done < total:
         valid = min(batch_size, total - done)
         batch_start = start + done
         start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-        bh, nm = ve.detailed_batch(plan, batch_size, start_limbs, np.int32(valid))
-        pending.append((batch_start, valid, start_limbs, bh, nm))
+        bh, nm = batch_fn(plan, batch_size, start_limbs, np.int32(valid))
+        pending.append((batch_start, valid, bh, nm))
         if len(pending) >= DISPATCH_WINDOW:
             collect_one()
         done += valid
@@ -150,7 +215,7 @@ def process_range_niceonly(
     enumeration arrives with the Pallas niceonly kernel."""
     if backend == "scalar":
         return scalar.process_range_niceonly(range_, base, stride_table)
-    if backend != "jax":
+    if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 
     from nice_tpu.ops import msd_filter
@@ -168,16 +233,22 @@ def process_range_niceonly(
         nice_numbers.extend(sub.nice_numbers)
 
     plan = get_plan(base)
+    backend = _pick_backend(plan, batch_size, backend)
+    dense_fn = (
+        pe.niceonly_dense_batch if backend == "pallas" else ve.niceonly_dense_batch
+    )
     pending: deque = deque()
 
     def collect_one():
-        batch_start, valid, start_limbs, count = pending.popleft()
+        batch_start, valid, count = pending.popleft()
         if int(count) > 0:
-            uniques = np.asarray(ve.uniques_batch(plan, batch_size, start_limbs))
-            for i in np.nonzero(uniques[:valid] == base)[0].tolist():
-                nice_numbers.append(
-                    NiceNumberSimple(number=batch_start + i, num_uniques=base)
-                )
+            for sub_start, uniques in _rare_scan_uniques(
+                plan, batch_start, valid, batch_size, backend
+            ):
+                for i in np.nonzero(uniques == base)[0].tolist():
+                    nice_numbers.append(
+                        NiceNumberSimple(number=sub_start + i, num_uniques=base)
+                    )
 
     for sub_range in msd_filter.get_valid_ranges(core, base):
         start = sub_range.start()
@@ -187,10 +258,8 @@ def process_range_niceonly(
             valid = min(batch_size, total - done)
             batch_start = start + done
             start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-            count = ve.niceonly_dense_batch(
-                plan, batch_size, start_limbs, np.int32(valid)
-            )
-            pending.append((batch_start, valid, start_limbs, count))
+            count = dense_fn(plan, batch_size, start_limbs, np.int32(valid))
+            pending.append((batch_start, valid, count))
             if len(pending) >= DISPATCH_WINDOW:
                 collect_one()
             done += valid

@@ -1,0 +1,196 @@
+"""Pallas TPU kernels for the niceness hot loops.
+
+Drop-in replacements for the ve.*_batch entry points: the whole per-candidate
+pipeline (derive n from a start offset, square, cube, chunked radix digit
+extraction, digit-mask popcount, histogram) runs inside one Mosaic kernel with
+zero HBM traffic — no input tensors at all (candidates are derived on-device
+from the scalar-prefetched start limbs, the analog of the reference's
+input-free grid-stride CUDA kernel, nice_kernels.cu:486-531), and the only
+output is a (2,128) i32 SMEM stats tile accumulated across sequential grid
+steps (the analog of its per-warp shared-mem histograms, nice_kernels.cu:496-530).
+
+The arithmetic is shared with ops/vector_engine.py — those helpers are pure
+elementwise jnp on u32 arrays of any shape, so the exact same code traces into
+the Mosaic kernel on (rows, 128) VPU blocks. One implementation, two
+compilers, bit-identical results (the cross-backend parity contract the whole
+reference test strategy is built on, SURVEY.md §4).
+
+Output tile layout (row, col):
+  [0, 0:base+2]  histogram of num_uniques (padding lanes counted in bin 0)
+  [1, 0]         near-miss count (detailed) / nice count (niceonly)
+
+On non-TPU backends the kernels run in interpreter mode automatically, which
+is how the test suite exercises them without hardware (the analog of the
+reference's NVRTC compile-only + CPU-mirror tests, client_process_gpu.rs:1421).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nice_tpu.ops import vector_engine as ve
+from nice_tpu.ops.limbs import BasePlan
+
+# Lanes per grid step: 256 sublanes x 128 lanes. Keeps every live (rows, 128)
+# u32 intermediate at 128 KiB so the whole pipeline (~15 live arrays during
+# extraction) sits comfortably in the ~16 MiB of VMEM.
+BLOCK_ROWS = 256
+BLOCK_LANES = BLOCK_ROWS * 128
+
+
+@functools.lru_cache(maxsize=None)
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def supports_base(plan: BasePlan) -> bool:
+    """The stats tile keeps the histogram in one 128-lane row."""
+    return plan.base + 2 <= 128
+
+
+def _effective_block_rows(batch_size: int, block_rows: int) -> int:
+    """Shrink the block for small batches (tests, tiny fields)."""
+    return min(block_rows, max(1, batch_size // 128))
+
+
+def _block_iota(block_rows: int):
+    row = jax.lax.broadcasted_iota(jnp.int32, (block_rows, 128), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_rows, 128), 1)
+    return row * 128 + col
+
+
+def _derive_lanes(plan: BasePlan, start_ref, idx, block_rows: int):
+    """n = start + global lane index, as broadcast u32 limbs."""
+    base_limbs = [
+        jnp.full((block_rows, 128), start_ref[i], dtype=jnp.uint32)
+        for i in range(plan.limbs_n)
+    ]
+    return ve.add_u32(base_limbs, idx.astype(jnp.uint32))
+
+
+def _make_kernel(plan: BasePlan, mode: str, block_rows: int):
+    """mode: "detailed" (histogram + near-miss count) or "niceonly" (count)."""
+
+    def kernel(start_ref, valid_ref, out_ref):
+        step = pl.program_id(0)
+        lane0 = step * (block_rows * 128)
+        idx = _block_iota(block_rows) + lane0
+        n = _derive_lanes(plan, start_ref, idx, block_rows)
+        uniques = ve.num_uniques_lanes(plan, n)
+        valid = idx < valid_ref[0]
+
+        @pl.when(step == 0)
+        def _():
+            # Zero the whole tile (SMEM output buffers start undefined).
+            for r in range(2):
+                for b in range(128):
+                    out_ref[r, b] = 0
+
+        if mode == "detailed":
+            u = jnp.where(valid, uniques, 0)
+            for b in range(plan.base + 2):
+                out_ref[0, b] += jnp.sum((u == b).astype(jnp.int32))
+            out_ref[1, 0] += jnp.sum(
+                (valid & (uniques > plan.near_miss_cutoff)).astype(jnp.int32)
+            )
+        else:
+            out_ref[1, 0] += jnp.sum(
+                (valid & (uniques == plan.base)).astype(jnp.int32)
+            )
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _stats_callable(plan: BasePlan, mode: str, batch_size: int, block_rows: int):
+    assert batch_size % (block_rows * 128) == 0, (batch_size, block_rows)
+    num_blocks = batch_size // (block_rows * 128)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # start limbs + valid count land in SMEM
+        grid=(num_blocks,),
+        in_specs=[],
+        # Stats tile lives in SMEM: Mosaic only allows scalar stores there,
+        # and the per-bin counts are scalars by construction.
+        out_specs=pl.BlockSpec(
+            (2, 128), lambda step, *_: (0, 0), memory_space=pltpu.SMEM
+        ),
+    )
+    call = pl.pallas_call(
+        _make_kernel(plan, mode, block_rows),
+        out_shape=jax.ShapeDtypeStruct((2, 128), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )
+
+    @jax.jit
+    def run(start_limbs, valid_count):
+        tile = call(start_limbs, jnp.reshape(valid_count, (1,)).astype(jnp.int32))
+        return tile[0], tile[1, 0]
+
+    return run
+
+
+def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
+                   block_rows: int = BLOCK_ROWS):
+    """(histogram i32[128] (bins 0..base+1), near_miss_count i32)."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _stats_callable(plan, "detailed", batch_size, block_rows)
+    return run(start_limbs, valid_count)
+
+
+def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
+                         valid_count, block_rows: int = BLOCK_ROWS):
+    """Count of fully nice lanes in a dense range batch (i32)."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _stats_callable(plan, "niceonly", batch_size, block_rows)
+    return run(start_limbs, valid_count)[1]
+
+
+# --------------------------------------------------------------------------
+# Per-lane uniques (rare-path near-miss / nice extraction)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _uniques_callable(plan: BasePlan, batch_size: int, block_rows: int):
+    assert batch_size % (block_rows * 128) == 0, (batch_size, block_rows)
+    num_blocks = batch_size // (block_rows * 128)
+
+    def kernel(start_ref, out_ref):
+        step = pl.program_id(0)
+        idx = _block_iota(block_rows) + step * (block_rows * 128)
+        n = _derive_lanes(plan, start_ref, idx, block_rows)
+        out_ref[:] = ve.num_uniques_lanes(plan, n)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_blocks,),
+        in_specs=[],
+        out_specs=pl.BlockSpec(
+            (block_rows, 128), lambda step, *_: (step, 0), memory_space=pltpu.VMEM
+        ),
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((batch_size // 128, 128), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )
+
+    @jax.jit
+    def run(start_limbs):
+        return call(start_limbs).reshape(batch_size)
+
+    return run
+
+
+def uniques_batch(plan: BasePlan, batch_size: int, start_limbs,
+                  block_rows: int = BLOCK_ROWS):
+    """Per-lane num_uniques for one batch (i32[batch_size])."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    return _uniques_callable(plan, batch_size, block_rows)(start_limbs)

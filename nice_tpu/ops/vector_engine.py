@@ -120,34 +120,6 @@ def _divmod_halfwords(hws_msw: list, divisor: int, out_len: int):
     return q[len(q) - out_len :], rem
 
 
-def extract_digit_list(plan: BasePlan, limbs: list, num_digits: int, hw_count: int):
-    """All base digits of a value with exactly num_digits digits.
-
-    Chunked: peel chunk_e digits at a time with one multi-halfword division by
-    the constant chunk_div, then split the small remainder into single digits
-    with scalar constant divisions (reference nice_kernels.cu:203-247 chunk
-    scheme, sized for u32 instead of u64 intermediates).
-    """
-    base = np.uint32(plan.base)
-    digits = []
-    hws = limbs_to_halfwords_msw(limbs, hw_count)
-    remaining = num_digits
-    while remaining > plan.chunk_e:
-        remaining -= plan.chunk_e
-        new_hw = halfwords_for(plan.base**remaining)
-        hws, rem = _divmod_halfwords(hws, plan.chunk_div, new_hw)
-        for _ in range(plan.chunk_e):
-            digits.append(rem % base)
-            rem = rem // base
-    # Tail: value now fits in one halfword (base^remaining <= chunk_div <= 2^16).
-    assert len(hws) == 1, (plan.base, num_digits, len(hws))
-    rem = hws[0]
-    for _ in range(remaining):
-        digits.append(rem % base)
-        rem = rem // base
-    return digits
-
-
 def set_digit_masks(plan: BasePlan, masks: list, digits: list) -> list:
     """OR each digit's presence bit into the u32 mask words."""
     one = np.uint32(1)
@@ -163,14 +135,42 @@ def set_digit_masks(plan: BasePlan, masks: list, digits: list) -> list:
     return masks
 
 
+def accumulate_digit_masks(plan: BasePlan, masks: list, limbs: list, num_digits: int, hw_count: int) -> list:
+    """Extract all base digits of a value with exactly num_digits digits and
+    OR each into the presence masks immediately.
+
+    Chunked radix scheme: peel chunk_e digits at a time with one
+    multi-halfword long division by the constant chunk_div, then split the
+    small remainder into single digits with scalar constant divisions
+    (reference nice_kernels.cu:203-247, sized for u32 instead of u64
+    intermediates). Folding digits into masks as they appear keeps at most
+    one digit array live, bounding the Pallas kernel's VMEM footprint at
+    ~the halfword list instead of all `base` digit arrays."""
+    base = np.uint32(plan.base)
+    hws = limbs_to_halfwords_msw(limbs, hw_count)
+    remaining = num_digits
+    while remaining > plan.chunk_e:
+        remaining -= plan.chunk_e
+        new_hw = halfwords_for(plan.base**remaining)
+        hws, rem = _divmod_halfwords(hws, plan.chunk_div, new_hw)
+        for _ in range(plan.chunk_e):
+            masks = set_digit_masks(plan, masks, [rem % base])
+            rem = rem // base
+    assert len(hws) == 1, (plan.base, num_digits, len(hws))
+    rem = hws[0]
+    for _ in range(remaining):
+        masks = set_digit_masks(plan, masks, [rem % base])
+        rem = rem // base
+    return masks
+
+
 def num_uniques_lanes(plan: BasePlan, n_limbs: list):
     """num_uniques of (n^2, n^3) for a batch of candidates given as limbs."""
     sq = mul_limbs(n_limbs, n_limbs, plan.limbs_sq)
     cu = mul_limbs(sq, n_limbs, plan.limbs_cu)
-    digits = extract_digit_list(plan, sq, plan.d_sq, plan.hw_sq)
-    digits += extract_digit_list(plan, cu, plan.d_cu, plan.hw_cu)
     masks = [jnp.zeros_like(n_limbs[0]) for _ in range(plan.n_masks)]
-    masks = set_digit_masks(plan, masks, digits)
+    masks = accumulate_digit_masks(plan, masks, sq, plan.d_sq, plan.hw_sq)
+    masks = accumulate_digit_masks(plan, masks, cu, plan.d_cu, plan.hw_cu)
     uniques = jax.lax.population_count(masks[0])
     for m in masks[1:]:
         uniques = uniques + jax.lax.population_count(m)

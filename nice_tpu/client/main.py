@@ -82,8 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--backend",
         default=_env("BACKEND", "jax"),
-        choices=["jax", "scalar"],
-        help="compute backend (env NICE_BACKEND)",
+        choices=["jax", "jnp", "pallas", "native", "scalar"],
+        help="compute backend: jax auto-selects Pallas kernels on TPU; "
+        "native is the multithreaded C++ host engine (env NICE_BACKEND)",
     )
     p.add_argument(
         "--batch-size",

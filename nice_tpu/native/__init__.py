@@ -1,0 +1,227 @@
+"""ctypes bindings for the native host engine (nice_native.cpp).
+
+The library is built on first import (g++, cached as libnice_native.so next
+to the source; rebuilt when the source is newer). Every entry point has a
+pure-Python fallback, so the framework degrades gracefully where no C++
+toolchain exists; `available()` reports which path is active and the
+`NICE_NO_NATIVE=1` env var forces the fallback (used by differential tests
+to compare both implementations).
+
+All natives are pure functions; ctypes releases the GIL for the duration of
+a call, so Python-level thread pools achieve real parallelism over field
+chunks — the analog of the reference's rayon par_iter (client/src/main.rs:194)
+and of its CPU-threaded MSD filter feeding the GPU (client_process_gpu.rs:624).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from functools import lru_cache
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "nice_native.cpp")
+_LIB = os.path.join(_HERE, "libnice_native.so")
+_U64 = ctypes.c_uint64
+_MASK64 = (1 << 64) - 1
+
+_build_lock = threading.Lock()
+
+
+def _build() -> bool:
+    with _build_lock:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return True
+        # Compile to a process-unique temp path and atomically rename: another
+        # process may be dlopen-ing the current .so while we rebuild.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", tmp],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, _LIB)
+            return True
+        except (OSError, subprocess.SubprocessError) as exc:
+            log.warning("native build failed, using Python fallbacks: %s", exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+
+@lru_cache(maxsize=1)
+def _load():
+    if os.environ.get("NICE_NO_NATIVE"):
+        return None
+    if not _build():
+        return None
+    lib = ctypes.CDLL(_LIB)
+    lib.nice_num_unique_digits.restype = ctypes.c_int
+    lib.nice_num_unique_digits.argtypes = [_U64, _U64, _U64]
+    lib.nice_is_nice.restype = ctypes.c_int
+    lib.nice_is_nice.argtypes = [_U64, _U64, _U64]
+    lib.nice_process_range_detailed.restype = None
+    lib.nice_process_range_detailed.argtypes = [
+        _U64, _U64, _U64, _U64, _U64,
+        ctypes.POINTER(_U64), ctypes.POINTER(_U64), _U64, ctypes.POINTER(_U64),
+    ]
+    lib.nice_iterate_range_strided.restype = None
+    lib.nice_iterate_range_strided.argtypes = [
+        _U64, _U64, _U64, _U64, _U64, _U64,
+        ctypes.POINTER(_U64), _U64, ctypes.POINTER(_U64), _U64,
+        ctypes.POINTER(_U64),
+    ]
+    lib.nice_has_duplicate_msd_prefix.restype = ctypes.c_int
+    lib.nice_has_duplicate_msd_prefix.argtypes = [_U64, _U64, _U64, _U64, _U64]
+    lib.nice_msd_valid_ranges.restype = ctypes.c_void_p
+    lib.nice_msd_valid_ranges.argtypes = [
+        _U64, _U64, _U64, _U64, _U64, ctypes.c_int, _U64, ctypes.c_int,
+    ]
+    lib.nice_ranges_count.restype = _U64
+    lib.nice_ranges_count.argtypes = [ctypes.c_void_p]
+    lib.nice_ranges_copy.restype = None
+    lib.nice_ranges_copy.argtypes = [ctypes.c_void_p, ctypes.POINTER(_U64)]
+    lib.nice_ranges_free.restype = None
+    lib.nice_ranges_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _split(n: int) -> tuple[int, int]:
+    if n < 0 or n >= 1 << 128:
+        raise ValueError(f"{n} does not fit in u128")
+    return n & _MASK64, n >> 64
+
+
+def _base_ok(base: int) -> bool:
+    """Bases the C++ arithmetic supports: digit indicators are u128 bitmasks
+    (base <= 128) and digit buffers are sized for base >= 4 (a cube of a
+    128-bit value has up to ~192 base-4 digits). Out-of-bounds bases use the
+    Python fallbacks, which the oracle allows up to 256."""
+    return 4 <= base <= 128
+
+
+def num_unique_digits(num: int, base: int) -> int:
+    """Native-or-fallback scalar niceness check (server verification path)."""
+    lib = _load()
+    if lib is None or num >= 1 << 128 or not _base_ok(base):
+        from nice_tpu.ops import scalar
+
+        return scalar.get_num_unique_digits(num, base)
+    lo, hi = _split(num)
+    return lib.nice_num_unique_digits(lo, hi, base)
+
+
+def is_nice(num: int, base: int) -> bool:
+    lib = _load()
+    if lib is None or num >= 1 << 128 or not _base_ok(base):
+        from nice_tpu.ops import scalar
+
+        return scalar.get_is_nice(num, base)
+    lo, hi = _split(num)
+    return bool(lib.nice_is_nice(lo, hi, base))
+
+
+def process_range_detailed(start: int, count: int, base: int, cutoff: int):
+    """(histogram list[base+2], [(n, num_uniques), ...]) for [start, start+count).
+
+    Returns None when the native library is unavailable (callers fall back to
+    the scalar oracle).
+    """
+    lib = _load()
+    if lib is None or start + count >= 1 << 128 or not _base_ok(base):
+        return None
+    lo, hi = _split(start)
+    hist = (_U64 * (base + 2))()
+    cap = 4096
+    while True:
+        misses = (_U64 * (3 * cap))()
+        miss_count = _U64(0)
+        for i in range(base + 2):
+            hist[i] = 0
+        lib.nice_process_range_detailed(
+            lo, hi, count, base, cutoff, hist, misses, cap,
+            ctypes.byref(miss_count),
+        )
+        if miss_count.value <= cap:
+            break
+        cap = int(miss_count.value)
+    out_misses = [
+        (misses[i * 3] | (misses[i * 3 + 1] << 64), int(misses[i * 3 + 2]))
+        for i in range(min(int(miss_count.value), cap))
+    ]
+    return list(hist), out_misses
+
+
+def iterate_range_strided(first: int, start_idx: int, end: int, base: int,
+                          gap_table) -> list[int] | None:
+    """Nice numbers among stride candidates in [first, end), starting from
+    candidate `first` at residue index start_idx. None => no native library."""
+    lib = _load()
+    if lib is None or end >= 1 << 128 or not _base_ok(base):
+        return None
+    flo, fhi = _split(first)
+    elo, ehi = _split(end)
+    num = len(gap_table)
+    gaps = (_U64 * num)(*gap_table)
+    cap = 1024
+    while True:
+        out = (_U64 * (2 * cap))()
+        count = _U64(0)
+        lib.nice_iterate_range_strided(
+            flo, fhi, start_idx, elo, ehi, base, gaps, num, out, cap,
+            ctypes.byref(count),
+        )
+        if count.value <= cap:
+            break
+        cap = int(count.value)
+    return [out[i * 2] | (out[i * 2 + 1] << 64) for i in range(int(count.value))]
+
+
+def has_duplicate_msd_prefix(start: int, end: int, base: int) -> bool | None:
+    lib = _load()
+    if lib is None or end > 1 << 128 or not _base_ok(base):
+        return None
+    slo, shi = _split(start)
+    elo, ehi = _split(end)
+    return bool(lib.nice_has_duplicate_msd_prefix(slo, shi, elo, ehi, base))
+
+
+def msd_valid_ranges(start: int, end: int, base: int, max_depth: int,
+                     min_range_size: int, subdivision_factor: int):
+    """[(sub_start, sub_end), ...] surviving the recursive MSD filter.
+    None => no native library (callers use the Python implementation)."""
+    lib = _load()
+    if lib is None or end > 1 << 128 or not _base_ok(base):
+        return None
+    slo, shi = _split(start)
+    elo, ehi = _split(end)
+    handle = lib.nice_msd_valid_ranges(
+        slo, shi, elo, ehi, base, max_depth, min_range_size, subdivision_factor
+    )
+    try:
+        n = int(lib.nice_ranges_count(handle))
+        buf = (_U64 * (4 * n))()
+        if n:
+            lib.nice_ranges_copy(handle, buf)
+        return [
+            (
+                buf[i * 4] | (buf[i * 4 + 1] << 64),
+                buf[i * 4 + 2] | (buf[i * 4 + 3] << 64),
+            )
+            for i in range(n)
+        ]
+    finally:
+        lib.nice_ranges_free(handle)

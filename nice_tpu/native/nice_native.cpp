@@ -1,0 +1,383 @@
+// Native host engine for nice-tpu.
+//
+// The reference implements its host-side compute in native code (Rust:
+// common/src/client_process.rs, fixed_width.rs, msd_prefix_filter.rs,
+// stride_filter.rs); this is the TPU build's native equivalent, exposed to
+// Python through a small extern "C" surface loaded with ctypes.  It covers
+// the pieces that run on the HOST in the heterogeneous pipeline:
+//
+//   * scalar niceness checks (num_unique_digits / is_nice) used by the API
+//     server's submission verification (reference api/src/main.rs:352-358)
+//   * the detailed range loop (CPU fallback / non-TPU client parity,
+//     reference client_process.rs:150-191)
+//   * the recursive MSD prefix filter that feeds range descriptors to the
+//     TPU niceonly kernels (reference msd_prefix_filter.rs:382-674, GPU
+//     pipeline client_process_gpu.rs:589-709)
+//   * CRT stride-table iteration with early-exit checks (reference
+//     stride_filter.rs:139-155) for the native niceonly path
+//
+// Arithmetic: candidates n fit in 128 bits for every supported base
+// (n < 2^110 at base 97); squares fit 256 bits, cubes 384.  Fixed-width
+// u64-limb routines with __int128 intermediates mirror the reference's
+// u64-limb / u128-accumulator scheme (fixed_width.rs:52-181).  All functions
+// are pure and thread-safe; Python callers fan out across threads (ctypes
+// releases the GIL), the analog of the reference's rayon par_iter.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Fixed-width helpers (LSW-first u64 limbs)
+// ---------------------------------------------------------------------------
+
+// out[0..4) = a[0..2) * a[0..2)  (exact 128x128 -> 256)
+inline void mul_2x2(const u64 a[2], const u64 b[2], u64 out[4]) {
+    u128 ll = (u128)a[0] * b[0];
+    u128 lh = (u128)a[0] * b[1];
+    u128 hl = (u128)a[1] * b[0];
+    u128 hh = (u128)a[1] * b[1];
+    u64 c0 = (u64)ll;
+    u128 t1 = (ll >> 64) + (u64)lh + (u64)hl;
+    u64 c1 = (u64)t1;
+    u128 t2 = (t1 >> 64) + (lh >> 64) + (hl >> 64) + (u64)hh;
+    u64 c2 = (u64)t2;
+    u64 c3 = (u64)((t2 >> 64) + (hh >> 64));
+    out[0] = c0; out[1] = c1; out[2] = c2; out[3] = c3;
+}
+
+// out[0..6) = a[0..4) * b[0..2)  (256x128 -> 384)
+inline void mul_4x2(const u64 a[4], const u64 b[2], u64 out[6]) {
+    u64 acc[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; ++i) {
+        u128 carry = 0;
+        for (int j = 0; j < 2; ++j) {
+            u128 cur = (u128)a[i] * b[j] + acc[i + j] + carry;
+            acc[i + j] = (u64)cur;
+            carry = cur >> 64;
+        }
+        int k = i + 2;
+        while (carry != 0 && k < 6) {
+            u128 cur = (u128)acc[k] + carry;
+            acc[k] = (u64)cur;
+            carry = cur >> 64;
+            ++k;
+        }
+    }
+    std::memcpy(out, acc, sizeof(acc));
+}
+
+// value[0..len) /= divisor, returns remainder; trims trailing zero limbs.
+inline u64 div_limbs_inplace(u64* value, int& len, u64 divisor) {
+    u128 rem = 0;
+    for (int i = len - 1; i >= 0; --i) {
+        u128 cur = (rem << 64) | value[i];
+        value[i] = (u64)(cur / divisor);
+        rem = cur % divisor;
+    }
+    while (len > 0 && value[len - 1] == 0) --len;
+    return (u64)rem;
+}
+
+inline bool limbs_nonzero(const u64* value, int len) { return len > 0; }
+
+// add small constant to a 2-limb value
+inline void add_2(u64 v[2], u64 x) {
+    u64 s = v[0] + x;
+    v[1] += (s < v[0]) ? 1 : 0;
+    v[0] = s;
+}
+
+// compare 2-limb values
+inline int cmp_2(const u64 a[2], const u64 b[2]) {
+    if (a[1] != b[1]) return a[1] < b[1] ? -1 : 1;
+    if (a[0] != b[0]) return a[0] < b[0] ? -1 : 1;
+    return 0;
+}
+
+// OR the digits of value (destroyed) into a u128 indicator; digits peeled
+// until the value is zero (the CPU rule, reference client_process.rs:76-127).
+inline void or_digits(u64* value, int len, u64 base, u128& indicator) {
+    while (limbs_nonzero(value, len)) {
+        u64 d = div_limbs_inplace(value, len, base);
+        indicator |= (u128)1 << d;
+    }
+}
+
+// Early-exit variant: returns false as soon as a duplicate digit appears
+// (reference client_process.rs:222-253).
+inline bool or_digits_distinct(u64* value, int len, u64 base, u128& indicator) {
+    while (limbs_nonzero(value, len)) {
+        u64 d = div_limbs_inplace(value, len, base);
+        u128 bit = (u128)1 << d;
+        if (indicator & bit) return false;
+        indicator |= bit;
+    }
+    return true;
+}
+
+inline int popcount128(u128 x) {
+    return __builtin_popcountll((u64)x) + __builtin_popcountll((u64)(x >> 64));
+}
+
+inline int limb_len(const u64* v, int cap) {
+    int len = cap;
+    while (len > 0 && v[len - 1] == 0) --len;
+    return len;
+}
+
+inline int num_unique_digits_impl(const u64 n[2], u64 base) {
+    u64 sq[4], cu[6];
+    mul_2x2(n, n, sq);
+    mul_4x2(sq, n, cu);
+    u128 indicator = 0;
+    int sq_len = limb_len(sq, 4), cu_len = limb_len(cu, 6);
+    or_digits(sq, sq_len, base, indicator);
+    or_digits(cu, cu_len, base, indicator);
+    return popcount128(indicator);
+}
+
+inline bool is_nice_impl(const u64 n[2], u64 base) {
+    u64 sq[4], cu[6];
+    mul_2x2(n, n, sq);
+    u128 indicator = 0;
+    int sq_len = limb_len(sq, 4);
+    // Square scanned before the cube is ever multiplied (reference
+    // nice_kernels.cu:270-299 ordering; most candidates die in the square).
+    u64 sq_copy[4];
+    std::memcpy(sq_copy, sq, sizeof(sq));
+    if (!or_digits_distinct(sq_copy, sq_len, base, indicator)) return false;
+    mul_4x2(sq, n, cu);
+    int cu_len = limb_len(cu, 6);
+    return or_digits_distinct(cu, cu_len, base, indicator);
+}
+
+// ---------------------------------------------------------------------------
+// MSD prefix filter (mirrors nice_tpu/ops/msd_filter.py exactly; the
+// reference's unsound cross MSD x LSD check is intentionally omitted there
+// and therefore here — see that module's docstring)
+// ---------------------------------------------------------------------------
+
+constexpr int MAX_DIGITS = 200;  // cube of a 128-bit n in base >= 10
+
+struct Digits {
+    uint8_t d[MAX_DIGITS];  // LSD first
+    int len = 0;
+};
+
+inline void to_digits_asc(const u64* value_in, int cap, u64 base, Digits& out) {
+    u64 value[6];
+    std::memcpy(value, value_in, cap * sizeof(u64));
+    int len = limb_len(value, cap);
+    out.len = 0;
+    if (len == 0) {
+        out.d[out.len++] = 0;
+        return;
+    }
+    while (limbs_nonzero(value, len)) {
+        out.d[out.len++] = (uint8_t)div_limbs_inplace(value, len, base);
+    }
+}
+
+// Longest shared MSD prefix; writes into pre (MSD first).
+inline int common_msd_prefix(const Digits& a, const Digits& b, uint8_t* pre) {
+    int n = a.len < b.len ? a.len : b.len;
+    int out = 0;
+    for (int i = 0; i < n; ++i) {
+        uint8_t x = a.d[a.len - 1 - i];
+        if (x == b.d[b.len - 1 - i]) pre[out++] = x;
+        else break;
+    }
+    return out;
+}
+
+inline bool has_duplicate_digits(const uint8_t* d, int len) {
+    u128 seen = 0;
+    for (int i = 0; i < len; ++i) {
+        u128 bit = (u128)1 << d[i];
+        if (seen & bit) return true;
+        seen |= bit;
+    }
+    return false;
+}
+
+inline bool has_overlapping_digits(const uint8_t* d1, int l1, const uint8_t* d2,
+                                   int l2) {
+    u128 seen = 0;
+    for (int i = 0; i < l1; ++i) seen |= (u128)1 << d1[i];
+    for (int i = 0; i < l2; ++i)
+        if (seen & ((u128)1 << d2[i])) return true;
+    return false;
+}
+
+// Half-open [start, end); true when the whole range can be skipped.
+bool has_duplicate_msd_prefix(const u64 start[2], const u64 end[2], u64 base) {
+    u64 size_is_one[2] = {start[0] + 1, start[1] + (start[0] + 1 == 0 ? 1 : 0)};
+    if (cmp_2(size_is_one, end) == 0) return false;
+
+    u64 last[2] = {end[0] - 1, end[1] - (end[0] == 0 ? 1 : 0)};
+
+    u64 sq_first[4], sq_last[4];
+    mul_2x2(start, start, sq_first);
+    mul_2x2(last, last, sq_last);
+    Digits dsq_first, dsq_last;
+    to_digits_asc(sq_first, 4, base, dsq_first);
+    to_digits_asc(sq_last, 4, base, dsq_last);
+    if (dsq_first.len != dsq_last.len) return false;
+
+    uint8_t sq_prefix[MAX_DIGITS];
+    int sq_prefix_len = common_msd_prefix(dsq_first, dsq_last, sq_prefix);
+    if (has_duplicate_digits(sq_prefix, sq_prefix_len)) return true;
+
+    u64 cu_first[6], cu_last[6];
+    mul_4x2(sq_first, start, cu_first);
+    mul_4x2(sq_last, last, cu_last);
+    Digits dcu_first, dcu_last;
+    to_digits_asc(cu_first, 6, base, dcu_first);
+    to_digits_asc(cu_last, 6, base, dcu_last);
+    if (dcu_first.len != dcu_last.len) return false;
+
+    uint8_t cu_prefix[MAX_DIGITS];
+    int cu_prefix_len = common_msd_prefix(dcu_first, dcu_last, cu_prefix);
+    if (has_duplicate_digits(cu_prefix, cu_prefix_len)) return true;
+
+    return has_overlapping_digits(sq_prefix, sq_prefix_len, cu_prefix,
+                                  cu_prefix_len);
+}
+
+struct RangeVec {
+    std::vector<u64> flat;  // (start_lo, start_hi, end_lo, end_hi) per range
+};
+
+void valid_ranges_recursive(u64 start_lo, u64 start_hi, u64 end_lo, u64 end_hi,
+                            u64 base, int depth, int max_depth,
+                            u64 min_range_size, int subdivision_factor,
+                            RangeVec& out) {
+    u128 start = ((u128)start_hi << 64) | start_lo;
+    u128 end = ((u128)end_hi << 64) | end_lo;
+    u128 size = end - start;
+    u64 s[2] = {start_lo, start_hi};
+    u64 e[2] = {end_lo, end_hi};
+    if (depth >= max_depth || size <= min_range_size) {
+        out.flat.insert(out.flat.end(), {start_lo, start_hi, end_lo, end_hi});
+        return;
+    }
+    if (has_duplicate_msd_prefix(s, e, base)) return;
+    if (size < (u128)min_range_size * subdivision_factor) {
+        out.flat.insert(out.flat.end(), {start_lo, start_hi, end_lo, end_hi});
+        return;
+    }
+    u128 chunk = size / subdivision_factor;
+    for (int i = 0; i < subdivision_factor; ++i) {
+        u128 sub_start = start + (u128)i * chunk;
+        u128 sub_end = (i == subdivision_factor - 1) ? end : sub_start + chunk;
+        if (sub_start < sub_end) {
+            valid_ranges_recursive((u64)sub_start, (u64)(sub_start >> 64),
+                                   (u64)sub_end, (u64)(sub_end >> 64), base,
+                                   depth + 1, max_depth, min_range_size,
+                                   subdivision_factor, out);
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int nice_num_unique_digits(u64 n_lo, u64 n_hi, u64 base) {
+    u64 n[2] = {n_lo, n_hi};
+    return num_unique_digits_impl(n, base);
+}
+
+int nice_is_nice(u64 n_lo, u64 n_hi, u64 base) {
+    u64 n[2] = {n_lo, n_hi};
+    return is_nice_impl(n, base) ? 1 : 0;
+}
+
+// Detailed range loop over [start, start+count). hist must hold base+2 u64
+// slots. Near misses (num_uniques > cutoff) append (n_lo, n_hi, uniques)
+// triples to out_misses (capacity cap triples); the true count is returned
+// via *miss_count (callers re-run with a bigger buffer if it exceeds cap —
+// the reference treats overflow as a hard error, client_process_gpu.rs:859).
+void nice_process_range_detailed(u64 start_lo, u64 start_hi, u64 count,
+                                 u64 base, u64 cutoff, u64* hist,
+                                 u64* out_misses, u64 cap, u64* miss_count) {
+    u64 n[2] = {start_lo, start_hi};
+    u64 misses = 0;
+    for (u64 i = 0; i < count; ++i) {
+        int uniques = num_unique_digits_impl(n, base);
+        hist[uniques] += 1;
+        if ((u64)uniques > cutoff) {
+            if (misses < cap) {
+                out_misses[misses * 3] = n[0];
+                out_misses[misses * 3 + 1] = n[1];
+                out_misses[misses * 3 + 2] = (u64)uniques;
+            }
+            ++misses;
+        }
+        add_2(n, 1);
+    }
+    *miss_count = misses;
+}
+
+// Niceonly stride iteration over [start, end): start at the first valid
+// candidate at-or-after start (residue index start_idx, computed host-side
+// by the Python stride table), jump via the gap table, early-exit check each
+// candidate. Returns number of nice numbers found (also capped appends).
+void nice_iterate_range_strided(u64 first_lo, u64 first_hi, u64 start_idx,
+                                u64 end_lo, u64 end_hi, u64 base,
+                                const u64* gap_table, u64 num_residues,
+                                u64* out_nice, u64 cap, u64* nice_count) {
+    u64 n[2] = {first_lo, first_hi};
+    u64 end[2] = {end_lo, end_hi};
+    u64 idx = start_idx;
+    u64 found = 0;
+    while (cmp_2(n, end) < 0) {
+        if (is_nice_impl(n, base)) {
+            if (found < cap) {
+                out_nice[found * 2] = n[0];
+                out_nice[found * 2 + 1] = n[1];
+            }
+            ++found;
+        }
+        add_2(n, gap_table[idx]);
+        if (++idx == num_residues) idx = 0;
+    }
+    *nice_count = found;
+}
+
+int nice_has_duplicate_msd_prefix(u64 start_lo, u64 start_hi, u64 end_lo,
+                                  u64 end_hi, u64 base) {
+    u64 s[2] = {start_lo, start_hi};
+    u64 e[2] = {end_lo, end_hi};
+    return has_duplicate_msd_prefix(s, e, base) ? 1 : 0;
+}
+
+// Recursive MSD filter. Returns an opaque handle; read size + data, then free.
+void* nice_msd_valid_ranges(u64 start_lo, u64 start_hi, u64 end_lo, u64 end_hi,
+                            u64 base, int max_depth, u64 min_range_size,
+                            int subdivision_factor) {
+    auto* out = new RangeVec();
+    valid_ranges_recursive(start_lo, start_hi, end_lo, end_hi, base, 0,
+                           max_depth, min_range_size, subdivision_factor,
+                           *out);
+    return out;
+}
+
+u64 nice_ranges_count(void* handle) {
+    return ((RangeVec*)handle)->flat.size() / 4;
+}
+
+void nice_ranges_copy(void* handle, u64* out) {
+    auto* rv = (RangeVec*)handle;
+    std::memcpy(out, rv->flat.data(), rv->flat.size() * sizeof(u64));
+}
+
+void nice_ranges_free(void* handle) { delete (RangeVec*)handle; }
+
+}  // extern "C"

@@ -127,6 +127,102 @@ def _split_for_jax(range_: FieldSize, base: int, scalar_fn):
     return core, slivers
 
 
+def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults:
+    """Multi-threaded native CPU detailed loop (the analog of the reference's
+    rayon par_iter client, client/src/main.rs:154-207). ctypes releases the
+    GIL, so a thread pool gets real parallelism."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from nice_tpu import native
+    from nice_tpu.core import number_stats
+
+    if not native.available():
+        raise RuntimeError(
+            "backend='native' requested but the C++ library is unavailable "
+            "(no toolchain?); use backend='scalar' or 'jax'"
+        )
+    cutoff = number_stats.get_near_miss_cutoff(base)
+    total = range_.size()
+    chunk = max(65536, total // (threads * 8) or 1)
+    spans = [
+        (range_.start() + off, min(chunk, total - off))
+        for off in range(0, total, chunk)
+    ]
+    hist = np.zeros(base + 2, dtype=np.int64)
+    nice_numbers: list[NiceNumberSimple] = []
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for res in pool.map(
+            lambda s: native.process_range_detailed(s[0], s[1], base, cutoff),
+            spans,
+        ):
+            if res is None:
+                # Out-of-bounds base or >u128 values: exact scalar fallback.
+                raise RuntimeError(
+                    f"native backend does not support base {base} at this range; "
+                    "use backend='scalar'"
+                )
+            sub_hist, misses = res
+            np.add(hist, np.asarray(sub_hist, dtype=np.int64), out=hist)
+            nice_numbers.extend(
+                NiceNumberSimple(number=n, num_uniques=u) for n, u in misses
+            )
+    nice_numbers.sort(key=lambda n: n.number)
+    distribution = tuple(
+        UniquesDistributionSimple(num_uniques=i, count=int(hist[i]))
+        for i in range(1, base + 1)
+    )
+    return FieldResults(distribution=distribution, nice_numbers=tuple(nice_numbers))
+
+
+def _native_niceonly(range_: FieldSize, base: int, stride_table, threads: int) -> FieldResults:
+    """Native filter cascade: C++ MSD subdivision -> stride-table gap jumps ->
+    early-exit checks, fanned across threads per MSD range."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from nice_tpu import native
+    from nice_tpu.ops import msd_filter, stride_filter
+
+    if not native.available():
+        raise RuntimeError(
+            "backend='native' requested but the C++ library is unavailable "
+            "(no toolchain?); use backend='scalar' or 'jax'"
+        )
+    if stride_table is None:
+        stride_table = stride_filter.get_stride_table(base, 1)
+    if stride_table.num_residues == 0:
+        return FieldResults(distribution=(), nice_numbers=())
+
+    gap_table = stride_table.gap_table
+
+    def run(sub: FieldSize) -> list[int]:
+        first, idx = stride_table.first_valid_at_or_after(sub.start())
+        if first >= sub.end():
+            return []
+        found = native.iterate_range_strided(first, idx, sub.end(), base, gap_table)
+        if found is None:
+            raise RuntimeError(
+                f"native backend does not support base {base} at this range; "
+                "use backend='scalar'"
+            )
+        return found
+
+    ranges = msd_filter.get_valid_ranges(range_, base)
+    nice_numbers: list[NiceNumberSimple] = []
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for found in pool.map(run, ranges):
+            nice_numbers.extend(
+                NiceNumberSimple(number=n, num_uniques=base) for n in found
+            )
+    nice_numbers.sort(key=lambda n: n.number)
+    return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
+
+
+def _native_threads() -> int:
+    import os
+
+    return int(os.environ.get("NICE_THREADS", os.cpu_count() or 1))
+
+
 def process_range_detailed(
     range_: FieldSize,
     base: int,
@@ -136,6 +232,8 @@ def process_range_detailed(
     """Full histogram + near-miss list, exact, any backend."""
     if backend == "scalar":
         return scalar.process_range_detailed(range_, base)
+    if backend == "native":
+        return _native_detailed(range_, base, _native_threads())
     if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -215,6 +313,8 @@ def process_range_niceonly(
     enumeration arrives with the Pallas niceonly kernel."""
     if backend == "scalar":
         return scalar.process_range_niceonly(range_, base, stride_table)
+    if backend == "native":
+        return _native_niceonly(range_, base, stride_table, _native_threads())
     if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 

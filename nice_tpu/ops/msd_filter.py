@@ -163,5 +163,21 @@ def get_valid_ranges_recursive(
 
 
 def get_valid_ranges(range_: FieldSize, base: int) -> list[FieldSize]:
-    """Default-parameter wrapper (reference msd_prefix_filter.rs:665-674)."""
+    """Default-parameter wrapper (reference msd_prefix_filter.rs:665-674).
+
+    Uses the C++ implementation when available (the host-side hot path when
+    feeding range descriptors to the device, reference GPU pipeline
+    client_process_gpu.rs:624-660); falls back to the Python definition."""
+    from nice_tpu import native
+
+    res = native.msd_valid_ranges(
+        range_.start(),
+        range_.end(),
+        base,
+        MSD_RECURSIVE_MAX_DEPTH,
+        MSD_RECURSIVE_MIN_RANGE_SIZE,
+        MSD_RECURSIVE_SUBDIVISION_FACTOR,
+    )
+    if res is not None:
+        return [FieldSize(s, e) for s, e in res]
     return get_valid_ranges_recursive(range_, base)

@@ -55,8 +55,13 @@ def supports_base(plan: BasePlan) -> bool:
 
 
 def _effective_block_rows(batch_size: int, block_rows: int) -> int:
-    """Shrink the block for small batches (tests, tiny fields)."""
-    return min(block_rows, max(1, batch_size // 128))
+    """Largest block (<= block_rows) that tiles batch_size exactly — shrinks
+    for small batches and for batch sizes not divisible by the default block."""
+    import math
+
+    if batch_size % 128 != 0:
+        raise ValueError(f"batch_size must be a multiple of 128, got {batch_size}")
+    return math.gcd(batch_size // 128, block_rows)
 
 
 def _block_iota(block_rows: int):

@@ -1,0 +1,121 @@
+"""Differential tests: C++ native host engine vs the Python oracle — the
+analog of the reference's fixed-width-vs-malachite cross-checks
+(fixed_width.rs:259-335, msd_prefix_filter.rs:700-787)."""
+
+import random
+
+import pytest
+
+from nice_tpu import native
+from nice_tpu.core import base_range
+from nice_tpu.core.types import FieldSize
+from nice_tpu.ops import engine, msd_filter, scalar, stride_filter
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)"
+)
+
+
+def fresh_rng():
+    return random.Random(1337)
+
+
+def sample_points(base, rng, count=40):
+    br = base_range.get_base_range(base)
+    span = br[1] - br[0]
+    pts = [br[0], br[1] - 1]
+    pts += [br[0] + rng.randrange(span) for _ in range(count)]
+    return pts
+
+
+@pytest.mark.parametrize("base", [10, 17, 40, 50, 69, 80, 97])
+def test_num_unique_digits_matches_scalar(base):
+    rng = fresh_rng()
+    for n in sample_points(base, rng):
+        assert native.num_unique_digits(n, base) == scalar.get_num_unique_digits(
+            n, base
+        ), (base, n)
+
+
+@pytest.mark.parametrize("base", [10, 40, 80])
+def test_is_nice_matches_scalar(base):
+    rng = fresh_rng()
+    for n in sample_points(base, rng):
+        assert native.is_nice(n, base) == scalar.get_is_nice(n, base), (base, n)
+    assert native.is_nice(69, 10)
+
+
+def test_native_detailed_b10_golden():
+    got = engine.process_range_detailed(FieldSize(47, 100), 10, backend="native")
+    want = scalar.process_range_detailed(FieldSize(47, 100), 10)
+    assert got == want
+    assert [(n.number, n.num_uniques) for n in got.nice_numbers] == [(69, 10)]
+
+
+@pytest.mark.parametrize("base", [40, 80])
+def test_native_detailed_matches_scalar_10k(base):
+    br = base_range.get_base_range_field(base)
+    rng_ = FieldSize(br.start(), br.start() + 10_000)
+    got = engine.process_range_detailed(rng_, base, backend="native")
+    want = scalar.process_range_detailed(rng_, base)
+    assert got == want
+
+
+def test_native_detailed_near_misses_b17():
+    rng_ = FieldSize(4913, 9913)
+    got = engine.process_range_detailed(rng_, 17, backend="native")
+    want = scalar.process_range_detailed(rng_, 17)
+    assert got == want
+    assert len(want.nice_numbers) == 2
+
+
+@pytest.mark.parametrize("base", [10, 17, 40, 62])
+def test_msd_prefix_matches_python(base):
+    rng = fresh_rng()
+    br = base_range.get_base_range(base)
+    span = br[1] - br[0]
+    for _ in range(60):
+        size = rng.choice([2, 5, 251, 1000, 100_000])
+        if span <= size:
+            continue
+        start = br[0] + rng.randrange(span - size)
+        fs = FieldSize(start, start + size)
+        assert native.has_duplicate_msd_prefix(
+            fs.start(), fs.end(), base
+        ) == msd_filter.has_duplicate_msd_prefix(fs, base), (base, fs)
+
+
+@pytest.mark.parametrize("base", [20, 40, 50])
+def test_msd_valid_ranges_matches_python(base):
+    br = base_range.get_base_range_field(base)
+    fs = FieldSize(br.start(), br.start() + 3_000_000)
+    got = msd_filter.get_valid_ranges(fs, base)  # native-backed
+    want = msd_filter.get_valid_ranges_recursive(fs, base)  # pure Python
+    assert [(r.start(), r.end()) for r in got] == [
+        (r.start(), r.end()) for r in want
+    ]
+
+
+@pytest.mark.parametrize("base", [10, 20, 40])
+def test_native_niceonly_matches_scalar(base):
+    br = base_range.get_base_range_field(base)
+    fs = FieldSize(br.start(), min(br.end(), br.start() + 50_000))
+    got = engine.process_range_niceonly(fs, base, backend="native")
+    want = scalar.process_range_niceonly(fs, base)
+    assert sorted(n.number for n in got.nice_numbers) == sorted(
+        n.number for n in want.nice_numbers
+    )
+
+
+def test_native_strided_iteration_wraparound():
+    """Start mid-modulus so the first_valid search wraps (reference edge case,
+    client_process_gpu.rs:1068-1075)."""
+    base = 20
+    table = stride_filter.get_stride_table(base, 1)
+    br = base_range.get_base_range(base)
+    start = br[0] + table.modulus - 3
+    fs = FieldSize(start, start + 2 * table.modulus)
+    first, idx = table.first_valid_at_or_after(fs.start())
+    got = native.iterate_range_strided(first, idx, fs.end(), base, table.gap_table)
+    want = [n.number for n in table.iterate_range(fs, base)]
+    assert got == want

@@ -38,11 +38,14 @@ def _build() -> bool:
         if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
             return True
         # Compile to a process-unique temp path and atomically rename: another
-        # process may be dlopen-ing the current .so while we rebuild.
+        # process may be dlopen-ing the current .so while we rebuild. CXX and
+        # CXXFLAGS match the Makefile's single recipe.
         tmp = f"{_LIB}.{os.getpid()}.tmp"
+        cxx = os.environ.get("CXX", "g++")
+        flags = os.environ.get("CXXFLAGS", "-O3 -fPIC -shared -std=c++17").split()
         try:
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", tmp],
+                [cxx, *flags, _SRC, "-o", tmp],
                 check=True,
                 capture_output=True,
                 timeout=120,
@@ -192,7 +195,7 @@ def iterate_range_strided(first: int, start_idx: int, end: int, base: int,
 
 def has_duplicate_msd_prefix(start: int, end: int, base: int) -> bool | None:
     lib = _load()
-    if lib is None or end > 1 << 128 or not _base_ok(base):
+    if lib is None or end >= 1 << 128 or not _base_ok(base):
         return None
     slo, shi = _split(start)
     elo, ehi = _split(end)
@@ -204,7 +207,7 @@ def msd_valid_ranges(start: int, end: int, base: int, max_depth: int,
     """[(sub_start, sub_end), ...] surviving the recursive MSD filter.
     None => no native library (callers use the Python implementation)."""
     lib = _load()
-    if lib is None or end > 1 << 128 or not _base_ok(base):
+    if lib is None or end >= 1 << 128 or not _base_ok(base):
         return None
     slo, shi = _split(start)
     elo, ehi = _split(end)

@@ -156,7 +156,9 @@ def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults
             spans,
         ):
             if res is None:
-                # Out-of-bounds base or >u128 values: exact scalar fallback.
+                # Out-of-bounds base or >u128 values; the caller picked the
+                # native backend explicitly, so raise rather than silently
+                # switching engines mid-field.
                 raise RuntimeError(
                     f"native backend does not support base {base} at this range; "
                     "use backend='scalar'"
@@ -221,6 +223,107 @@ def _native_threads() -> int:
     import os
 
     return int(os.environ.get("NICE_THREADS", os.cpu_count() or 1))
+
+
+def _host_strided_scan(table, base: int, start: int, end: int) -> list[int]:
+    """Exact nice numbers among stride candidates in [start, end) (host path,
+    native C++ when available)."""
+    from nice_tpu import native
+
+    if start >= end:
+        return []
+    first, idx = table.first_valid_at_or_after(start)
+    if first >= end:
+        return []
+    found = native.iterate_range_strided(first, idx, end, base, table.gap_table)
+    if found is None:
+        return [
+            n.number for n in table.iterate_range(FieldSize(start, end), base)
+        ]
+    return found
+
+
+def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
+    """Device niceonly: host MSD filter (coarse floor) -> stride-compacted
+    descriptor batches on the TPU -> host re-scan of hit descriptors.
+
+    The heterogeneous pipeline of the reference GPU path
+    (client_process_gpu.rs:589-709): the host filter produces range
+    descriptors, the device counts nice candidates per descriptor by index
+    arithmetic (P7), and only descriptors with hits are re-enumerated on the
+    host to recover the actual numbers. A count/re-scan mismatch raises (the
+    reference treats inconsistent device output as a hard error,
+    client_process_gpu.rs:776-781).
+    """
+    import os
+
+    from nice_tpu.ops import msd_filter, stride_filter
+
+    plan = get_plan(base)
+    table = stride_filter.get_stride_table(base, 1)
+    if table.num_residues == 0:
+        return []
+    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
+    modulus = table.modulus
+    if pe._interpret():
+        desc_max, periods = 8, 8  # keep interpreter-mode tests fast
+    else:
+        desc_max, periods = pe.STRIDED_DESC_MAX, pe.STRIDED_PERIODS
+    span = periods * modulus
+
+    # Coarse host filter: cheap lanes make a high recursion floor optimal
+    # (reference floor sweep, client_process_gpu.rs:85-94; env override
+    # mirrors NICE_GPU_MSD_FLOOR).
+    floor = int(os.environ.get("NICE_TPU_MSD_FLOOR", 65536))
+    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=floor)
+
+    descs: list[tuple[int, int, int]] = []
+    for r in ranges:
+        lo, hi = r.start(), r.end()
+        n0 = (lo // modulus) * modulus
+        while n0 < hi:
+            descs.append((n0, lo, hi))
+            n0 += span
+
+    nice: list[int] = []
+    pending: deque = deque()
+
+    def pack(group: list[tuple[int, int, int]]) -> np.ndarray:
+        arr = np.zeros((desc_max, 12), dtype=np.uint32)
+        for i, (n0, lo, hi) in enumerate(group):
+            arr[i, 0:4] = int_to_limbs(n0, 4)
+            arr[i, 4:8] = int_to_limbs(lo, 4)
+            arr[i, 8:12] = int_to_limbs(hi, 4)
+        return arr
+
+    def collect_one():
+        group, counts_dev = pending.popleft()
+        counts = np.asarray(counts_dev).reshape(-1)
+        for i, (n0, lo, hi) in enumerate(group):
+            count = int(counts[i])
+            if count == 0:
+                continue
+            found = _host_strided_scan(
+                table, base, max(lo, n0), min(hi, n0 + span)
+            )
+            if len(found) != count:
+                raise RuntimeError(
+                    f"device/host nice-count mismatch in descriptor "
+                    f"(n0={n0}, [{lo},{hi})): device {count}, host {len(found)}"
+                )
+            nice.extend(found)
+
+    for off in range(0, len(descs), desc_max):
+        group = descs[off : off + desc_max]
+        counts = pe.niceonly_strided_batch(
+            plan, spec, pack(group), periods=periods
+        )
+        pending.append((group, counts))
+        if len(pending) >= 4:
+            collect_one()
+    while pending:
+        collect_one()
+    return nice
 
 
 def process_range_detailed(
@@ -334,9 +437,20 @@ def process_range_niceonly(
 
     plan = get_plan(base)
     backend = _pick_backend(plan, batch_size, backend)
-    dense_fn = (
-        pe.niceonly_dense_batch if backend == "pallas" else ve.niceonly_dense_batch
-    )
+    if backend == "pallas" and plan.limbs_n > 4:
+        backend = "jnp"  # strided descriptors carry candidates as 4 u32 limbs
+    if backend == "pallas":
+        # Stride-compacted device path (builds its own k=1 table — the 2D
+        # period x residue layout wants a small residue set; any passed
+        # stride_table only parameterizes the scalar/host paths).
+        nice_numbers.extend(
+            NiceNumberSimple(number=n, num_uniques=base)
+            for n in _niceonly_pallas(core, base)
+        )
+        nice_numbers.sort(key=lambda n: n.number)
+        return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
+
+    dense_fn = ve.niceonly_dense_batch
     pending: deque = deque()
 
     def collect_one():

@@ -162,8 +162,17 @@ def get_valid_ranges_recursive(
     return valid_ranges
 
 
-def get_valid_ranges(range_: FieldSize, base: int) -> list[FieldSize]:
+def get_valid_ranges(
+    range_: FieldSize,
+    base: int,
+    min_range_size: int = MSD_RECURSIVE_MIN_RANGE_SIZE,
+    max_depth: int = MSD_RECURSIVE_MAX_DEPTH,
+) -> list[FieldSize]:
     """Default-parameter wrapper (reference msd_prefix_filter.rs:665-674).
+
+    min_range_size is the recursion floor: device consumers raise it (the
+    reference GPU's adaptive floor, client_process_gpu.rs:103-156) because a
+    coarser filter trades host CPU time for cheap device lanes.
 
     Uses the C++ implementation when available (the host-side hot path when
     feeding range descriptors to the device, reference GPU pipeline
@@ -174,10 +183,12 @@ def get_valid_ranges(range_: FieldSize, base: int) -> list[FieldSize]:
         range_.start(),
         range_.end(),
         base,
-        MSD_RECURSIVE_MAX_DEPTH,
-        MSD_RECURSIVE_MIN_RANGE_SIZE,
+        max_depth,
+        min_range_size,
         MSD_RECURSIVE_SUBDIVISION_FACTOR,
     )
     if res is not None:
         return [FieldSize(s, e) for s, e in res]
-    return get_valid_ranges_recursive(range_, base)
+    return get_valid_ranges_recursive(
+        range_, base, max_depth=max_depth, min_range_size=min_range_size
+    )

@@ -158,6 +158,149 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
 
 
 # --------------------------------------------------------------------------
+# Stride-compacted niceonly kernel (P7 candidate compaction)
+# --------------------------------------------------------------------------
+#
+# Candidates are enumerated by index arithmetic from the CRT stride table —
+# n = n0 + row * M + residues[col] — laid out as a 2D block: periods along
+# sublanes, residue slots along lanes. No gather: the residue table is a
+# broadcast row. This is the TPU analog of the reference GPU's on-device
+# candidate reconstruction B0 + (g/R)*M + residues[g%R]
+# (nice_kernels.cu:452-457); the 2D layout replaces the div/mod entirely.
+#
+# One execution processes up to STRIDED_DESC_MAX range descriptors (one per
+# outer grid step; the inner grid walks residue tiles), because each
+# pallas_call execution carries a fixed dispatch latency — the analog of the
+# reference batching 65k ranges per launch (client_process_gpu.rs:667-682).
+# Each descriptor is (n0 limbs, range-lo limbs, range-hi limbs) packed into a
+# scalar-prefetched u32 row; per-descriptor nice counts land in the SMEM
+# stats tile so the host re-scans only descriptors that actually hit.
+
+STRIDED_DESC_MAX = 1024  # descriptors per execution (stats tile rows 0..7)
+STRIDED_PERIODS = 128    # stride periods per descriptor (block sublanes)
+_DESC_WIDTH = 12         # u32 fields per descriptor: n0[4] lo[4] hi[4]
+
+
+class StrideSpec:
+    """Hashable trace-time stride constants (modulus + residue table)."""
+
+    def __init__(self, modulus: int, residues: tuple):
+        assert modulus < 1 << 32 and STRIDED_PERIODS * modulus < 1 << 32
+        self.modulus = modulus
+        self.residues = tuple(int(r) for r in residues)
+
+    def __hash__(self):
+        return hash((self.modulus, self.residues))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, StrideSpec)
+            and self.modulus == other.modulus
+            and self.residues == other.residues
+        )
+
+    @property
+    def num_residues(self) -> int:
+        return len(self.residues)
+
+    @property
+    def residue_tiles(self) -> int:
+        return max(1, -(-len(self.residues) // 128))
+
+    def padded_residues(self) -> np.ndarray:
+        out = np.zeros((self.residue_tiles, 128), dtype=np.uint32)
+        flat = out.reshape(-1)
+        flat[: self.num_residues] = self.residues
+        return out
+
+    def descriptor_span(self) -> int:
+        """Numbers covered by one descriptor's period block."""
+        return STRIDED_PERIODS * self.modulus
+
+
+def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int):
+    R = spec.num_residues
+    M = np.uint32(spec.modulus)
+
+    def kernel(desc_ref, res_ref, out_ref):
+        d = pl.program_id(0)
+        rt = pl.program_id(1)
+
+        @pl.when((d == 0) & (rt == 0))
+        def _():
+            for r in range(8):
+                for c in range(128):
+                    out_ref[r, c] = 0
+
+        row = jax.lax.broadcasted_iota(jnp.uint32, (periods, 128), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (periods, 128), 1)
+        n0 = [
+            jnp.full((periods, 128), desc_ref[d, i], dtype=jnp.uint32)
+            for i in range(plan.limbs_n)
+        ]
+        n = ve.add_u32(n0, row * M)
+        res_row = jnp.broadcast_to(
+            res_ref[pl.ds(rt, 1), :], (periods, 128)
+        ).astype(jnp.uint32)
+        n = ve.add_u32(n, res_row)
+
+        lo = [desc_ref[d, 4 + i] for i in range(plan.limbs_n)]
+        hi = [desc_ref[d, 8 + i] for i in range(plan.limbs_n)]
+        valid = (col + rt * 128 < R) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
+
+        uniques = ve.num_uniques_lanes(plan, n)
+        cnt = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
+        out_ref[d // 128, d % 128] += cnt
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
+                      periods: int):
+    assert num_desc <= STRIDED_DESC_MAX
+    assert plan.limbs_n <= 4
+    res = spec.padded_residues()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # descriptor table lands in SMEM
+        grid=(num_desc, spec.residue_tiles),
+        in_specs=[
+            # Whole residue table resident in VMEM; the kernel dynamic-slices
+            # its residue tile (a (1,128) block would violate sublane tiling).
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (8, 128), lambda d, rt, *_: (0, 0), memory_space=pltpu.SMEM
+        ),
+    )
+    call = pl.pallas_call(
+        _make_strided_kernel(plan, spec, periods),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )
+
+    @jax.jit
+    def run(desc):
+        return call(desc, res)
+
+    return run
+
+
+def niceonly_strided_batch(plan: BasePlan, spec: StrideSpec, desc: np.ndarray,
+                           periods: int = STRIDED_PERIODS):
+    """Per-descriptor nice counts (i32[8,128], flattened index = descriptor row).
+
+    desc: u32[num_desc, 12] rows of (n0 limbs[4], lo limbs[4], hi limbs[4]),
+    LSW first, zero-padded. Each descriptor counts nice numbers among stride
+    candidates n = n0 + p*M + residues[j] (p < periods) with lo <= n < hi.
+    """
+    assert desc.ndim == 2 and desc.shape[1] == _DESC_WIDTH, desc.shape
+    run = _strided_callable(plan, spec, desc.shape[0], periods)
+    return run(desc)
+
+
+# --------------------------------------------------------------------------
 # Per-lane uniques (rare-path near-miss / nice extraction)
 # --------------------------------------------------------------------------
 

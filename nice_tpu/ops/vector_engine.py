@@ -91,6 +91,22 @@ def add_u32(limbs: list, x) -> list:
     return out
 
 
+def limbs_lt(a: list, b: list):
+    """Elementwise a < b for equal-length LSW-first limb lists (entries may be
+    arrays or broadcastable scalars)."""
+    assert len(a) == len(b)
+    lt = a[-1] < b[-1]
+    eq = a[-1] == b[-1]
+    for i in range(len(a) - 2, -1, -1):
+        lt = lt | (eq & (a[i] < b[i]))
+        eq = eq & (a[i] == b[i])
+    return lt
+
+
+def limbs_ge(a: list, b: list):
+    return ~limbs_lt(a, b)
+
+
 # --------------------------------------------------------------------------
 # Digit extraction (chunked radix, constant divisors)
 # --------------------------------------------------------------------------

@@ -91,6 +91,81 @@ def test_detailed_kernel_matches_scalar_b80():
     assert int(nm) == want_nm
 
 
+def _stride_spec(base):
+    from nice_tpu.ops import stride_filter
+
+    t = stride_filter.get_stride_table(base, 1)
+    return t, pe.StrideSpec(t.modulus, tuple(t.valid_residues))
+
+
+def test_strided_kernel_b10_finds_69():
+    plan = get_plan(10)
+    table, spec = _stride_spec(10)
+    periods = 4
+    desc = np.zeros((2, 12), dtype=np.uint32)
+    # descriptor 0 covers [47, 100): n0 = floor(47/M)*M
+    n0 = (47 // spec.modulus) * spec.modulus
+    from nice_tpu.ops.limbs import int_to_limbs as itl
+
+    desc[0, 0:4] = itl(n0, 4)
+    desc[0, 4:8] = itl(47, 4)
+    desc[0, 8:12] = itl(100, 4)
+    counts = np.asarray(
+        pe.niceonly_strided_batch(plan, spec, desc, periods=periods)
+    ).reshape(-1)
+    assert counts[0] == 1  # 69
+    assert counts[1:].sum() == 0  # empty descriptor contributes nothing
+
+
+@pytest.mark.parametrize("base", [20, 40])
+def test_strided_kernel_counts_match_host(base):
+    """Device per-descriptor counts == host stride-table scan, including
+    range-edge masking and period padding (the mirror-test pattern,
+    client_process_gpu.rs:988-1075)."""
+    plan = get_plan(base)
+    table, spec = _stride_spec(base)
+    br = base_range.get_base_range(base)
+    periods = 4
+    span = periods * spec.modulus
+    from nice_tpu.ops.limbs import int_to_limbs as itl
+
+    # ragged range: starts/ends mid-period
+    lo = br[0] + 7
+    hi = lo + 2 * span + 311
+    desc_rows = []
+    n0 = (lo // spec.modulus) * spec.modulus
+    while n0 < hi:
+        desc_rows.append((n0, lo, hi))
+        n0 += span
+    desc = np.zeros((len(desc_rows), 12), dtype=np.uint32)
+    for i, (n0_, lo_, hi_) in enumerate(desc_rows):
+        desc[i, 0:4] = itl(n0_, 4)
+        desc[i, 4:8] = itl(lo_, 4)
+        desc[i, 8:12] = itl(hi_, 4)
+    counts = np.asarray(
+        pe.niceonly_strided_batch(plan, spec, desc, periods=periods)
+    ).reshape(-1)
+    for i, (n0_, lo_, hi_) in enumerate(desc_rows):
+        s, e = max(lo_, n0_), min(hi_, n0_ + span)
+        want = sum(
+            1
+            for n in table.iterate_range(FieldSize(s, e), base)
+        )
+        # count candidates that are nice
+        assert counts[i] == want, (base, i, desc_rows[i])
+
+
+def test_engine_pallas_niceonly_matches_scalar_b20():
+    base = 20
+    br = base_range.get_base_range_field(base)
+    fs = FieldSize(br.start(), min(br.end(), br.start() + 9_000))
+    got = engine.process_range_niceonly(fs, base, backend="pallas", batch_size=BL)
+    want = scalar.process_range_niceonly(fs, base)
+    assert sorted(n.number for n in got.nice_numbers) == sorted(
+        n.number for n in want.nice_numbers
+    )
+
+
 def test_engine_explicit_pallas_backend_b10():
     """End-to-end engine run through the Pallas path (interpreted), including
     the rare-path near-miss extraction."""

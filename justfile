@@ -1,0 +1,46 @@
+# just recipes (reference justfile parity)
+
+# run the full test suite (CPU-only, Pallas interpreter mode)
+test:
+    python -m pytest tests/ -q
+
+# quick subset: core + filters + native differentials
+test-fast:
+    python -m pytest tests/test_base_range.py tests/test_core_misc.py \
+        tests/test_filters.py tests/test_native.py -q
+
+# build the C++ native host engine
+native:
+    make -C nice_tpu/native
+
+# real-chip benchmark, one JSON line (NICE_BENCH_MODE to pick the field)
+bench:
+    python bench.py
+
+# offline client benchmark across the suite
+benchmark mode="extra-large" backend="jax":
+    python -m nice_tpu.client --benchmark {{mode}} --backend {{backend}}
+
+# serve the API + dashboard on :8127 (seeds base 40 on first run)
+serve db="nice.db":
+    python -m nice_tpu.server --db {{db}} --init-base 40
+
+# run one claim->process->submit iteration against a local server
+client api="http://127.0.0.1:8127":
+    python -m nice_tpu.client detailed --api-base {{api}}
+
+# consensus + stats + cache refresh pass
+jobs db="nice.db":
+    python -m nice_tpu.jobs --db {{db}}
+
+# filter effectiveness report (cached by parameter hash)
+filter-effectiveness base="40":
+    python scripts/filter_effectiveness.py --base {{base}}
+
+# audit the C++ MSD filter against the Python definition
+msd-crosscheck:
+    python scripts/msd_crosscheck.py
+
+# profile the engine hot path with cProfile
+profile mode="large":
+    NICE_BENCH_MODE={{mode}} python -m cProfile -s cumtime bench.py | head -40

@@ -311,6 +311,14 @@ def make_handler(ctx: ApiContext):
                     self._send(
                         200, ctx.metrics.render(), content_type="text/plain"
                     )
+                elif method == "GET" and path == "/stats/bases":
+                    self._send(200, ctx.db.get_base_stats())
+                elif method == "GET" and path == "/stats/leaderboard":
+                    self._send(200, ctx.db.get_leaderboard())
+                elif method == "GET" and path == "/stats/search_rate":
+                    self._send(200, ctx.db.get_search_rate())
+                elif method == "GET" and self._try_static(path):
+                    pass  # served from web/
                 elif method == "POST" and path == "/submit":
                     length = int(self.headers.get("Content-Length", 0))
                     try:
@@ -330,6 +338,39 @@ def make_handler(ctx: ApiContext):
                 self._error(500, f"Internal server error: {e}")
             finally:
                 ctx.metrics.record(endpoint, status, time.monotonic() - t0)
+
+        def _try_static(self, path: str) -> bool:
+            """Serve the analytics dashboard + browser search page from web/
+            (the reference hosts these as a separate static site; co-hosting
+            them keeps the single-binary deployment simple)."""
+            import os
+
+            web_root = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "web"
+            )
+            rel = path.lstrip("/") or "index.html"
+            full = os.path.realpath(os.path.join(web_root, rel))
+            if os.path.isdir(full):
+                full = os.path.join(full, "index.html")
+            if not full.startswith(os.path.realpath(web_root) + os.sep):
+                return False
+            if not os.path.isfile(full):
+                return False
+            ctype = {
+                ".html": "text/html",
+                ".js": "application/javascript",
+                ".css": "text/css",
+                ".json": "application/json",
+            }.get(os.path.splitext(full)[1], "application/octet-stream")
+            with open(full, "rb") as f:
+                raw = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(raw)))
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.end_headers()
+            self.wfile.write(raw)
+            return True
 
         def do_GET(self):
             self._route("GET")

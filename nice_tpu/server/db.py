@@ -605,6 +605,63 @@ class Db:
                 "SELECT * FROM chunks WHERE base_id = ? ORDER BY id ASC", (base,)
             ).fetchall()
 
+    # -- analytics (dashboard REST surface; reference serves these via
+    # PostgREST views over the same tables, web/index.html:203-276) ---------
+
+    def get_base_stats(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM bases ORDER BY id ASC"
+            ).fetchall()
+        out = []
+        for r in rows:
+            out.append(
+                {
+                    "base": r["id"],
+                    "range_start": str(unpad(r["range_start"])),
+                    "range_end": str(unpad(r["range_end"])),
+                    "range_size": str(unpad(r["range_size"])),
+                    "checked_detailed": str(unpad(r["checked_detailed"])),
+                    "checked_niceonly": str(unpad(r["checked_niceonly"])),
+                    "minimum_cl": r["minimum_cl"],
+                    "niceness_mean": r["niceness_mean"],
+                    "niceness_stdev": r["niceness_stdev"],
+                    "distribution": json.loads(r["distribution"]),
+                    "numbers": json.loads(r["numbers"]),
+                }
+            )
+        return out
+
+    def get_leaderboard(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM cache_leaderboard ORDER BY"
+                " CAST(numbers_checked AS TEXT) DESC"
+            ).fetchall()
+        return [
+            {
+                "username": r["username"],
+                "submissions": r["submissions"],
+                "numbers_checked": str(unpad(r["numbers_checked"])),
+                "last_submission": r["last_submission"],
+            }
+            for r in rows
+        ]
+
+    def get_search_rate(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM cache_search_rate ORDER BY hour ASC"
+            ).fetchall()
+        return [
+            {
+                "hour": r["hour"],
+                "searched_detailed": str(unpad(r["searched_detailed"])),
+                "searched_niceonly": str(unpad(r["searched_niceonly"])),
+            }
+            for r in rows
+        ]
+
     # -- caches ------------------------------------------------------------
 
     def refresh_search_caches(self) -> None:

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Print the valid search interval for each base (reference
+scripts/base_bounds.rs): the n-range where digits(n^2)+digits(n^3) == base.
+
+Usage: python scripts/base_bounds.py [--min 4] [--max 120]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--min", type=int, default=4)
+    p.add_argument("--max", type=int, default=120)
+    args = p.parse_args()
+    print(f"{'base':>5} {'range_start':>28} {'range_end':>28} {'size':>14}")
+    for base in range(args.min, args.max + 1):
+        r = base_range.get_base_range(base)
+        if r is None:
+            continue
+        print(f"{base:>5} {r[0]:>28} {r[1]:>28} {r[1] - r[0]:>14.3e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

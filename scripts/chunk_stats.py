@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Per-chunk progress stats from the coordination ledger (reference
+scripts/chunk_stats.rs).
+
+Usage: python scripts/chunk_stats.py --db nice.db [--base 40]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.server.db import Db, unpad  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--db", default="nice.db")
+    p.add_argument("--base", type=int, default=None)
+    args = p.parse_args()
+    db = Db(args.db)
+    try:
+        bases = [args.base] if args.base else db.get_bases()
+        for base in bases:
+            chunks = db.get_chunks_in_base(base)
+            print(f"base {base}: {len(chunks)} chunks")
+            print(f"{'chunk':>8} {'size':>14} {'checked_nice':>13} "
+                  f"{'checked_det':>12} {'minimum_cl':>10}")
+            for c in chunks:
+                size = unpad(c["range_end"]) - unpad(c["range_start"])
+                fmt = lambda v: "-" if v is None else v
+                print(
+                    f"{c['id']:>8} {size:>14} {fmt(c['checked_niceonly']):>13} "
+                    f"{fmt(c['checked_detailed']):>12} {fmt(c['minimum_cl']):>10}"
+                )
+    finally:
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Measure the kill rate of each niceonly filter on sample ranges (reference
+scripts/filter_effectiveness.rs): residue (mod b-1), LSD (mod b^k), the
+combined CRT stride, and the recursive MSD prefix filter.
+
+Results are cached under scripts/.cache keyed by the SHA-256 of the
+parameters (reference filter_effectiveness.rs:22-31).
+
+Usage: python scripts/filter_effectiveness.py --base 40 --size 1000000 [--k 1]
+"""
+
+import argparse
+import hashlib
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.core.types import FieldSize  # noqa: E402
+from nice_tpu.ops import lsd_filter, msd_filter, residue_filter  # noqa: E402
+from nice_tpu.ops.stride_filter import get_stride_table  # noqa: E402
+
+CACHE_DIR = Path(__file__).resolve().parent / ".cache"
+
+
+def measure(base: int, start: int, size: int, k: int) -> dict:
+    rng = FieldSize(start, start + size)
+    b1 = base - 1
+    residues = set(residue_filter.get_residue_filter(base))
+    lsd_bitmap = lsd_filter.get_valid_multi_lsd_bitmap(base, k)
+    table = get_stride_table(base, k)
+
+    residue_pass = sum(1 for n in range(start, start + size) if n % b1 in residues)
+    lsd_pass = sum(1 for n in range(start, start + size) if lsd_bitmap[n % base**k])
+    stride_pass = table.count_candidates(rng)
+
+    t0 = time.monotonic()
+    surviving = msd_filter.get_valid_ranges(rng, base)
+    msd_time = time.monotonic() - t0
+    msd_pass = sum(r.size() for r in surviving)
+
+    return {
+        "base": base,
+        "start": start,
+        "size": size,
+        "k": k,
+        "residue_survival": residue_pass / size,
+        "lsd_survival": lsd_pass / size,
+        "stride_survival": stride_pass / size,
+        "msd_survival": msd_pass / size,
+        "msd_filter_secs": round(msd_time, 4),
+        "msd_surviving_ranges": len(surviving),
+        "combined_survival": (msd_pass / size) * (stride_pass / size),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", type=int, default=40)
+    p.add_argument("--size", type=int, default=1_000_000)
+    p.add_argument("--start", type=int, default=None, help="default: range start")
+    p.add_argument("--k", type=int, default=1)
+    p.add_argument("--no-cache", action="store_true")
+    args = p.parse_args()
+
+    r = base_range.get_base_range(args.base)
+    if r is None:
+        print(f"base {args.base} has no valid range", file=sys.stderr)
+        return 1
+    start = args.start if args.start is not None else r[0]
+
+    key = hashlib.sha256(
+        json.dumps([args.base, start, args.size, args.k]).encode()
+    ).hexdigest()[:16]
+    cache_file = CACHE_DIR / f"filter_effectiveness_{key}.json"
+    if cache_file.exists() and not args.no_cache:
+        print(cache_file.read_text().strip())
+        return 0
+
+    out = measure(args.base, start, args.size, args.k)
+    CACHE_DIR.mkdir(exist_ok=True)
+    cache_file.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

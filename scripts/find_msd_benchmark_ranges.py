@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Locate windows where the MSD filter is unusually effective or ineffective
+(reference scripts/find_msd_benchmark_ranges.rs:10-39) — the source of the
+msd-effective / msd-ineffective benchmark fields.
+
+Scans windows across a base's range, measuring surviving fraction after the
+recursive filter, and prints the extremes.
+
+Usage: python scripts/find_msd_benchmark_ranges.py --base 50 --window 10000000 --samples 64
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.core.types import FieldSize  # noqa: E402
+from nice_tpu.ops import msd_filter  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", type=int, default=50)
+    p.add_argument("--window", type=int, default=10_000_000)
+    p.add_argument("--samples", type=int, default=64)
+    args = p.parse_args()
+    lo, hi = base_range.get_base_range(args.base)
+    span = hi - lo - args.window
+    if span <= 0:
+        print("window larger than base range", file=sys.stderr)
+        return 1
+    results = []
+    for i in range(args.samples):
+        start = lo + (span * i) // max(1, args.samples - 1)
+        fs = FieldSize(start, start + args.window)
+        surviving = msd_filter.get_valid_ranges(fs, args.base)
+        frac = sum(r.size() for r in surviving) / args.window
+        results.append((frac, start))
+        print(f"start={start} surviving={frac:.4f} ranges={len(surviving)}")
+    results.sort()
+    print(f"\nmost effective (least surviving): start={results[0][1]} "
+          f"({results[0][0]:.4f} surviving)")
+    print(f"least effective (most surviving): start={results[-1][1]} "
+          f"({results[-1][0]:.4f} surviving)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

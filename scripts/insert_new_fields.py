@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Seed the coordination ledger with a base's chunks + fields (reference
+scripts/insert_new_fields.rs).
+
+Usage: python scripts/insert_new_fields.py --db nice.db --base 40 [--field-size 1000000000]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.server.db import Db  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--db", default="nice.db")
+    p.add_argument("--base", type=int, required=True, action="append")
+    p.add_argument("--field-size", type=int, default=1_000_000_000)
+    args = p.parse_args()
+    db = Db(args.db)
+    try:
+        for base in args.base:
+            n = db.seed_base(base, args.field_size)
+            print(f"seeded base {base}: {n} fields")
+    finally:
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

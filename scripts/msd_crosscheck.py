@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Audit the C++ MSD prefix filter against the Python definition on random
+ranges (reference scripts/msd_crosscheck.rs: fixed-width vs malachite audit).
+
+Usage: python scripts/msd_crosscheck.py [--iters 500] [--seed 42]
+"""
+
+import argparse
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu import native  # noqa: E402
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.core.types import FieldSize  # noqa: E402
+from nice_tpu.ops import msd_filter  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=500)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--bases", type=int, nargs="*", default=[10, 17, 20, 40, 44, 50, 62, 80, 97])
+    args = p.parse_args()
+
+    if not native.available():
+        print("native library unavailable; nothing to crosscheck", file=sys.stderr)
+        return 1
+
+    rng = random.Random(args.seed)
+    checked = mismatches = 0
+    for _ in range(args.iters):
+        base = rng.choice(args.bases)
+        r = base_range.get_base_range(base)
+        span = r[1] - r[0]
+        size = rng.choice([2, 10, 251, 4096, 100_000])
+        if span <= size:
+            continue
+        start = r[0] + rng.randrange(span - size)
+        fs = FieldSize(start, start + size)
+        want = msd_filter.has_duplicate_msd_prefix(fs, base)
+        got = native.has_duplicate_msd_prefix(fs.start(), fs.end(), base)
+        checked += 1
+        if got != want:
+            mismatches += 1
+            print(f"MISMATCH base={base} range=[{fs.start()},{fs.end()}): "
+                  f"native={got} python={want}")
+    print(f"checked {checked} ranges, {mismatches} mismatches")
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Brute-force scan of a base's whole range with the scalar oracle — no
+filters (reference scripts/naive_base_search.rs). Ground truth for small bases.
+
+Usage: python scripts/naive_base_search.py --base 10 [--limit 10000000]
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.ops import scalar  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", type=int, default=10)
+    p.add_argument("--limit", type=int, default=10_000_000,
+                   help="refuse ranges bigger than this")
+    args = p.parse_args()
+    r = base_range.get_base_range(args.base)
+    if r is None:
+        print(f"base {args.base} has no valid range", file=sys.stderr)
+        return 1
+    size = r[1] - r[0]
+    if size > args.limit:
+        print(f"range size {size:.2e} exceeds --limit {args.limit:.2e}",
+              file=sys.stderr)
+        return 1
+    t0 = time.monotonic()
+    found = []
+    for n in range(r[0], r[1]):
+        if scalar.get_is_nice(n, args.base):
+            found.append(n)
+            print(f"nice: {n}")
+    dt = time.monotonic() - t0
+    print(f"base {args.base}: scanned {size} numbers in {dt:.2f}s "
+          f"({size / dt:,.0f} n/s), {len(found)} nice")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Digit-by-digit backtracking search with pruning (reference
+scripts/radix_tree_search.rs:13-19): build candidate n most-significant-digit
+first; at each partial prefix, bound the square's and cube's shared MSD
+digits and prune subtrees whose forced digits already collide.
+
+For a prefix P of length d (of D total digits of n), every completion lies in
+[P * b^(D-d), (P+1) * b^(D-d)); the MSD prefix filter applied to that interval
+decides whether the subtree can contain a nice number — the same test the
+range filter uses (ops/msd_filter.py), driven top-down instead of by binary
+subdivision.
+
+Usage: python scripts/radix_tree_search.py --base 20 [--leaf 250]
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.core.types import FieldSize  # noqa: E402
+from nice_tpu.ops import msd_filter, scalar  # noqa: E402
+
+
+def search(base: int, leaf: int) -> tuple[list[int], int, int]:
+    lo, hi = base_range.get_base_range(base)
+    found: list[int] = []
+    visited = pruned = 0
+
+    def recurse(start: int, end: int) -> None:
+        nonlocal visited, pruned
+        start, end = max(start, lo), min(end, hi)
+        if start >= end:
+            return
+        visited += 1
+        if end - start <= leaf:
+            found.extend(
+                n for n in range(start, end) if scalar.get_is_nice(n, base)
+            )
+            return
+        if msd_filter.has_duplicate_msd_prefix(FieldSize(start, end), base):
+            pruned += 1
+            return
+        # descend one radix digit: split the interval at the next digit of n
+        width = 1
+        while width * base < end - start:
+            width *= base
+        first = (start // width) * width
+        child = first
+        while child < end:
+            recurse(child, child + width)
+            child += width
+
+    recurse(lo, hi)
+    return found, visited, pruned
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", type=int, default=20)
+    p.add_argument("--leaf", type=int, default=250)
+    args = p.parse_args()
+    t0 = time.monotonic()
+    found, visited, pruned = search(args.base, args.leaf)
+    dt = time.monotonic() - t0
+    for n in found:
+        print(f"nice: {n}")
+    print(
+        f"base {args.base}: {len(found)} nice, {visited} nodes visited, "
+        f"{pruned} subtrees pruned, {dt:.2f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Overall search progress per base from the coordination ledger (reference
+scripts/search_progress.rs): fraction of fields at each check level.
+
+Usage: python scripts/search_progress.py --db nice.db
+"""
+
+import argparse
+import sys
+from collections import Counter
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.server.db import Db  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--db", default="nice.db")
+    args = p.parse_args()
+    db = Db(args.db)
+    try:
+        for base in db.get_bases():
+            fields = db.get_fields_in_base(base)
+            total = len(fields)
+            by_cl = Counter(f.check_level for f in fields)
+            size_total = sum(f.range_size for f in fields)
+            size_checked = sum(f.range_size for f in fields if f.check_level >= 1)
+            size_detailed = sum(f.range_size for f in fields if f.check_level >= 2)
+            print(
+                f"base {base}: {total} fields, "
+                f"{100 * size_checked / size_total:.1f}% checked, "
+                f"{100 * size_detailed / size_total:.1f}% detailed; "
+                f"check levels {dict(sorted(by_cl.items()))}"
+            )
+    finally:
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

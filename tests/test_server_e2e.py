@@ -139,6 +139,35 @@ def test_submit_verification_rejects_fake_nice_number(server):
     assert "422" in str(err.value)
 
 
+def test_stats_endpoints_and_static_web(server):
+    base_url, db_path = server
+
+    bases = _get(f"{base_url}/stats/bases")
+    assert {b["base"] for b in bases} == {10, 17}
+    assert bases[0]["range_start"] == "47"
+
+    # leaderboard/search_rate serve (possibly empty) lists
+    assert isinstance(_get(f"{base_url}/stats/leaderboard"), list)
+    assert isinstance(_get(f"{base_url}/stats/search_rate"), list)
+
+    # the analytics dashboard and browser search client are served from web/
+    with urllib.request.urlopen(f"{base_url}/", timeout=10) as r:
+        assert b"nice numbers" in r.read()
+    with urllib.request.urlopen(f"{base_url}/search/", timeout=10) as r:
+        assert b"worker-pool.js" in r.read()
+    with urllib.request.urlopen(f"{base_url}/search/worker.js", timeout=10) as r:
+        body = r.read()
+        # the reference's distribution_updates/distribution field-name
+        # mismatch (web/search/worker.js:83) must not be replicated
+        assert b"distribution" in body and b"distribution_updates" not in body
+    # path traversal is rejected
+    try:
+        urllib.request.urlopen(f"{base_url}/search/../../SURVEY.md", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
 def test_unknown_route_and_bad_claim(server):
     base_url, _ = server
     try:

@@ -1,0 +1,95 @@
+// Worker pool: split a claimed field into contiguous BigInt sub-ranges across
+// ~80% of cores, aggregate per-worker results, throttle progress updates,
+// and abort when at least half the workers fail (reference
+// web/search/worker-pool.js:116-339).
+
+"use strict";
+
+class WorkerPool {
+  constructor(maxWorkers) {
+    const cores = navigator.hardwareConcurrency || 4;
+    this.maxWorkers = maxWorkers || Math.max(1, Math.floor(cores * 0.8));
+  }
+
+  // data: {base, range_start, range_end}; onProgress(processed, total)
+  processClaimData(data, onProgress) {
+    const start = BigInt(data.range_start);
+    const end = BigInt(data.range_end);
+    const total = end - start;
+    const n = this.maxWorkers;
+    const chunk = total / BigInt(n);
+
+    return new Promise((resolve, reject) => {
+      const workers = [];
+      const results = new Array(n).fill(null);
+      let done = 0;
+      let failed = 0;
+      let processed = 0n;
+      let lastReport = 0;
+
+      const finish = () => {
+        workers.forEach((w) => w.terminate());
+        const ok = results.filter((r) => r !== null);
+        if (failed * 2 >= n) {
+          reject(new Error(`${failed}/${n} workers failed; aborting field`));
+          return;
+        }
+        resolve(WorkerPool.aggregate(ok, data.base));
+      };
+
+      for (let i = 0; i < n; i++) {
+        const subStart = start + BigInt(i) * chunk;
+        const subEnd = i === n - 1 ? end : subStart + chunk;
+        const w = new Worker("worker.js");
+        workers.push(w);
+        w.onmessage = (e) => {
+          const msg = e.data;
+          if (msg.type === "progress") {
+            processed += BigInt(msg.processed);
+            const now = Date.now();
+            if (now - lastReport > 250) {
+              lastReport = now;
+              onProgress && onProgress(processed, total);
+            }
+          } else if (msg.type === "complete") {
+            results[i] = msg.result;
+            if (++done + failed === n) finish();
+          } else if (msg.type === "error") {
+            console.error("worker error:", msg.message);
+            failed++;
+            if (done + failed === n) finish();
+          }
+        };
+        w.onerror = (err) => {
+          console.error("worker crashed:", err.message);
+          failed++;
+          if (done + failed === n) finish();
+        };
+        w.postMessage({
+          type: "process",
+          start: subStart.toString(),
+          end: subEnd.toString(),
+          base: data.base,
+        });
+      }
+    });
+  }
+
+  // Merge per-worker {distribution, nice_numbers} (reference
+  // worker-pool.js:427-466).
+  static aggregate(results, base) {
+    const distribution = {};
+    for (let u = 1; u <= base; u++) distribution[u] = 0;
+    const niceNumbers = [];
+    for (const r of results) {
+      for (const [u, count] of Object.entries(r.distribution)) {
+        distribution[u] = (distribution[u] || 0) + count;
+      }
+      niceNumbers.push(...r.nice_numbers);
+    }
+    niceNumbers.sort((a, b) => (BigInt(a.number) < BigInt(b.number) ? -1 : 1));
+    return { distribution, nice_numbers: niceNumbers };
+  }
+}
+
+window.WorkerPool = WorkerPool;

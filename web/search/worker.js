@@ -1,0 +1,66 @@
+// Browser search worker: processes a sub-range with a BigInt scalar engine.
+//
+// The reference ships a WASM build of its Rust engine (wasm-client/src/lib.rs)
+// driven by this worker's twin (web/search/worker.js); here the engine is
+// plain JS BigInt — the same digit-peel algorithm as the scalar oracle
+// (nice_tpu/ops/scalar.py), bit-exact with every other backend.
+//
+// NOTE: the reference worker reads a differently-named result field than its
+// WASM emits (a latent mismatch, reference web/search/worker.js:83). Both
+// sides here agree on `distribution`.
+
+"use strict";
+
+const PROGRESS_CHUNK = 100000n;
+
+function numUniqueDigits(n, base) {
+  const sq = n * n;
+  const cu = sq * n;
+  let indicator = 0n;
+  for (let v = sq; v !== 0n; v /= base) indicator |= 1n << v % base;
+  for (let v = cu; v !== 0n; v /= base) indicator |= 1n << v % base;
+  // popcount of a BigInt bitmask
+  let count = 0;
+  for (let m = indicator; m !== 0n; m &= m - 1n) count++;
+  return count;
+}
+
+function processRange(startStr, endStr, baseInt) {
+  const base = BigInt(baseInt);
+  const cutoff = Math.floor(0.9 * baseInt); // near-miss cutoff (core/number_stats.py)
+  const distribution = {};
+  for (let u = 1; u <= baseInt; u++) distribution[u] = 0;
+  const niceNumbers = [];
+
+  let n = BigInt(startStr);
+  const end = BigInt(endStr);
+  let sinceProgress = 0n;
+  while (n < end) {
+    const u = numUniqueDigits(n, base);
+    distribution[u] += 1;
+    if (u > cutoff) {
+      niceNumbers.push({ number: n.toString(), num_uniques: u });
+    }
+    n += 1n;
+    sinceProgress += 1n;
+    if (sinceProgress >= PROGRESS_CHUNK) {
+      postMessage({ type: "progress", processed: sinceProgress.toString() });
+      sinceProgress = 0n;
+    }
+  }
+  if (sinceProgress > 0n) {
+    postMessage({ type: "progress", processed: sinceProgress.toString() });
+  }
+  return { distribution, nice_numbers: niceNumbers };
+}
+
+onmessage = (e) => {
+  const msg = e.data;
+  if (msg.type !== "process") return;
+  try {
+    const result = processRange(msg.start, msg.end, msg.base);
+    postMessage({ type: "complete", result });
+  } catch (err) {
+    postMessage({ type: "error", message: String(err) });
+  }
+};

@@ -29,7 +29,7 @@ from nice_tpu.core.types import (
 )
 from nice_tpu.ops import pallas_engine as pe
 from nice_tpu.ops import scalar
-from nice_tpu.ops.limbs import get_plan, int_to_limbs
+from nice_tpu.ops.limbs import get_plan, int_to_limbs, ints_to_limbs
 from nice_tpu.ops import vector_engine as ve
 
 # Default lanes per device batch. Large enough to amortize dispatch, small
@@ -70,6 +70,54 @@ def _pick_backend(plan, batch_size: int, backend: str) -> str:
     ):
         return "pallas"
     return "jnp"
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_mesh(devs: tuple):
+    from nice_tpu.parallel import mesh as pmesh
+
+    return pmesh.make_mesh(list(devs))
+
+
+def _mesh_or_none():
+    """Multi-chip context: a 1-D mesh over all visible devices when more than
+    one is visible and sharding is not disabled (NICE_TPU_SHARD=0). The
+    engine dispatches whole super-batches (batch_size lanes per device) through
+    parallel/mesh.py sharded steps, which run the same single-chip kernels per
+    device and psum the stats over ICI (P8). The mesh (and the jitted sharded
+    steps keyed on it) are cached so repeated process_range_* calls never
+    retrace."""
+    import os
+
+    import jax
+
+    if os.environ.get("NICE_TPU_SHARD", "1") == "0":
+        return None
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return _cached_mesh(tuple(devs))
+
+
+def _shard_inputs(plan, core_end: int, batch_start: int, valid: int,
+                  batch_size: int, n_dev: int):
+    """Exact per-device (starts u32[n_dev, limbs_n], valids i32[n_dev]) for one
+    super-batch, computed on the host with Python ints (no in-graph offset
+    arithmetic, so no u32/i32 overflow at any field size). Device starts are
+    clamped to the core end so tail devices with zero valid lanes never leave
+    the base range (their lanes are masked, but digit extraction still runs)."""
+    starts = ints_to_limbs(
+        [min(batch_start + d * batch_size, core_end) for d in range(n_dev)],
+        plan.limbs_n,
+    )
+    valids = np.asarray(
+        [max(0, min(batch_size, valid - d * batch_size)) for d in range(n_dev)],
+        dtype=np.int32,
+    )
+    return starts, valids
 
 
 def _rare_scan_uniques(plan, batch_start: int, valid: int, batch_size: int, backend: str):
@@ -285,11 +333,28 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
             descs.append((n0, lo, hi))
             n0 += span
 
+    # Descriptor batches shard across the mesh when >1 device is visible:
+    # each device runs the strided kernel on its own desc_max rows and the
+    # per-descriptor count tiles are stacked (not reduced — the host needs
+    # every count to pick re-scan ranges).
+    mesh = _mesh_or_none()
+    if mesh is not None:
+        from nice_tpu.parallel import mesh as pmesh
+
+        n_dev = mesh.devices.size
+        sharded_step = pmesh.make_sharded_strided_step(
+            plan, spec, desc_max, periods, mesh
+        )
+    else:
+        n_dev = 1
+        sharded_step = None
+    group_cap = desc_max * n_dev
+
     nice: list[int] = []
     pending: deque = deque()
 
     def pack(group: list[tuple[int, int, int]]) -> np.ndarray:
-        arr = np.zeros((desc_max, 12), dtype=np.uint32)
+        arr = np.zeros((group_cap, 12), dtype=np.uint32)
         for i, (n0, lo, hi) in enumerate(group):
             arr[i, 0:4] = int_to_limbs(n0, 4)
             arr[i, 4:8] = int_to_limbs(lo, 4)
@@ -298,9 +363,11 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
 
     def collect_one():
         group, counts_dev = pending.popleft()
-        counts = np.asarray(counts_dev).reshape(-1)
-        for i, (n0, lo, hi) in enumerate(group):
-            count = int(counts[i])
+        # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
+        # flat at [d, i] after collapsing each device's tile.
+        counts = np.asarray(counts_dev).reshape(n_dev, -1)
+        for g, (n0, lo, hi) in enumerate(group):
+            count = int(counts[g // desc_max, g % desc_max])
             if count == 0:
                 continue
             found = _host_strided_scan(
@@ -313,11 +380,13 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                 )
             nice.extend(found)
 
-    for off in range(0, len(descs), desc_max):
-        group = descs[off : off + desc_max]
-        counts = pe.niceonly_strided_batch(
-            plan, spec, pack(group), periods=periods
-        )
+    for off in range(0, len(descs), group_cap):
+        group = descs[off : off + group_cap]
+        packed = pack(group)
+        if sharded_step is not None:
+            counts = sharded_step(packed)
+        else:
+            counts = pe.niceonly_strided_batch(plan, spec, packed, periods=periods)
         pending.append((group, counts))
         if len(pending) >= 4:
             collect_one()
@@ -360,7 +429,32 @@ def process_range_detailed(
     # executes in order while the host keeps dispatching — the reference's
     # overlapped launch pipeline, client_process_gpu.rs:667-682). The window
     # bounds in-flight device buffers so arbitrarily large fields run in
-    # constant memory.
+    # constant memory. With >1 device, each dispatch is a super-batch of
+    # batch_size lanes per device through the sharded psum step.
+    mesh = _mesh_or_none()
+    if mesh is not None:
+        from nice_tpu.parallel import mesh as pmesh
+
+        n_dev = mesh.devices.size
+        # backend is already resolved to exactly "pallas" or "jnp" here; pass
+        # it through so an explicit backend="jnp" is honored on TPU too.
+        step = pmesh.make_sharded_stats_step(
+            plan, batch_size, mesh, "detailed", kernel=backend
+        )
+        lanes = batch_size * n_dev
+
+        def dispatch(batch_start, valid):
+            starts, valids = _shard_inputs(
+                plan, core.end(), batch_start, valid, batch_size, n_dev
+            )
+            return step(starts, valids)
+    else:
+        lanes = batch_size
+
+        def dispatch(batch_start, valid):
+            start_limbs = int_to_limbs(batch_start, plan.limbs_n)
+            return batch_fn(plan, batch_size, start_limbs, np.int32(valid))
+
     start = core.start()
     total = core.size()
     pending: deque = deque()
@@ -368,12 +462,12 @@ def process_range_detailed(
     def collect_one():
         batch_start, valid, bh, nm = pending.popleft()
         bh = np.asarray(bh, dtype=np.int64)[: plan.base + 2]
-        bh[0] -= batch_size - valid  # remove tail-padding lanes from bin 0
+        bh[0] -= lanes - valid  # remove tail-padding lanes from bin 0
         np.add(hist, bh, out=hist)
         if int(nm) > 0:
             # Rare path: re-derive per-lane uniques around this batch only.
             for sub_start, uniques in _rare_scan_uniques(
-                plan, batch_start, valid, batch_size, backend
+                plan, batch_start, valid, lanes, backend
             ):
                 idxs = np.nonzero(uniques > plan.near_miss_cutoff)[0]
                 for i in idxs.tolist():
@@ -385,11 +479,9 @@ def process_range_detailed(
 
     done = 0
     while done < total:
-        valid = min(batch_size, total - done)
+        valid = min(lanes, total - done)
         batch_start = start + done
-        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-        bh, nm = batch_fn(plan, batch_size, start_limbs, np.int32(valid))
-        pending.append((batch_start, valid, bh, nm))
+        pending.append((batch_start, valid) + tuple(dispatch(batch_start, valid)))
         if len(pending) >= DISPATCH_WINDOW:
             collect_one()
         done += valid
@@ -450,14 +542,38 @@ def process_range_niceonly(
         nice_numbers.sort(key=lambda n: n.number)
         return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
 
-    dense_fn = ve.niceonly_dense_batch
+    mesh = _mesh_or_none()
+    if mesh is not None:
+        from nice_tpu.parallel import mesh as pmesh
+
+        n_dev = mesh.devices.size
+        # Only the jnp dense path reaches here (the pallas strided path
+        # returned above), so the per-device kernel is jnp by construction.
+        step = pmesh.make_sharded_stats_step(
+            plan, batch_size, mesh, "niceonly", kernel="jnp"
+        )
+        lanes = batch_size * n_dev
+    else:
+        lanes = batch_size
+
+    def dispatch(batch_start, valid, core_end):
+        if mesh is not None:
+            starts, valids = _shard_inputs(
+                plan, core_end, batch_start, valid, batch_size, n_dev
+            )
+            return step(starts, valids)
+        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
+        return ve.niceonly_dense_batch(
+            plan, batch_size, start_limbs, np.int32(valid)
+        )
+
     pending: deque = deque()
 
     def collect_one():
         batch_start, valid, count = pending.popleft()
         if int(count) > 0:
             for sub_start, uniques in _rare_scan_uniques(
-                plan, batch_start, valid, batch_size, backend
+                plan, batch_start, valid, lanes, backend
             ):
                 for i in np.nonzero(uniques == base)[0].tolist():
                     nice_numbers.append(
@@ -469,10 +585,9 @@ def process_range_niceonly(
         total = sub_range.size()
         done = 0
         while done < total:
-            valid = min(batch_size, total - done)
+            valid = min(lanes, total - done)
             batch_start = start + done
-            start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-            count = dense_fn(plan, batch_size, start_limbs, np.int32(valid))
+            count = dispatch(batch_start, valid, sub_range.end())
             pending.append((batch_start, valid, count))
             if len(pending) >= DISPATCH_WINDOW:
                 collect_one()

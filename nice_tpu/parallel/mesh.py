@@ -73,6 +73,100 @@ def make_sharded_detailed_step(plan: BasePlan, per_device_batch: int, mesh: Mesh
     return jax.jit(sharded)
 
 
+@functools.lru_cache(maxsize=None)
+def make_sharded_stats_step(
+    plan: BasePlan,
+    per_device_batch: int,
+    mesh: Mesh,
+    mode: str,
+    kernel: str = "auto",
+):
+    """Production multi-chip stats step: every device runs the SINGLE-CHIP
+    batch engine — the Mosaic/Pallas stats kernel on TPU, the jnp graph
+    elsewhere — on its own (start, valid) slice, and the stats are psum-reduced
+    over ICI. This is the step ops/engine.py dispatches when more than one
+    device is visible, so the multi-chip path exercises the exact same kernels
+    as single-chip (ref reduction chain P8, nice_kernels.cu:496-530).
+
+    mode: "detailed" | "niceonly".
+    kernel: "pallas" | "jnp" | "auto" (pallas iff it would be picked
+    single-chip: TPU backend + base fits the stats tile + whole blocks).
+
+    Returns fn(starts u32[n_dev, limbs_n], valids i32[n_dev]) with per-device
+    start limbs / valid counts computed exactly on the host (no in-graph
+    offset arithmetic -> no u32 overflow concerns at any field size):
+      detailed -> (histogram i32[>=base+2], near_miss_count i32), replicated
+      niceonly -> nice count i32, replicated
+    """
+    from nice_tpu.ops import pallas_engine as pe
+
+    if kernel == "auto":
+        kernel = (
+            "pallas"
+            if (
+                jax.default_backend() == "tpu"
+                and pe.supports_base(plan)
+                and per_device_batch % 128 == 0
+            )
+            else "jnp"
+        )
+
+    mod = pe if kernel == "pallas" else ve
+    if mode == "detailed":
+        run = lambda start, valid: mod.detailed_batch(  # noqa: E731
+            plan, per_device_batch, start, valid
+        )
+    else:
+        run = lambda start, valid: (  # noqa: E731
+            None,
+            mod.niceonly_dense_batch(plan, per_device_batch, start, valid),
+        )
+
+    def device_step(start_row, valid_row):
+        hist, count = run(start_row[0], valid_row[0])
+        count = jax.lax.psum(count, FIELD_AXIS)
+        if mode == "detailed":
+            return jax.lax.psum(hist, FIELD_AXIS), count
+        return count
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS)),
+        out_specs=(P(), P()) if mode == "detailed" else P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
+                              periods: int, mesh: Mesh):
+    """Multi-chip stride-compacted niceonly step: the descriptor table is
+    sharded across the mesh (each device counts nice candidates for its own
+    descriptor rows with the strided Pallas kernel) and the per-descriptor
+    count tiles are stacked, NOT reduced — the host needs every descriptor's
+    count to decide which sub-ranges to re-scan.
+
+    Returns fn(desc u32[n_dev * per_device_desc, 12]) ->
+    i32[n_dev * 8, 128]; descriptor (dev d, local i) count lands at
+    [d * 8 + i // 128, i % 128].
+    """
+    from nice_tpu.ops import pallas_engine as pe
+
+    def device_step(desc):
+        return pe.niceonly_strided_batch(plan, spec, desc, periods=periods)
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(FIELD_AXIS, None),),
+        out_specs=P(FIELD_AXIS, None),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_sharded_niceonly_step(plan: BasePlan, per_device_batch: int, mesh: Mesh):
     """Jitted multi-chip niceonly (dense) step: psum'd count of fully nice
     lanes across the mesh."""

@@ -1,0 +1,73 @@
+"""Host platform helpers shared by tests/conftest.py, bench.py and
+__graft_entry__.py.
+
+Two recurring needs around the axon tunnel (one real TPU chip shared with the
+driver) are centralized here so the recipe cannot diverge between the test
+suite, the benchmark runner, and the multichip dryrun:
+
+- forcing a VIRTUAL CPU device mesh before jax backend init. The env var
+  JAX_PLATFORMS=cpu alone is not enough: the axon PJRT plugin overrides it at
+  import time, so callers must also jax.config.update("jax_platforms", "cpu")
+  after import; and --xla_force_host_platform_device_count must be in
+  XLA_FLAGS before the CPU backend initializes.
+- probing backend init under a watchdog. Init can HANG indefinitely (a wedged
+  device lease on the tunnel), not just raise, so a plain try/except never
+  returns; the probe runs in a daemon thread with a timeout.
+
+This module must stay import-light (no jax at module import) so conftest can
+use it before any jax import.
+"""
+
+from __future__ import annotations
+
+from typing import MutableMapping
+
+
+def force_virtual_cpu(env: MutableMapping[str, str], n_devices: int = 8) -> None:
+    """Mutate env (os.environ or a subprocess env dict) so the NEXT jax import
+    in that environment sees >= n_devices virtual CPU devices."""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+
+
+def probe_backend(timeout_s: float = 60.0, platform: str | None = None):
+    """(device_count | None, error | None): import jax, optionally force a
+    platform via jax.config, and count devices — inside a watchdog thread.
+
+    Returns (n, None) on success; (None, exc) on an init exception; and
+    (None, TimeoutError) when init hangs past timeout_s. The hung daemon
+    thread cannot be joined — callers that need a clean retry should re-exec
+    or subprocess (jax also caches a FAILED backend, so in-process retries
+    see the same error)."""
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            if platform:
+                jax.config.update("jax_platforms", platform)
+            result["n"] = len(jax.devices())
+        except Exception as exc:  # noqa: BLE001 — callers decide retryability
+            result["exc"] = exc
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "n" in result:
+        return result["n"], None
+    return None, result.get(
+        "exc",
+        TimeoutError(
+            f"jax backend init hung >{timeout_s:.0f}s (wedged device lease?)"
+        ),
+    )

@@ -7,17 +7,17 @@ real chip.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nice_tpu.utils.platform import force_virtual_cpu  # noqa: E402
 
 # Force CPU: the session env pins JAX_PLATFORMS=axon (the real chip) which the
 # test suite must never grab — bench.py owns the chip. The axon PJRT plugin
 # overrides the JAX_PLATFORMS env var at import time, so the env var alone is
 # not enough: jax.config.update after import is authoritative.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_virtual_cpu(os.environ, 8)
 
 import jax  # noqa: E402
 

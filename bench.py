@@ -59,6 +59,14 @@ DEFAULT_SUITE = (
 )
 HEADLINE = ("extra-large", "detailed")
 
+# Natural kind for a bare NICE_BENCH_MODE: the msd-* and massive modes are
+# niceonly benchmarks in the reference suite (benchmark.rs:40-76).
+_MODE_KIND = {
+    "massive": "niceonly",
+    "msd-effective": "niceonly",
+    "msd-ineffective": "niceonly",
+}
+
 
 def _init_jax():
     """Import jax and force backend init, re-exec'ing on transient failure.
@@ -175,15 +183,31 @@ def _parse_suite(raw: str) -> tuple:
 def main() -> int:
     jax, n_chips = _init_jax()
 
-    if os.environ.get("NICE_BENCH_SUITE"):
-        suite = _parse_suite(os.environ["NICE_BENCH_SUITE"])
-    elif os.environ.get("NICE_BENCH_MODE"):
-        mode = os.environ["NICE_BENCH_MODE"]
-        suite = tuple(
-            (m, k) for (m, k) in DEFAULT_SUITE if m == mode
-        ) or ((mode, "detailed"),)
-    else:
-        suite = DEFAULT_SUITE
+    try:
+        if os.environ.get("NICE_BENCH_SUITE"):
+            suite = _parse_suite(os.environ["NICE_BENCH_SUITE"])
+        elif os.environ.get("NICE_BENCH_MODE"):
+            mode = os.environ["NICE_BENCH_MODE"]
+            suite = tuple(
+                (m, k) for (m, k) in DEFAULT_SUITE if m == mode
+            ) or ((mode, _MODE_KIND.get(mode, "detailed")),)
+        else:
+            suite = DEFAULT_SUITE
+    except ValueError as exc:
+        # Still a JSON line, never a bare traceback (driver contract).
+        print(
+            json.dumps(
+                {
+                    "metric": "numbers/sec/chip (benchmark suite)",
+                    "value": 0,
+                    "unit": "numbers/sec/chip",
+                    "vs_baseline": 0,
+                    "error": str(exc),
+                }
+            ),
+            flush=True,
+        )
+        return 1
 
     on_tpu = jax.default_backend() == "tpu"
     results: dict[tuple, dict] = {}

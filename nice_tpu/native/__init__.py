@@ -61,10 +61,16 @@ def _build() -> bool:
             return False
 
 
-@lru_cache(maxsize=1)
 def _load():
+    # The env check sits OUTSIDE the cache so flipping NICE_NO_NATIVE after a
+    # first call still takes effect (tests toggle it per-case).
     if os.environ.get("NICE_NO_NATIVE"):
         return None
+    return _load_lib()
+
+
+@lru_cache(maxsize=1)
+def _load_lib():
     if not _build():
         return None
     lib = ctypes.CDLL(_LIB)

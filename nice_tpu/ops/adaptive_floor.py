@@ -1,0 +1,118 @@
+"""Adaptive MSD recursion floor for the heterogeneous niceonly pipeline.
+
+The niceonly device path is a two-phase pipeline per field: the HOST runs the
+MSD prefix filter down to a recursion floor (coarse floor = cheap host work,
+more surviving lanes for the device; fine floor = expensive host recursion,
+fewer lanes), then the DEVICE scans the surviving stride candidates. The
+optimal floor balances the two phases — the reference measured 350 s -> 4.8 s
+per 1e12 numbers between floor 250 and 64k on one core (ref
+client_process_gpu.rs:85-94) and retunes the floor per field to hold
+msd_time ~= device_tail_time (ref client_process_gpu.rs:103-184).
+
+This is the TPU re-derivation of that controller. Differences from the
+reference are deliberate:
+
+- The TPU device tail is far cheaper per lane than the CUDA kernel it was
+  tuned against (the stride kernel derives candidates on-device with zero HBM
+  traffic), so the ceiling is higher and the default seed coarser.
+- Timing uses time.monotonic() around explicit phase boundaries in
+  engine._niceonly_pallas; there is no stream-event machinery to integrate.
+
+NICE_TPU_MSD_FLOOR pins the floor and disables adaptation (the analog of
+NICE_GPU_MSD_FLOOR).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# Below ~250 the device receives virtually the dense range; above the cap the
+# survival rate has saturated (ref sweep data) and host recursion time is
+# already negligible.
+FLOOR_MIN = 250
+FLOOR_MAX = 1 << 20
+
+# Fields to observe before adapting (one-time jit/compile costs would skew
+# the first ratios).
+WARMUP_FIELDS = 2
+
+# Max multiplicative nudge per field, either direction.
+MAX_STEP = 1.5
+
+# Phases shorter than this are measurement noise; treat as "free".
+MIN_SECS = 0.002
+
+# Seed calibrated so a 32-core host lands near the reference's 16k sweet
+# spot; fewer cores -> coarser floor (host recursion is the bottleneck).
+_SEED_CORE_PRODUCT = 2_097_152
+
+
+class AdaptiveFloor:
+    """Per-process controller; thread-safe (client workers share one)."""
+
+    def __init__(self, pinned: int | None = None, seed: int | None = None):
+        self._lock = threading.Lock()
+        self.pinned = pinned is not None
+        if pinned is not None:
+            self.floor = float(max(1, pinned))
+            self._warmup = 0
+        else:
+            if seed is None:
+                cores = os.cpu_count() or 32
+                seed = _SEED_CORE_PRODUCT // cores
+            self.floor = float(min(max(seed, FLOOR_MIN), FLOOR_MAX))
+            self._warmup = WARMUP_FIELDS
+
+    def current(self) -> int:
+        return int(self.floor)
+
+    def observe(self, host_secs: float, device_secs: float) -> None:
+        """Record one field's phase split and nudge the floor toward
+        host_secs ~= device_secs. No-op when pinned or warming up."""
+        if self.pinned:
+            return
+        with self._lock:
+            if self._warmup > 0:
+                self._warmup -= 1
+                return
+            if device_secs < MIN_SECS and host_secs < MIN_SECS:
+                return  # field too small to tell anything
+            if device_secs < MIN_SECS:
+                ratio = MAX_STEP  # device idle: host filter is over-working
+            elif host_secs < MIN_SECS:
+                ratio = 1.0 / MAX_STEP  # host free: refine the filter
+            else:
+                ratio = host_secs / device_secs
+            ratio = min(max(ratio, 1.0 / MAX_STEP), MAX_STEP)
+            self.floor = min(max(self.floor * ratio, FLOOR_MIN), FLOOR_MAX)
+
+
+_CONTROLLERS: dict[str, AdaptiveFloor] = {}
+_CONTROLLERS_LOCK = threading.Lock()
+
+
+def get_floor_controller(pipeline: str = "strided") -> AdaptiveFloor:
+    """Per-pipeline controller; NICE_TPU_MSD_FLOOR pins all of them.
+
+    The strided-descriptor and dense device pipelines have DIFFERENT optimal
+    floors (a strided device lane is far cheaper per surviving number than a
+    dense lane), so a shared controller would oscillate between their balance
+    points when a client alternates bases; each pipeline keys its own."""
+    with _CONTROLLERS_LOCK:
+        ctrl = _CONTROLLERS.get(pipeline)
+        if ctrl is None:
+            raw = os.environ.get("NICE_TPU_MSD_FLOOR")
+            pinned = None
+            if raw:
+                try:
+                    pinned = max(1, int(float(raw)))
+                except ValueError:
+                    pass  # fall through to adaptive
+            ctrl = _CONTROLLERS[pipeline] = AdaptiveFloor(pinned=pinned)
+        return ctrl
+
+
+def reset_for_tests() -> None:
+    with _CONTROLLERS_LOCK:
+        _CONTROLLERS.clear()

@@ -270,7 +270,7 @@ def _native_niceonly(range_: FieldSize, base: int, stride_table, threads: int) -
 def _native_threads() -> int:
     import os
 
-    return int(os.environ.get("NICE_THREADS", os.cpu_count() or 1))
+    return max(1, int(os.environ.get("NICE_THREADS", os.cpu_count() or 1)))
 
 
 def _host_strided_scan(table, base: int, start: int, end: int) -> list[int]:
@@ -303,9 +303,9 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     reference treats inconsistent device output as a hard error,
     client_process_gpu.rs:776-781).
     """
-    import os
+    import time
 
-    from nice_tpu.ops import msd_filter, stride_filter
+    from nice_tpu.ops import adaptive_floor, msd_filter, stride_filter
 
     plan = get_plan(base)
     table = stride_filter.get_stride_table(base, 1)
@@ -319,19 +319,15 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
         desc_max, periods = pe.STRIDED_DESC_MAX, pe.STRIDED_PERIODS
     span = periods * modulus
 
-    # Coarse host filter: cheap lanes make a high recursion floor optimal
-    # (reference floor sweep, client_process_gpu.rs:85-94; env override
-    # mirrors NICE_GPU_MSD_FLOOR).
-    floor = int(os.environ.get("NICE_TPU_MSD_FLOOR", 65536))
-    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=floor)
-
-    descs: list[tuple[int, int, int]] = []
-    for r in ranges:
-        lo, hi = r.start(), r.end()
-        n0 = (lo // modulus) * modulus
-        while n0 < hi:
-            descs.append((n0, lo, hi))
-            n0 += span
+    # Coarse host filter down to the adaptive recursion floor: cheap device
+    # lanes make a high floor optimal (reference floor sweep,
+    # client_process_gpu.rs:85-94); the controller retunes it per field to
+    # hold host-filter time ~= device-tail time, and NICE_TPU_MSD_FLOOR pins
+    # it (the analog of NICE_GPU_MSD_FLOOR, client_process_gpu.rs:103-184).
+    ctrl = adaptive_floor.get_floor_controller("strided")
+    t_host0 = time.monotonic()
+    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=ctrl.current())
+    host_secs = time.monotonic() - t_host0
 
     # Descriptor batches shard across the mesh when >1 device is visible:
     # each device runs the strided kernel on its own desc_max rows and the
@@ -353,23 +349,77 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     nice: list[int] = []
     pending: deque = deque()
 
-    def pack(group: list[tuple[int, int, int]]) -> np.ndarray:
+    # Descriptors stream as numpy COLUMNS, never as a materialized Python
+    # list: the massive benchmark (1e13 @ b50) has ~3e7 descriptors, so
+    # per-descriptor Python objects or int_to_limbs calls would dominate the
+    # run (the reference hit the same wall and batches 65k ranges per launch,
+    # client_process_gpu.rs:667-682). Values are carried as TWO u64 half
+    # columns — strided-capable bases go up to limbs_n == 4 (< 2^128), and
+    # bases 60-95 really do have range ends above 2^64.
+    M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+    mask32 = np.uint64(0xFFFFFFFF)
+
+    def _halves(x: int, k: int) -> tuple[np.ndarray, np.ndarray]:
+        return (
+            np.full(k, x & 0xFFFFFFFFFFFFFFFF, dtype=np.uint64),
+            np.full(k, x >> 64, dtype=np.uint64),
+        )
+
+    def desc_columns():
+        """Yield 6 u64 column arrays (n0_lo, n0_hi, lo_lo, lo_hi, hi_lo,
+        hi_hi) per surviving MSD range."""
+        for r in ranges:
+            lo, hi = r.start(), r.end()
+            first = (lo // modulus) * modulus
+            k = -(-(hi - first) // span)
+            if k <= 0:
+                continue
+            # n0 = first + i*span as split u64 halves with vectorized carry.
+            offs = np.arange(k, dtype=np.uint64) * np.uint64(span)
+            n0_lo = (np.uint64(first & 0xFFFFFFFFFFFFFFFF) + offs) & M64
+            carry = (n0_lo < offs).astype(np.uint64)
+            n0_hi = np.uint64(first >> 64) + carry
+            yield (n0_lo, n0_hi, *_halves(lo, k), *_halves(hi, k))
+
+    def grouped_columns():
+        """Re-chunk the per-range columns into group_cap-sized groups."""
+        bufs: list[list[np.ndarray]] = [[] for _ in range(6)]
+        buffered = 0
+        for cols in desc_columns():
+            for b, c in zip(bufs, cols):
+                b.append(c)
+            buffered += len(cols[0])
+            while buffered >= group_cap:
+                cat = [np.concatenate(b) for b in bufs]
+                yield tuple(c[:group_cap] for c in cat)
+                bufs = [[c[group_cap:]] for c in cat]
+                buffered = len(bufs[0][0])
+        if buffered:
+            yield tuple(np.concatenate(b) for b in bufs)
+
+    def pack(cols) -> np.ndarray:
         arr = np.zeros((group_cap, 12), dtype=np.uint32)
-        for i, (n0, lo, hi) in enumerate(group):
-            arr[i, 0:4] = int_to_limbs(n0, 4)
-            arr[i, 4:8] = int_to_limbs(lo, 4)
-            arr[i, 8:12] = int_to_limbs(hi, 4)
+        k = len(cols[0])
+        for j in range(6):  # u64 half j fills u32 limb pair (2*j, 2*j+1)
+            arr[:k, 2 * j] = (cols[j] & mask32).astype(np.uint32)
+            arr[:k, 2 * j + 1] = (cols[j] >> np.uint64(32)).astype(np.uint32)
         return arr
 
+    def _at(cols, j: int, g: int) -> int:
+        return int(cols[2 * j][g]) | (int(cols[2 * j + 1][g]) << 64)
+
     def collect_one():
-        group, counts_dev = pending.popleft()
+        cols, counts_dev = pending.popleft()
         # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
         # flat at [d, i] after collapsing each device's tile.
         counts = np.asarray(counts_dev).reshape(n_dev, -1)
-        for g, (n0, lo, hi) in enumerate(group):
-            count = int(counts[g // desc_max, g % desc_max])
-            if count == 0:
-                continue
+        k = len(cols[0])
+        flat = np.concatenate(
+            [counts[d, :desc_max] for d in range(n_dev)]
+        )[:k]
+        for g in np.nonzero(flat)[0].tolist():
+            n0, lo, hi = _at(cols, 0, g), _at(cols, 1, g), _at(cols, 2, g)
+            count = int(flat[g])
             found = _host_strided_scan(
                 table, base, max(lo, n0), min(hi, n0 + span)
             )
@@ -380,18 +430,21 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                 )
             nice.extend(found)
 
-    for off in range(0, len(descs), group_cap):
-        group = descs[off : off + group_cap]
-        packed = pack(group)
+    t_dev0 = time.monotonic()
+    for cols in grouped_columns():
+        packed = pack(cols)
         if sharded_step is not None:
             counts = sharded_step(packed)
         else:
             counts = pe.niceonly_strided_batch(plan, spec, packed, periods=periods)
-        pending.append((group, counts))
+        pending.append((cols, counts))
         if len(pending) >= 4:
             collect_one()
     while pending:
         collect_one()
+    # Device tail includes the rare-path host re-scan — both sit on the far
+    # side of the host-filter/device boundary the controller balances.
+    ctrl.observe(host_secs, time.monotonic() - t_dev0)
     return nice
 
 
@@ -528,9 +581,25 @@ def process_range_niceonly(
         nice_numbers.extend(sub.nice_numbers)
 
     plan = get_plan(base)
+    requested = backend
     backend = _pick_backend(plan, batch_size, backend)
     if backend == "pallas" and plan.limbs_n > 4:
-        backend = "jnp"  # strided descriptors carry candidates as 4 u32 limbs
+        # Strided descriptors carry candidates as 4 u32 limbs (bases up to
+        # ~96). An explicit pallas request must not silently change engines.
+        if requested == "pallas":
+            raise ValueError(
+                f"base {base} needs {plan.limbs_n} u32 limbs; the strided "
+                "pallas niceonly path carries 4 — use backend='jax' (dense "
+                "device scan) or 'native'/'scalar'"
+            )
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "niceonly base %d exceeds 4 u32 limbs; falling back from the "
+            "strided pallas path to the dense device scan",
+            base,
+        )
+        backend = "jnp"
     if backend == "pallas":
         # Stride-compacted device path (builds its own k=1 table — the 2D
         # period x residue layout wants a small residue set; any passed
@@ -580,7 +649,22 @@ def process_range_niceonly(
                         NiceNumberSimple(number=sub_start + i, num_uniques=base)
                     )
 
-    for sub_range in msd_filter.get_valid_ranges(core, base):
+    # Same adaptive host-filter floor as the strided device path: the dense
+    # device scan is cheap per lane, so a fine (250) floor would be
+    # host-dominated (the setting the reference tunes away from for device
+    # backends, client_process_gpu.rs:85-94).
+    import time
+
+    from nice_tpu.ops import adaptive_floor
+
+    ctrl = adaptive_floor.get_floor_controller("dense")
+    t_host0 = time.monotonic()
+    sub_ranges = msd_filter.get_valid_ranges(
+        core, base, min_range_size=ctrl.current()
+    )
+    host_secs = time.monotonic() - t_host0
+    t_dev0 = time.monotonic()
+    for sub_range in sub_ranges:
         start = sub_range.start()
         total = sub_range.size()
         done = 0
@@ -594,6 +678,7 @@ def process_range_niceonly(
             done += valid
     while pending:
         collect_one()
+    ctrl.observe(host_secs, time.monotonic() - t_dev0)
 
     nice_numbers.sort(key=lambda n: n.number)
     return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))

@@ -160,11 +160,17 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
     field = ctx.db.get_field_by_id(claim.field_id)
     base = field.base
     numbers_expanded = number_stats.expand_numbers(data.nice_numbers, base)
+    # Wall-clock the client spent on the field (claim -> submit), recorded
+    # for the per-field performance analytics the schema column exists for.
+    from nice_tpu.server.db import now_utc
+
+    elapsed_secs = max(0.0, (now_utc() - claim.claim_time).total_seconds())
 
     if claim.search_mode == SearchMode.NICEONLY:
         # Honor system: no verification (reference api/src/main.rs:278-300).
         ctx.db.insert_submission(
-            claim, data.username, data.client_version, user_ip, None, numbers_expanded
+            claim, data.username, data.client_version, user_ip, None,
+            numbers_expanded, elapsed_secs=elapsed_secs,
         )
         if field.check_level == 0:
             ctx.db.update_field_canon_and_cl(
@@ -223,6 +229,7 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
             user_ip,
             distribution_expanded,
             numbers_expanded,
+            elapsed_secs=elapsed_secs,
         )
         if field.check_level < 2:
             ctx.db.update_field_canon_and_cl(
@@ -237,6 +244,35 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
         data.username,
     )
     return {"status": "OK"}
+
+
+def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
+    """Admin disqualification: removes a user's (or one submission's) results
+    from consensus and the caches without deleting the audit trail (the
+    reference's abuse/consensus story depends on this flag). Gated by a
+    shared secret: requests must carry X-Admin-Key matching NICE_ADMIN_KEY;
+    with no key configured the endpoint is disabled."""
+    import hmac
+    import os
+
+    configured = os.environ.get("NICE_ADMIN_KEY", "")
+    provided = headers.get("X-Admin-Key", "")
+    if not configured or not hmac.compare_digest(configured, provided):
+        raise ApiError(403, "admin endpoint disabled or bad key")
+    if "submission_id" in payload:
+        try:
+            submission_id = int(payload["submission_id"])
+        except (TypeError, ValueError):
+            raise ApiError(
+                400, f"Invalid submission_id {payload['submission_id']!r}"
+            )
+        changed = ctx.db.disqualify_submission(submission_id)
+    elif "username" in payload:
+        changed = ctx.db.disqualify_user(str(payload["username"]))
+    else:
+        raise ApiError(400, "body must contain submission_id or username")
+    ctx.db.refresh_search_caches()
+    return {"status": "OK", "disqualified": changed}
 
 
 NOT_FOUND_MESSAGE = (
@@ -297,7 +333,19 @@ def make_handler(ctx: ApiContext):
                         200, claim_helper(ctx, SearchMode.NICEONLY, user_ip).to_json()
                     )
                 elif method == "GET" and path == "/claim/validate":
-                    self._send(200, ctx.db.get_validation_field().to_json())
+                    qs = parse_qs(urlparse(self.path).query)
+                    base_arg = qs.get("base", [None])[0]
+                    try:
+                        base_filter = int(base_arg) if base_arg else None
+                    except ValueError:
+                        raise ApiError(400, f"Invalid base {base_arg!r}")
+                    try:
+                        self._send(
+                            200,
+                            ctx.db.get_validation_field(base_filter).to_json(),
+                        )
+                    except KeyError as e:
+                        raise ApiError(404, f"No validation field available: {e}")
                 elif method == "GET" and path == "/status":
                     self._send(
                         200,
@@ -314,9 +362,15 @@ def make_handler(ctx: ApiContext):
                 elif method == "GET" and path == "/stats/bases":
                     self._send(200, ctx.db.get_base_stats())
                 elif method == "GET" and path == "/stats/leaderboard":
-                    self._send(200, ctx.db.get_leaderboard())
+                    qs = parse_qs(urlparse(self.path).query)
+                    self._send(
+                        200, ctx.db.get_leaderboard(qs.get("mode", [None])[0])
+                    )
                 elif method == "GET" and path == "/stats/search_rate":
-                    self._send(200, ctx.db.get_search_rate())
+                    qs = parse_qs(urlparse(self.path).query)
+                    self._send(
+                        200, ctx.db.get_search_rate(qs.get("mode", [None])[0])
+                    )
                 elif method == "GET" and self._try_static(path):
                     pass  # served from web/
                 elif method == "POST" and path == "/submit":
@@ -326,6 +380,13 @@ def make_handler(ctx: ApiContext):
                     except json.JSONDecodeError as e:
                         raise ApiError(400, f"Invalid JSON body: {e}")
                     self._send(200, handle_submit(ctx, payload, user_ip))
+                elif method == "POST" and path == "/admin/disqualify":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError as e:
+                        raise ApiError(400, f"Invalid JSON body: {e}")
+                    self._send(200, handle_disqualify(ctx, payload, self.headers))
                 else:
                     status = 404
                     self._error(404, NOT_FOUND_MESSAGE)

@@ -135,6 +135,14 @@ class Db:
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
+        # Cross-process safety: WAL readers never block, and writers from
+        # OTHER processes (multi-worker deployments, jobs runner alongside the
+        # API) wait out short write bursts instead of failing with
+        # "database is locked" (the SQLite analog of the reference's
+        # multi-worker Postgres FOR UPDATE SKIP LOCKED claims,
+        # db_util/fields.rs:204-536; BEGIN IMMEDIATE in _txn provides the
+        # claim-path mutual exclusion).
+        self._conn.execute("PRAGMA busy_timeout=10000")
         self.init_schema()
 
     def init_schema(self) -> None:
@@ -542,23 +550,29 @@ class Db:
 
     # -- validation --------------------------------------------------------
 
-    def get_validation_field(self) -> ValidationData:
+    def get_validation_field(self, base: Optional[int] = None) -> ValidationData:
         """A random double-checked field plus its canonical results
-        (reference db_util/fields.rs:611-679)."""
+        (reference db_util/fields.rs:611-679). base filters to one base —
+        an extension the CLI's --base validation flag relies on."""
         max_id = self._max_field_id()
         if max_id == 0:
             raise KeyError("no fields")
         pivot = random.randint(1, max_id)
+        base_pred = "" if base is None else " AND base_id = ?"
+        base_args = [] if base is None else [base]
         with self._lock:
             row = self._conn.execute(
                 "SELECT * FROM fields WHERE id >= ? AND check_level >= 2 AND"
-                " canon_submission_id IS NOT NULL ORDER BY id ASC LIMIT 1",
-                (pivot,),
+                f" canon_submission_id IS NOT NULL{base_pred}"
+                " ORDER BY id ASC LIMIT 1",
+                [pivot, *base_args],
             ).fetchone()
             if row is None:
                 row = self._conn.execute(
                     "SELECT * FROM fields WHERE check_level >= 2 AND"
-                    " canon_submission_id IS NOT NULL ORDER BY id ASC LIMIT 1"
+                    f" canon_submission_id IS NOT NULL{base_pred}"
+                    " ORDER BY id ASC LIMIT 1",
+                    base_args,
                 ).fetchone()
         if row is None:
             raise KeyError("no double-checked field with canonical submission")
@@ -632,71 +646,122 @@ class Db:
             )
         return out
 
-    def get_leaderboard(self) -> list[dict]:
+    def get_leaderboard(self, search_mode: Optional[str] = None) -> list[dict]:
+        """All-time numbers-searched per (search_mode, username) — the
+        reference's cache_search_leaderboard shape (schema.sql:121-131)."""
+        q = "SELECT * FROM cache_search_leaderboard"
+        args: list = []
+        if search_mode:
+            q += " WHERE search_mode = ?"
+            args.append(search_mode)
         with self._lock:
-            rows = self._conn.execute(
-                "SELECT * FROM cache_leaderboard ORDER BY"
-                " CAST(numbers_checked AS TEXT) DESC"
-            ).fetchall()
-        return [
+            rows = self._conn.execute(q, args).fetchall()
+        out = [
             {
+                "search_mode": r["search_mode"],
                 "username": r["username"],
+                "total_range": str(unpad(r["total_range"])),
                 "submissions": r["submissions"],
-                "numbers_checked": str(unpad(r["numbers_checked"])),
                 "last_submission": r["last_submission"],
             }
             for r in rows
         ]
+        out.sort(key=lambda r: int(r["total_range"]), reverse=True)
+        return out
 
-    def get_search_rate(self) -> list[dict]:
+    def get_search_rate(self, search_mode: Optional[str] = None) -> list[dict]:
+        """Daily numbers-searched per (date, search_mode, username) over the
+        cache window — the reference's cache_search_rate_daily shape."""
+        q = "SELECT * FROM cache_search_rate_daily"
+        args: list = []
+        if search_mode:
+            q += " WHERE search_mode = ?"
+            args.append(search_mode)
+        q += " ORDER BY date ASC, search_mode ASC, username ASC"
         with self._lock:
-            rows = self._conn.execute(
-                "SELECT * FROM cache_search_rate ORDER BY hour ASC"
-            ).fetchall()
+            rows = self._conn.execute(q, args).fetchall()
         return [
             {
-                "hour": r["hour"],
-                "searched_detailed": str(unpad(r["searched_detailed"])),
-                "searched_niceonly": str(unpad(r["searched_niceonly"])),
+                "date": r["date"],
+                "search_mode": r["search_mode"],
+                "username": r["username"],
+                "total_range": str(unpad(r["total_range"])),
             }
             for r in rows
         ]
 
     # -- caches ------------------------------------------------------------
 
+    CACHE_RATE_WINDOW_DAYS = 90
+
     def refresh_search_caches(self) -> None:
-        """Rebuild leaderboard + search-rate caches (reference db_util/cache.rs:3-40)."""
+        """Rebuild the per-user/per-mode numbers-searched caches (reference
+        db_util/cache.rs:3-40): daily totals over a 90-day window and the
+        all-time leaderboard.
+
+        One pass over a single submissions-join-fields query; the aggregation
+        runs in Python because range sizes are padded u128 TEXT (SQLite's
+        integer SUM is i64 and would overflow on hi-base fields — the
+        reference leans on Postgres DECIMAL here)."""
+        from datetime import timedelta
+
+        cutoff = ts(now_utc() - timedelta(days=self.CACHE_RATE_WINDOW_DAYS))[:10]
         with self._lock, self._txn():
-            self._conn.execute("DELETE FROM cache_leaderboard")
             rows = self._conn.execute(
-                "SELECT username, COUNT(*) AS subs, MAX(submit_time) AS last"
-                " FROM submissions WHERE disqualified = 0 GROUP BY username"
+                "SELECT s.search_mode, s.username, s.submit_time, f.range_size"
+                " FROM submissions s JOIN fields f ON s.field_id = f.id"
+                " WHERE s.disqualified = 0"
             ).fetchall()
+            daily: dict[tuple, int] = {}
+            alltime: dict[tuple, list] = {}  # -> [total, subs, last]
             for r in rows:
-                checked = self._conn.execute(
-                    "SELECT f.range_size FROM submissions s JOIN fields f ON"
-                    " s.field_id = f.id WHERE s.username = ? AND s.disqualified = 0",
-                    (r["username"],),
-                ).fetchall()
-                total = sum(unpad(c["range_size"]) for c in checked)
-                self._conn.execute(
-                    "INSERT INTO cache_leaderboard (username, submissions,"
-                    " numbers_checked, last_submission) VALUES (?, ?, ?, ?)",
-                    (r["username"], r["subs"], pad(total), r["last"]),
-                )
-            self._conn.execute("DELETE FROM cache_search_rate")
-            rows = self._conn.execute(
-                "SELECT substr(submit_time, 1, 13) AS hour, search_mode,"
-                " COUNT(*) AS cnt FROM submissions GROUP BY hour, search_mode"
-            ).fetchall()
-            hours: dict[str, dict[str, int]] = {}
-            for r in rows:
-                hours.setdefault(r["hour"], {"detailed": 0, "niceonly": 0})[
-                    r["search_mode"]
-                ] = r["cnt"]
-            for hour, counts in hours.items():
-                self._conn.execute(
-                    "INSERT INTO cache_search_rate (hour, searched_detailed,"
-                    " searched_niceonly) VALUES (?, ?, ?)",
-                    (hour, pad(counts["detailed"]), pad(counts["niceonly"])),
-                )
+                size = unpad(r["range_size"])
+                date = r["submit_time"][:10]
+                key = (r["search_mode"], r["username"])
+                if date >= cutoff:
+                    dkey = (date, *key)
+                    daily[dkey] = daily.get(dkey, 0) + size
+                entry = alltime.setdefault(key, [0, 0, ""])
+                entry[0] += size
+                entry[1] += 1
+                entry[2] = max(entry[2], r["submit_time"])
+            self._conn.execute("DELETE FROM cache_search_rate_daily")
+            self._conn.executemany(
+                "INSERT INTO cache_search_rate_daily"
+                " (date, search_mode, username, total_range) VALUES (?, ?, ?, ?)",
+                [(d, m, u, pad(t)) for (d, m, u), t in daily.items()],
+            )
+            self._conn.execute("DELETE FROM cache_search_leaderboard")
+            self._conn.executemany(
+                "INSERT INTO cache_search_leaderboard"
+                " (search_mode, username, total_range, submissions,"
+                " last_submission) VALUES (?, ?, ?, ?, ?)",
+                [
+                    (m, u, pad(t), subs, last)
+                    for (m, u), (t, subs, last) in alltime.items()
+                ],
+            )
+
+    # -- disqualification --------------------------------------------------
+
+    def disqualify_submission(self, submission_id: int) -> int:
+        """Mark one submission disqualified. Returns rows changed. The next
+        consensus pass recomputes canon without it (consensus and the caches
+        both filter disqualified = 0)."""
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "UPDATE submissions SET disqualified = 1 WHERE id = ?",
+                (submission_id,),
+            )
+            return cur.rowcount
+
+    def disqualify_user(self, username: str) -> int:
+        """Disqualify every submission by a user (the reference's abuse
+        story: disqualification removes a user's results from consensus and
+        the leaderboard without deleting the audit trail)."""
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "UPDATE submissions SET disqualified = 1 WHERE username = ?",
+                (username,),
+            )
+            return cur.rowcount

@@ -28,13 +28,48 @@ U128_MAX = (1 << 128) - 1
 
 
 class FieldQueue:
-    """Thread-safe niceonly + detailed-thin pre-claim queues."""
+    """Thread-safe niceonly + detailed-thin pre-claim queues.
 
-    def __init__(self, db: Db):
+    Refills run on a BACKGROUND thread: a claim that dips below the threshold
+    only signals the refiller and pops immediately, so no claimant ever pays
+    bulk-claim latency (the whole point of the queues — the reference's
+    90-100 ms -> 3-5 ms win, CHANGELOG.md:42 — which an inline refill would
+    hand right back to whichever client drew the short straw). An EMPTY queue
+    returns None and the caller falls back to a direct DB claim."""
+
+    def __init__(self, db: Db, start_thread: bool = True):
         self.db = db
         self._niceonly: deque[FieldRecord] = deque()
         self._detailed_thin: deque[FieldRecord] = deque()
         self._lock = threading.Lock()
+        self._refill_wanted = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start_thread:
+            self._thread = threading.Thread(
+                target=self._refill_loop, name="field-queue-refill", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._refill_wanted.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _refill_loop(self) -> None:
+        while not self._stop.is_set():
+            self._refill_wanted.wait()
+            self._refill_wanted.clear()
+            if self._stop.is_set():
+                return
+            with self._lock:
+                need_no = len(self._niceonly) <= REFILL_THRESHOLD
+                need_dt = len(self._detailed_thin) <= DETAILED_REFILL_THRESHOLD
+            if need_no:
+                self.refill_niceonly()
+            if need_dt:
+                self.refill_detailed_thin()
 
     def niceonly_queue_size(self) -> int:
         with self._lock:
@@ -46,19 +81,21 @@ class FieldQueue:
 
     def claim_niceonly(self) -> Optional[FieldRecord]:
         with self._lock:
-            need_refill = len(self._niceonly) <= REFILL_THRESHOLD
-        if need_refill:
-            self.refill_niceonly()
-        with self._lock:
-            return self._niceonly.popleft() if self._niceonly else None
+            field = self._niceonly.popleft() if self._niceonly else None
+            low = len(self._niceonly) <= REFILL_THRESHOLD
+        if low:
+            self._refill_wanted.set()
+        return field
 
     def claim_detailed_thin(self) -> Optional[FieldRecord]:
         with self._lock:
-            need_refill = len(self._detailed_thin) <= DETAILED_REFILL_THRESHOLD
-        if need_refill:
-            self.refill_detailed_thin()
-        with self._lock:
-            return self._detailed_thin.popleft() if self._detailed_thin else None
+            field = (
+                self._detailed_thin.popleft() if self._detailed_thin else None
+            )
+            low = len(self._detailed_thin) <= DETAILED_REFILL_THRESHOLD
+        if low:
+            self._refill_wanted.set()
+        return field
 
     def refill_niceonly(self) -> None:
         try:

@@ -82,17 +82,28 @@ CREATE INDEX IF NOT EXISTS idx_claims_field ON claims(field_id);
 CREATE INDEX IF NOT EXISTS idx_submissions_field ON submissions(field_id);
 CREATE INDEX IF NOT EXISTS idx_submissions_claim ON submissions(claim_id);
 
--- Leaderboard / search-rate caches refreshed by the jobs runner
--- (reference schema.sql:111-131, db_util/cache.rs:3-40).
-CREATE TABLE IF NOT EXISTS cache_leaderboard (
-    username        TEXT PRIMARY KEY,
-    submissions     INTEGER NOT NULL,
-    numbers_checked TEXT NOT NULL,
-    last_submission TEXT NOT NULL
+-- Leaderboard / search-rate caches refreshed by the jobs runner, with the
+-- reference's semantics (reference schema.sql:111-131, db_util/cache.rs:3-40):
+-- numbers searched (sum of field range sizes), per user AND per search mode;
+-- daily buckets over a 90-day window plus an all-time leaderboard.
+CREATE TABLE IF NOT EXISTS cache_search_rate_daily (
+    date            TEXT NOT NULL,                 -- ISO date bucket
+    search_mode     TEXT NOT NULL,
+    username        TEXT NOT NULL,
+    total_range     TEXT NOT NULL,                 -- padded u128 decimal
+    PRIMARY KEY (date, search_mode, username)
 );
 
-CREATE TABLE IF NOT EXISTS cache_search_rate (
-    hour            TEXT PRIMARY KEY,              -- ISO hour bucket
-    searched_detailed TEXT NOT NULL,
-    searched_niceonly TEXT NOT NULL
+CREATE TABLE IF NOT EXISTS cache_search_leaderboard (
+    search_mode     TEXT NOT NULL,
+    username        TEXT NOT NULL,
+    total_range     TEXT NOT NULL,                 -- padded u128 decimal
+    submissions     INTEGER NOT NULL,
+    last_submission TEXT NOT NULL,
+    PRIMARY KEY (search_mode, username)
 );
+
+CREATE INDEX IF NOT EXISTS idx_cache_rate_daily_mode_date
+    ON cache_search_rate_daily(search_mode, date);
+CREATE INDEX IF NOT EXISTS idx_cache_leaderboard_mode
+    ON cache_search_leaderboard(search_mode, total_range DESC);

@@ -1,0 +1,85 @@
+"""Adaptive MSD floor controller (ref client_process_gpu.rs:103-184 analog)."""
+
+import pytest
+
+from nice_tpu.ops import adaptive_floor as af
+
+
+def make(seed=16000):
+    return af.AdaptiveFloor(seed=seed)
+
+
+def test_warmup_skips_adaptation():
+    c = make()
+    start = c.current()
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(10.0, 0.1)
+    assert c.current() == start  # warmup fields observed, no movement
+
+
+def test_moves_toward_balance_and_clamps_step():
+    c = make()
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(1.0, 1.0)
+    start = c.current()
+    c.observe(3.0, 1.0)  # host-dominated -> coarsen, but at most MAX_STEP
+    assert c.current() == int(start * af.MAX_STEP)
+    c.observe(0.5, 2.0)  # device-dominated -> refine
+    assert c.current() < int(start * af.MAX_STEP)
+
+
+def test_balanced_field_holds_floor():
+    c = make()
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(1.0, 1.0)
+    start = c.current()
+    c.observe(1.0, 1.0)
+    assert c.current() == start
+
+
+def test_bounds():
+    c = make(seed=af.FLOOR_MIN)
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(1.0, 1.0)
+    c.observe(0.001, 10.0)  # push down: already at min
+    assert c.current() == af.FLOOR_MIN
+    c2 = make(seed=af.FLOOR_MAX)
+    for _ in range(af.WARMUP_FIELDS):
+        c2.observe(1.0, 1.0)
+    c2.observe(10.0, 0.001)  # push up: already at max
+    assert c2.current() == af.FLOOR_MAX
+
+
+def test_tiny_fields_ignored():
+    c = make()
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(1.0, 1.0)
+    start = c.current()
+    c.observe(0.0001, 0.0001)  # both phases in the noise
+    assert c.current() == start
+
+
+def test_env_pin_disables_adaptation(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MSD_FLOOR", "12345")
+    af.reset_for_tests()
+    c = af.get_floor_controller()
+    assert c.current() == 12345
+    c.observe(100.0, 0.001)
+    assert c.current() == 12345
+    af.reset_for_tests()
+
+
+def test_env_invalid_falls_back_to_adaptive(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MSD_FLOOR", "not-a-number")
+    af.reset_for_tests()
+    c = af.get_floor_controller()
+    assert not c.pinned
+    assert af.FLOOR_MIN <= c.current() <= af.FLOOR_MAX
+    af.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    af.reset_for_tests()
+    yield
+    af.reset_for_tests()

@@ -59,6 +59,20 @@ def test_sharded_niceonly_strided_matches_scalar():
     ]
 
 
+def test_sharded_niceonly_strided_above_u64():
+    """Bases 60-95 have range ends above 2^64: the descriptor columns must
+    carry values as two u64 halves, not a single u64."""
+    base = 60
+    br = base_range.get_base_range(base)
+    assert br[0] > 1 << 64  # the premise this test pins
+    rng = FieldSize(br[0], br[0] + 40_000)
+    got = engine.process_range_niceonly(rng, base, backend="pallas", batch_size=128)
+    want = scalar.process_range_niceonly(rng, base)
+    assert [n.number for n in got.nice_numbers] == [
+        n.number for n in want.nice_numbers
+    ]
+
+
 def test_shard_disable_env(monkeypatch):
     monkeypatch.setenv("NICE_TPU_SHARD", "0")
     assert engine._mesh_or_none() is None

@@ -20,57 +20,84 @@ class WorkerPool {
     const chunk = total / BigInt(n);
 
     return new Promise((resolve, reject) => {
-      const workers = [];
+      const workers = new Array(n).fill(null);
       const results = new Array(n).fill(null);
+      const retried = new Array(n).fill(false);
+      const workerProcessed = new Array(n).fill(0n);
       let done = 0;
-      let failed = 0;
-      let processed = 0n;
+      let failures = 0;
       let lastReport = 0;
+      let settled = false;
 
-      const finish = () => {
-        workers.forEach((w) => w.terminate());
-        const ok = results.filter((r) => r !== null);
-        if (failed * 2 >= n) {
-          reject(new Error(`${failed}/${n} workers failed; aborting field`));
-          return;
-        }
-        resolve(WorkerPool.aggregate(ok, data.base));
+      // A field submit must cover the WHOLE range: partial aggregates are
+      // never valid results (the server recomputes and would reject — or
+      // worse, record a wrong distribution). Every failed sub-range gets one
+      // retry on a fresh worker (even in a 1-worker pool); a sub-range
+      // failing twice aborts the field — which also bounds systemic failures
+      // at one retry round.
+      const finish = (err) => {
+        if (settled) return;
+        settled = true;
+        workers.forEach((w) => w && w.terminate());
+        if (err) reject(err);
+        else resolve(WorkerPool.aggregate(results, data.base));
       };
 
-      for (let i = 0; i < n; i++) {
-        const subStart = start + BigInt(i) * chunk;
-        const subEnd = i === n - 1 ? end : subStart + chunk;
+      const report = () => {
+        const now = Date.now();
+        if (now - lastReport > 250) {
+          lastReport = now;
+          const processed = workerProcessed.reduce((a, b) => a + b, 0n);
+          onProgress && onProgress(processed, total);
+        }
+      };
+
+      const launch = (i, subStart, subEnd) => {
         const w = new Worker("worker.js");
-        workers.push(w);
+        workers[i] = w;
         w.onmessage = (e) => {
           const msg = e.data;
           if (msg.type === "progress") {
-            processed += BigInt(msg.processed);
-            const now = Date.now();
-            if (now - lastReport > 250) {
-              lastReport = now;
-              onProgress && onProgress(processed, total);
-            }
+            workerProcessed[i] += BigInt(msg.processed);
+            report();
           } else if (msg.type === "complete") {
             results[i] = msg.result;
-            if (++done + failed === n) finish();
+            if (++done === n) finish();
           } else if (msg.type === "error") {
-            console.error("worker error:", msg.message);
-            failed++;
-            if (done + failed === n) finish();
+            onFailure(i, subStart, subEnd, msg.message);
           }
         };
-        w.onerror = (err) => {
-          console.error("worker crashed:", err.message);
-          failed++;
-          if (done + failed === n) finish();
-        };
+        w.onerror = (err) => onFailure(i, subStart, subEnd, err.message);
         w.postMessage({
           type: "process",
           start: subStart.toString(),
           end: subEnd.toString(),
           base: data.base,
         });
+      };
+
+      const onFailure = (i, subStart, subEnd, message) => {
+        console.error(`worker ${i} failed:`, message);
+        workers[i].terminate();
+        workerProcessed[i] = 0n; // the retry re-processes from the start
+        failures++;
+        if (!retried[i]) {
+          retried[i] = true;
+          launch(i, subStart, subEnd);
+        } else {
+          finish(
+            new Error(
+              `sub-range ${i} failed twice (${message}); ` +
+              `${failures}/${n} total failures; aborting field`
+            )
+          );
+        }
+      };
+
+      for (let i = 0; i < n; i++) {
+        const subStart = start + BigInt(i) * chunk;
+        const subEnd = i === n - 1 ? end : subStart + chunk;
+        launch(i, subStart, subEnd);
       }
     });
   }

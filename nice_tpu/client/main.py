@@ -287,6 +287,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(
         level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
     )
+    # Make JAX_PLATFORMS authoritative: some PJRT plugins override the env
+    # var at import time, so a user's JAX_PLATFORMS=cpu would otherwise
+    # still grab (or hang on) an accelerator (see nice_tpu/utils/platform.py).
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform and args.backend in ("jax", "jnp", "pallas"):
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if args.benchmark:
         return run_benchmark(args)
     if args.validate:

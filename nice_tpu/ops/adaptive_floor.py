@@ -107,7 +107,7 @@ def get_floor_controller(pipeline: str = "strided") -> AdaptiveFloor:
             if raw:
                 try:
                     pinned = max(1, int(float(raw)))
-                except ValueError:
+                except (ValueError, OverflowError):  # e.g. "abc", "inf"
                     pass  # fall through to adaptive
             ctrl = _CONTROLLERS[pipeline] = AdaptiveFloor(pinned=pinned)
         return ctrl

@@ -273,6 +273,53 @@ def _native_threads() -> int:
     return max(1, int(os.environ.get("NICE_THREADS", os.cpu_count() or 1)))
 
 
+def _pick_stride_depth(base: int, ranges, max_k: int = 3) -> tuple[int, int]:
+    """Choose the CRT stride depth k and kernel periods for the strided
+    device path.
+
+    This is the TPU re-design of the reference's fused low-digit GPU
+    prefilter (nice_kernels.cu:329-383, gated per base by measured survival,
+    client_process_gpu.rs:407-450): on a VPU there is no warp divergence to
+    early-exit with, so instead of evaluating the low-digit predicate on
+    device we FOLD it into the CRT stride table (deeper k = modulus
+    (b-1)*b^k filters k low digits of the sqube) and let the host index
+    arithmetic compact the lanes before they ever reach the device (P7).
+
+    Deeper k trades a bigger modulus (coarser descriptor spans -> masked-lane
+    waste on narrow MSD ranges) for fewer candidate lanes per number. The
+    score is expected device lanes per covered number on the field's median
+    surviving range width; a deeper k must beat the shallower one by >5%
+    (the reference's measured-win gate, which compiled its prefilter out at
+    b42+ where survival made it a loss).
+
+    Returns (k, periods) with periods * modulus sized to the median range.
+    """
+    from nice_tpu.ops import stride_filter
+
+    if not ranges:
+        return 1, pe.STRIDED_PERIODS
+    widths = sorted(r.size() for r in ranges)
+    typical = max(1, widths[len(widths) // 2])
+
+    best: tuple[float, int, int] | None = None
+    for k in range(1, max_k + 1):
+        modulus = (base - 1) * base**k
+        if pe.STRIDED_PERIODS * modulus >= 1 << 32:
+            break  # kernel index arithmetic is u32 (StrideSpec contract)
+        table = stride_filter.get_stride_table(base, k)
+        if table.num_residues == 0:
+            return k, 1  # provably nothing to search at any depth
+        periods = max(1, min(pe.STRIDED_PERIODS, typical // modulus))
+        span = periods * modulus
+        # Expected device lanes per covered number on the median range.
+        descs = -(-typical // span)
+        score = descs * periods * table.num_residues / typical
+        if best is None or score < best[0] * 0.95:
+            best = (score, k, periods)
+    assert best is not None
+    return best[1], best[2]
+
+
 def _host_strided_scan(table, base: int, start: int, end: int) -> list[int]:
     """Exact nice numbers among stride candidates in [start, end) (host path,
     native C++ when available)."""
@@ -308,16 +355,6 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     from nice_tpu.ops import adaptive_floor, msd_filter, stride_filter
 
     plan = get_plan(base)
-    table = stride_filter.get_stride_table(base, 1)
-    if table.num_residues == 0:
-        return []
-    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
-    modulus = table.modulus
-    if pe._interpret():
-        desc_max, periods = 8, 8  # keep interpreter-mode tests fast
-    else:
-        desc_max, periods = pe.STRIDED_DESC_MAX, pe.STRIDED_PERIODS
-    span = periods * modulus
 
     # Coarse host filter down to the adaptive recursion floor: cheap device
     # lanes make a high floor optimal (reference floor sweep,
@@ -327,7 +364,20 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     ctrl = adaptive_floor.get_floor_controller("strided")
     t_host0 = time.monotonic()
     ranges = msd_filter.get_valid_ranges(core, base, min_range_size=ctrl.current())
+
+    k, periods = _pick_stride_depth(base, ranges)
+    table = stride_filter.get_stride_table(base, k)
     host_secs = time.monotonic() - t_host0
+    if table.num_residues == 0:
+        return []
+    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
+    modulus = table.modulus
+    if pe._interpret():
+        desc_max = 8  # keep interpreter-mode tests fast
+        periods = min(periods, 8)
+    else:
+        desc_max = pe.STRIDED_DESC_MAX
+    span = periods * modulus
 
     # Descriptor batches shard across the mesh when >1 device is visible:
     # each device runs the strided kernel on its own desc_max rows and the
@@ -601,8 +651,8 @@ def process_range_niceonly(
         )
         backend = "jnp"
     if backend == "pallas":
-        # Stride-compacted device path (builds its own k=1 table — the 2D
-        # period x residue layout wants a small residue set; any passed
+        # Stride-compacted device path (picks its own table depth via
+        # _pick_stride_depth and expands offsets host-side; any passed
         # stride_table only parameterizes the scalar/host paths).
         nice_numbers.extend(
             NiceNumberSimple(number=n, num_uniques=base)

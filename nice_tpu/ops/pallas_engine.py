@@ -162,14 +162,19 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
 # --------------------------------------------------------------------------
 #
 # Candidates are enumerated by index arithmetic from the CRT stride table —
-# n = n0 + row * M + residues[col] — laid out as a 2D block: periods along
-# sublanes, residue slots along lanes. No gather: the residue table is a
-# broadcast row. This is the TPU analog of the reference GPU's on-device
-# candidate reconstruction B0 + (g/R)*M + residues[g%R]
-# (nice_kernels.cu:452-457); the 2D layout replaces the div/mod entirely.
+# candidate i of a descriptor is n = n0 + offsets[i], where the offset table
+# offsets[i] = (i // R) * M + residues[i % R] is pre-expanded ON THE HOST
+# (u32, periods * M < 2^32 by the StrideSpec contract) and laid out as dense
+# (8, 128) VMEM tiles. This is the TPU analog of the reference GPU's
+# on-device candidate reconstruction B0 + (g/R)*M + residues[g%R]
+# (nice_kernels.cu:452-457) — the host expansion replaces the div/mod, keeps
+# every block a full (8, 128) VPU tile at ANY stride depth (a deep-k table
+# with periods=1 would starve the sublane axis in a periods-by-residues
+# layout), and costs periods*R*4 bytes of VMEM (70 KB at b40 k=1 ... 2.6 MB
+# at b50 k=3).
 #
 # One execution processes up to STRIDED_DESC_MAX range descriptors (one per
-# outer grid step; the inner grid walks residue tiles), because each
+# outer grid step; the inner grid walks offset tiles), because each
 # pallas_call execution carries a fixed dispatch latency — the analog of the
 # reference batching 65k ranges per launch (client_process_gpu.rs:667-682).
 # Each descriptor is (n0 limbs, range-lo limbs, range-hi limbs) packed into a
@@ -177,8 +182,9 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
 # stats tile so the host re-scans only descriptors that actually hit.
 
 STRIDED_DESC_MAX = 1024  # descriptors per execution (stats tile rows 0..7)
-STRIDED_PERIODS = 128    # stride periods per descriptor (block sublanes)
+STRIDED_PERIODS = 128    # max stride periods per descriptor
 _DESC_WIDTH = 12         # u32 fields per descriptor: n0[4] lo[4] hi[4]
+_TILE_LANES = 8 * 128    # offsets per (8, 128) grid tile
 
 
 class StrideSpec:
@@ -203,50 +209,45 @@ class StrideSpec:
     def num_residues(self) -> int:
         return len(self.residues)
 
-    @property
-    def residue_tiles(self) -> int:
-        return max(1, -(-len(self.residues) // 128))
 
-    def padded_residues(self) -> np.ndarray:
-        out = np.zeros((self.residue_tiles, 128), dtype=np.uint32)
-        flat = out.reshape(-1)
-        flat[: self.num_residues] = self.residues
-        return out
-
-    def descriptor_span(self) -> int:
-        """Numbers covered by one descriptor's period block."""
-        return STRIDED_PERIODS * self.modulus
+def _expanded_offsets(spec: StrideSpec, periods: int) -> np.ndarray:
+    """Dense candidate offsets (i // R) * M + residues[i % R] for one
+    descriptor span, tiled as ((tiles * 8), 128) u32 with zero padding."""
+    res = np.asarray(spec.residues, dtype=np.uint32)
+    offs = (
+        np.arange(periods, dtype=np.uint32)[:, None] * np.uint32(spec.modulus)
+        + res[None, :]
+    ).reshape(-1)
+    tiles = -(-offs.size // _TILE_LANES)
+    out = np.zeros(tiles * _TILE_LANES, dtype=np.uint32)
+    out[: offs.size] = offs
+    return out.reshape(tiles * 8, 128)
 
 
 def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int):
-    R = spec.num_residues
-    M = np.uint32(spec.modulus)
+    total = periods * spec.num_residues
 
-    def kernel(desc_ref, res_ref, out_ref):
+    def kernel(desc_ref, offs_ref, out_ref):
         d = pl.program_id(0)
-        rt = pl.program_id(1)
+        t = pl.program_id(1)
 
-        @pl.when((d == 0) & (rt == 0))
+        @pl.when((d == 0) & (t == 0))
         def _():
             for r in range(8):
                 for c in range(128):
                     out_ref[r, c] = 0
 
-        row = jax.lax.broadcasted_iota(jnp.uint32, (periods, 128), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (periods, 128), 1)
+        offs = offs_ref[pl.ds(t * 8, 8), :]
         n0 = [
-            jnp.full((periods, 128), desc_ref[d, i], dtype=jnp.uint32)
+            jnp.full((8, 128), desc_ref[d, i], dtype=jnp.uint32)
             for i in range(plan.limbs_n)
         ]
-        n = ve.add_u32(n0, row * M)
-        res_row = jnp.broadcast_to(
-            res_ref[pl.ds(rt, 1), :], (periods, 128)
-        ).astype(jnp.uint32)
-        n = ve.add_u32(n, res_row)
+        n = ve.add_u32(n0, offs)
 
+        idx = _block_iota(8) + t * _TILE_LANES
         lo = [desc_ref[d, 4 + i] for i in range(plan.limbs_n)]
         hi = [desc_ref[d, 8 + i] for i in range(plan.limbs_n)]
-        valid = (col + rt * 128 < R) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
+        valid = (idx < total) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
 
         uniques = ve.num_uniques_lanes(plan, n)
         cnt = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
@@ -260,17 +261,17 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
                       periods: int):
     assert num_desc <= STRIDED_DESC_MAX
     assert plan.limbs_n <= 4
-    res = spec.padded_residues()
+    offs = _expanded_offsets(spec, periods)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # descriptor table lands in SMEM
-        grid=(num_desc, spec.residue_tiles),
+        grid=(num_desc, offs.shape[0] // 8),
         in_specs=[
-            # Whole residue table resident in VMEM; the kernel dynamic-slices
-            # its residue tile (a (1,128) block would violate sublane tiling).
+            # Whole offset table resident in VMEM; the kernel dynamic-slices
+            # its (8, 128) tile.
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (8, 128), lambda d, rt, *_: (0, 0), memory_space=pltpu.SMEM
+            (8, 128), lambda d, t, *_: (0, 0), memory_space=pltpu.SMEM
         ),
     )
     call = pl.pallas_call(
@@ -282,7 +283,7 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
 
     @jax.jit
     def run(desc):
-        return call(desc, res)
+        return call(desc, offs)
 
     return run
 

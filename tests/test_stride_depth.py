@@ -1,0 +1,109 @@
+"""CRT stride-depth selection (the TPU re-design of the reference's fused
+low-digit GPU prefilter, nice_kernels.cu:329-383 / client_process_gpu.rs:407-450)
+and its soundness contract."""
+
+import numpy as np
+import pytest
+
+from nice_tpu.core import base_range
+from nice_tpu.core.types import FieldSize
+from nice_tpu.ops import engine, pallas_engine as pe, scalar, stride_filter
+from nice_tpu.ops.limbs import get_plan, int_to_limbs
+
+
+@pytest.mark.parametrize("base", [10, 40])
+@pytest.mark.parametrize("k", [1, 2, 3])
+def test_deeper_tables_never_reject_a_nice_number(base, k):
+    """Soundness mirror (ref client_process_gpu.rs:1289-1324): every nice
+    number is a stride candidate at EVERY depth k."""
+    table = stride_filter.get_stride_table(base, k)
+    br = base_range.get_base_range(base)
+    rng = FieldSize(br[0], min(br[1], br[0] + 30_000))
+    nice = scalar.process_range_niceonly(rng, base).nice_numbers
+    if base == 10:
+        assert [n.number for n in nice] == [69]
+    residues = set(table.valid_residues)
+    for n in nice:
+        assert n.number % table.modulus in residues, (k, n.number)
+
+
+@pytest.mark.parametrize("base", [30, 40, 50])
+def test_deeper_tables_are_sparser(base):
+    d = [
+        stride_filter.get_stride_table(base, k).num_residues
+        / ((base - 1) * base**k)
+        for k in (1, 2, 3)
+    ]
+    assert d[0] >= d[1] >= d[2]
+
+
+def test_pick_depth_narrow_ranges_stay_shallow():
+    # Median surviving range far narrower than the k=2 modulus: deeper k
+    # would waste masked lanes, so the gate keeps k=1.
+    br = base_range.get_base_range(40)
+    ranges = [FieldSize(br[0], br[0] + 4_000)] * 5
+    k, periods = engine._pick_stride_depth(40, ranges)
+    assert k == 1
+    assert 1 <= periods <= pe.STRIDED_PERIODS
+
+
+def test_pick_depth_wide_ranges_go_deeper():
+    # Only when ranges dwarf the deep spans does the density gain beat the
+    # tail-padding waste (at 50M-wide ranges the k=2 span of ~8M leaves
+    # ~12% ceil padding, more than the ~8% density win — the gate correctly
+    # stays at k=1 there; measured like the reference compiling its
+    # prefilter out at b42+).
+    br = base_range.get_base_range(40)
+    width = 500_000_000
+    ranges = [FieldSize(br[0], br[0] + width)] * 3
+    k, periods = engine._pick_stride_depth(40, ranges)
+    assert k == 2
+    span = periods * (39 * 40**k)
+    assert span <= width
+
+    narrower = [FieldSize(br[0], br[0] + 50_000_000)] * 3
+    k, _ = engine._pick_stride_depth(40, narrower)
+    assert k == 1  # padding waste > density gain at this width
+
+
+def test_pick_depth_respects_u32_contract():
+    for base in (40, 50, 60):
+        br = base_range.get_base_range(base)
+        ranges = [FieldSize(br[0], br[0] + 10**9)]
+        k, periods = engine._pick_stride_depth(base, ranges)
+        modulus = (base - 1) * base**k
+        assert pe.STRIDED_PERIODS * modulus < 1 << 32
+        assert periods * modulus < 1 << 32
+
+
+def test_strided_kernel_counts_match_host_at_k2():
+    """The device kernel mirrors the host scan on a DEEP (k=2) table too."""
+    base = 40
+    plan = get_plan(base)
+    table = stride_filter.get_stride_table(base, 2)
+    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
+    br = base_range.get_base_range(base)
+    periods = 2
+    span = periods * spec.modulus
+    lo = br[0] + 11
+    hi = lo + span + 5_000  # ragged: partial second descriptor
+    rows = []
+    n0 = (lo // spec.modulus) * spec.modulus
+    while n0 < hi:
+        rows.append((n0, lo, hi))
+        n0 += span
+    desc = np.zeros((len(rows), 12), dtype=np.uint32)
+    for i, (n0_, lo_, hi_) in enumerate(rows):
+        desc[i, 0:4] = int_to_limbs(n0_, 4)
+        desc[i, 4:8] = int_to_limbs(lo_, 4)
+        desc[i, 8:12] = int_to_limbs(hi_, 4)
+    counts = np.asarray(
+        pe.niceonly_strided_batch(plan, spec, desc, periods=periods)
+    ).reshape(-1)
+    for i, (n0_, lo_, hi_) in enumerate(rows):
+        want = len(
+            table.iterate_range(
+                FieldSize(max(lo_, n0_), min(hi_, n0_ + span)), base
+            )
+        )
+        assert counts[i] == want, (i, counts[i], want)

@@ -43,12 +43,16 @@ class WorkerPool {
         else resolve(WorkerPool.aggregate(results, data.base));
       };
 
+      let maxProcessed = 0n; // keep the progress display monotonic: a retry
+      // resets its worker's counter (the sub-range really is re-processed),
+      // but the bar should not jump backwards while it catches up.
       const report = () => {
         const now = Date.now();
         if (now - lastReport > 250) {
           lastReport = now;
           const processed = workerProcessed.reduce((a, b) => a + b, 0n);
-          onProgress && onProgress(processed, total);
+          if (processed > maxProcessed) maxProcessed = processed;
+          onProgress && onProgress(maxProcessed, total);
         }
       };
 

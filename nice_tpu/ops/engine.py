@@ -355,6 +355,10 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     from nice_tpu.ops import adaptive_floor, msd_filter, stride_filter
 
     plan = get_plan(base)
+    # Bases with no valid residues (e.g. 15) provably contain no nice
+    # numbers: bail before paying the MSD host filter.
+    if stride_filter.get_stride_table(base, 1).num_residues == 0:
+        return []
 
     # Coarse host filter down to the adaptive recursion floor: cheap device
     # lanes make a high floor optimal (reference floor sweep,
@@ -369,6 +373,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     table = stride_filter.get_stride_table(base, k)
     host_secs = time.monotonic() - t_host0
     if table.num_residues == 0:
+        # A deeper refinement emptied out: nothing can be nice here.
         return []
     spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
     modulus = table.modulus

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Grouped bar chart of niceonly filter survival rates per base (reference
+scripts/filter_effectiveness_chart.py, fed by filter_effectiveness.py output).
+
+Reads the JSON lines produced by scripts/filter_effectiveness.py (one file or
+its scripts/.cache directory) and renders survival-per-filter bars per base.
+Lower is better: each bar is the fraction of candidates that SURVIVE that
+filter alone.
+
+Usage:
+    python scripts/filter_effectiveness.py --base 40 > /tmp/fe40.json
+    python scripts/filter_effectiveness.py --base 50 > /tmp/fe50.json
+    python scripts/filter_effectiveness_chart.py /tmp/fe40.json /tmp/fe50.json \
+        --out /tmp/filters.png
+    python scripts/filter_effectiveness_chart.py --cache --out /tmp/filters.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+CACHE_DIR = Path(__file__).resolve().parent / ".cache"
+
+# Filters in pipeline order; Okabe-Ito CVD-safe hues in fixed assignment.
+FILTERS = (
+    ("residue_survival", "residue (mod b-1)", "#0072B2"),
+    ("lsd_survival", "LSD (mod b^k)", "#E69F00"),
+    ("stride_survival", "CRT stride", "#009E73"),
+    ("msd_survival", "MSD prefix", "#CC79A7"),
+)
+
+
+def load(paths: list[str], use_cache: bool) -> list[dict]:
+    files = [Path(p) for p in paths]
+    if use_cache:
+        files += sorted(CACHE_DIR.glob("filter_effectiveness_*.json"))
+    out = []
+    for f in files:
+        try:
+            out.append(json.loads(f.read_text()))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+    seen = {}
+    for d in out:  # last measurement per base wins
+        seen[d["base"]] = d
+    return [seen[b] for b in sorted(seen)]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*", help="filter_effectiveness.py JSON outputs")
+    p.add_argument("--cache", action="store_true",
+                   help="also load everything under scripts/.cache")
+    p.add_argument("--out", help="write PNG here (default: text table)")
+    args = p.parse_args()
+
+    data = load(args.files, args.cache)
+    if not data:
+        print(
+            "no measurements; run scripts/filter_effectiveness.py first",
+            file=sys.stderr,
+        )
+        return 1
+
+    header = f"{'base':>5}" + "".join(f"{label:>18}" for _, label, _ in FILTERS)
+    print(header + f"{'combined':>12}")
+    for d in data:
+        row = f"{d['base']:>5}"
+        for key, _, _ in FILTERS:
+            row += f"{100 * d[key]:>17.2f}%"
+        print(row + f"{100 * d['combined_survival']:>11.3f}%")
+
+    if not args.out:
+        return 0
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    bases = [d["base"] for d in data]
+    x = np.arange(len(bases), dtype=float)
+    width = 0.8 / len(FILTERS)
+    fig, ax = plt.subplots(figsize=(max(7, 2.2 * len(bases)), 4.5))
+    for fi, (key, label, color) in enumerate(FILTERS):
+        offs = (fi - (len(FILTERS) - 1) / 2) * width
+        vals = [100 * d[key] for d in data]
+        bars = ax.bar(x + offs, vals, width * 0.92, color=color, label=label)
+        for rect, v in zip(bars, vals):
+            ax.annotate(
+                f"{v:.1f}", (rect.get_x() + rect.get_width() / 2, v),
+                textcoords="offset points", xytext=(0, 2), ha="center",
+                fontsize=7, color="#444444",
+            )
+    ax.set_xticks(x, [str(b) for b in bases])
+    ax.set_xlabel("base")
+    ax.set_ylabel("candidates surviving the filter (%)")
+    ax.set_title("Niceonly filter survival per base (lower is better)")
+    ax.legend(frameon=False, ncol=2)
+    ax.grid(axis="y", color="#dddddd", linewidth=0.6)
+    ax.set_axisbelow(True)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=140)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Gaussian fit of per-base uniques distributions (reference scripts/gaussian.py:
+fetch base stats, compare the empirical distribution against a normal fit, and
+estimate the odds of a fully-nice number per base).
+
+Reads base stats from a ledger (--db) or a running API (--api). For each base
+with recorded distribution data: fits N(mean, stdev), reports the tail
+probability P(uniques == base) under the fit vs the search size needed for one
+expected nice number, and optionally renders a chart per base.
+
+Usage:
+    python scripts/gaussian.py --db nice.db
+    python scripts/gaussian.py --api http://127.0.0.1:8127 --plot /tmp/gauss.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def load_bases(args) -> list[dict]:
+    if args.api:
+        with urllib.request.urlopen(f"{args.api}/stats/bases", timeout=30) as r:
+            return json.loads(r.read())
+    from nice_tpu.server.db import Db  # noqa: E402
+
+    db = Db(args.db)
+    try:
+        return db.get_base_stats()
+    finally:
+        db.close()
+
+
+def normal_sf(z: float) -> float:
+    """Survival function of the standard normal (no scipy needed)."""
+    return 0.5 * math.erfc(z / math.sqrt(2))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--db", default="nice.db")
+    p.add_argument("--api", help="API base URL (overrides --db)")
+    p.add_argument("--plot", help="write a PNG chart to this path (matplotlib)")
+    args = p.parse_args()
+
+    bases = [b for b in load_bases(args) if b.get("niceness_mean") is not None]
+    if not bases:
+        print("no bases with distribution stats yet (run some detailed fields)")
+        return 0
+
+    print(
+        f"{'base':>5} {'mean':>9} {'stdev':>8} {'z(nice)':>8} "
+        f"{'P(nice) fit':>12} {'E[search for 1]':>16}"
+    )
+    rows = []
+    for b in bases:
+        base = b["base"] if "base" in b else b["id"]
+        mean = float(b["niceness_mean"]) * base  # stored as niceness fraction
+        stdev = float(b["niceness_stdev"]) * base
+        if stdev <= 0:
+            continue
+        # P(uniques >= base) under the fit, with continuity correction.
+        z = (base - 0.5 - mean) / stdev
+        p_nice = normal_sf(z)
+        expect = (1 / p_nice) if p_nice > 0 else float("inf")
+        rows.append((base, mean, stdev, z, p_nice))
+        print(
+            f"{base:>5} {mean:>9.3f} {stdev:>8.3f} {z:>8.2f} "
+            f"{p_nice:>12.3e} {expect:>16.3e}"
+        )
+
+    if args.plot and rows:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        # One chart, one series (Okabe-Ito blue), one axis: the z-distance of
+        # "fully nice" from each base's fitted mean — the headline quantity.
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        xs = [r[0] for r in rows]
+        zs = [r[3] for r in rows]
+        ax.bar(xs, zs, color="#0072B2", width=0.7)
+        ax.set_xlabel("base")
+        ax.set_ylabel("z-score of uniques == base under N(mean, stdev)")
+        ax.set_title("How many standard deviations away is a nice number?")
+        ax.grid(axis="y", color="#dddddd", linewidth=0.6)
+        ax.set_axisbelow(True)
+        for spine in ("top", "right"):
+            ax.spines[spine].set_visible(False)
+        for x, z in zip(xs, zs):
+            ax.annotate(
+                f"{z:.1f}", (x, z), textcoords="offset points", xytext=(0, 3),
+                ha="center", fontsize=8, color="#444444",
+            )
+        fig.tight_layout()
+        fig.savefig(args.plot, dpi=140)
+        print(f"wrote {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

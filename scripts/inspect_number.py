@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Inspect a number's square-cube pandigital properties per base (reference
+scripts/inspect_number.py: valid-candidate window discovery + per-base digit
+breakdown).
+
+For each base where n falls in the valid range (digits(n^2) + digits(n^3)
+== b — necessary for niceness), prints n^2 and n^3 in base b, the combined
+digit multiset, num_uniques, niceness, the position inside the search range,
+and a digit histogram.
+
+Usage:
+    python scripts/inspect_number.py 69
+    python scripts/inspect_number.py 69 --base 10
+    python scripts/inspect_number.py 3141592653589793 --min-base 40 --max-base 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.core import base_range  # noqa: E402
+from nice_tpu.ops import scalar  # noqa: E402
+
+DIGITS36 = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def to_base(v: int, base: int) -> list[int]:
+    """Base-b digit list, most significant first."""
+    if v == 0:
+        return [0]
+    out = []
+    while v:
+        v, d = divmod(v, base)
+        out.append(d)
+    return out[::-1]
+
+
+def fmt_digits(digits: list[int], base: int) -> str:
+    if base <= 36:
+        return "".join(DIGITS36[d] for d in digits)
+    return "[" + " ".join(str(d) for d in digits) + "]"
+
+
+def inspect_in_base(n: int, base: int) -> None:
+    sq, cu = n * n, n * n * n
+    d_sq, d_cu = to_base(sq, base), to_base(cu, base)
+    combined = d_sq + d_cu
+    uniques = scalar.get_num_unique_digits(n, base)
+    r = base_range.get_base_range(base)
+    print(f"base {base}:")
+    print(f"  n^2 = {sq} = {fmt_digits(d_sq, base)} ({len(d_sq)} digits)")
+    print(f"  n^3 = {cu} = {fmt_digits(d_cu, base)} ({len(d_cu)} digits)")
+    print(
+        f"  combined digits: {len(combined)} of {base}; "
+        f"num_uniques = {uniques}; niceness = {uniques / base:.4f}"
+        + ("  <- NICE!" if uniques == base else "")
+    )
+    if r is not None:
+        pos = (n - r[0]) / max(1, r[1] - r[0])
+        print(
+            f"  search range: [{r[0]}, {r[1]}) — position {100 * pos:.2f}% through"
+        )
+    hist = [0] * base
+    for d in combined:
+        hist[d] += 1
+    missing = [d for d in range(base) if hist[d] == 0]
+    dupes = {d: c for d, c in enumerate(hist) if c > 1}
+    if missing:
+        print(f"  missing digits: {missing}")
+    if dupes:
+        print(f"  duplicated digits (digit: count): {dupes}")
+    print()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("number", type=int)
+    p.add_argument("--base", type=int, help="inspect only this base")
+    p.add_argument("--min-base", type=int, default=4)
+    p.add_argument("--max-base", type=int, default=120)
+    args = p.parse_args()
+    n = args.number
+    if n < 2:
+        print("number must be >= 2", file=sys.stderr)
+        return 1
+
+    if args.base is not None:
+        inspect_in_base(n, args.base)
+        return 0
+
+    found = []
+    for base in range(args.min_base, args.max_base + 1):
+        sq_digits = len(to_base(n * n, base))
+        cu_digits = len(to_base(n * n * n, base))
+        if sq_digits + cu_digits == base:
+            found.append(base)
+    if not found:
+        print(
+            f"{n} is not a valid candidate in any base in "
+            f"[{args.min_base}, {args.max_base}] (digit counts never sum to b)"
+        )
+        return 0
+    print(f"{n} is a valid candidate in base(s) {found}\n")
+    for base in found:
+        inspect_in_base(n, base)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Search-progress charts from the coordination ledger (reference
+scripts/progress_charts.py: submission history -> progress-over-time plots).
+
+Renders two PNGs from the sqlite ledger:
+  1. daily numbers searched, one line per search mode
+  2. cumulative numbers searched over time per mode
+
+With no --out, prints the daily totals as text instead.
+
+Usage:
+    python scripts/progress_charts.py --db nice.db --out /tmp/progress
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nice_tpu.server.db import Db, unpad  # noqa: E402
+
+# Okabe-Ito CVD-safe hues, fixed assignment: detailed is always blue,
+# niceonly always orange (color follows the entity, never the rank).
+MODE_COLORS = {"detailed": "#0072B2", "niceonly": "#E69F00"}
+MODES = ("detailed", "niceonly")
+
+
+def daily_totals(db: Db) -> dict[str, dict[str, int]]:
+    """date -> mode -> numbers searched that day (disqualified excluded)."""
+    with db._lock:
+        rows = db._conn.execute(
+            "SELECT s.submit_time, s.search_mode, f.range_size"
+            " FROM submissions s JOIN fields f ON s.field_id = f.id"
+            " WHERE s.disqualified = 0 ORDER BY s.submit_time ASC"
+        ).fetchall()
+    out: dict[str, dict[str, int]] = defaultdict(lambda: {m: 0 for m in MODES})
+    for r in rows:
+        out[r["submit_time"][:10]][r["search_mode"]] += unpad(r["range_size"])
+    return dict(out)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--db", default="nice.db")
+    p.add_argument("--out", help="output path prefix (writes <out>_daily.png"
+                                 " and <out>_cumulative.png)")
+    args = p.parse_args()
+
+    db = Db(args.db)
+    try:
+        daily = daily_totals(db)
+    finally:
+        db.close()
+    if not daily:
+        print("no submissions in the ledger yet")
+        return 0
+    days = sorted(daily)
+
+    if not args.out:
+        print(f"{'date':>10} {'detailed':>16} {'niceonly':>16}")
+        for d in days:
+            print(f"{d:>10} {daily[d]['detailed']:>16} {daily[d]['niceonly']:>16}")
+        return 0
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def style(ax):
+        ax.grid(axis="y", color="#dddddd", linewidth=0.6)
+        ax.set_axisbelow(True)
+        for spine in ("top", "right"):
+            ax.spines[spine].set_visible(False)
+        ax.tick_params(axis="x", rotation=45)
+
+    # 1) daily totals per mode (two series -> legend present)
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for mode in MODES:
+        ax.plot(
+            days, [daily[d][mode] for d in days],
+            color=MODE_COLORS[mode], linewidth=2, marker="o", markersize=4,
+            label=mode,
+        )
+    ax.set_ylabel("numbers searched per day")
+    ax.set_title("Daily search volume")
+    ax.legend(frameon=False)
+    style(ax)
+    fig.tight_layout()
+    daily_path = f"{args.out}_daily.png"
+    fig.savefig(daily_path, dpi=140)
+    print(f"wrote {daily_path}")
+
+    # 2) cumulative totals per mode
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for mode in MODES:
+        run, series = 0, []
+        for d in days:
+            run += daily[d][mode]
+            series.append(run)
+        ax.plot(
+            days, series, color=MODE_COLORS[mode], linewidth=2, label=mode
+        )
+    ax.set_ylabel("cumulative numbers searched")
+    ax.set_title("Search progress over time")
+    ax.legend(frameon=False)
+    style(ax)
+    fig.tight_layout()
+    cum_path = f"{args.out}_cumulative.png"
+    fig.savefig(cum_path, dpi=140)
+    print(f"wrote {cum_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Python twin of web/search/worker.js's FastEngine.
+
+There is no JS runtime in this image, so the browser fast engine is pinned by
+transliteration: this module re-implements FastEngine line-for-line (24-bit
+f64-exact limbs, schoolbook mul, small-constant chunked-radix digit peel, two
+u32 presence masks) and differential-tests it against the scalar oracle. The
+JS side additionally self-tests against its BigInt oracle at runtime on every
+field and falls back on mismatch (worker.js processRange)."""
+
+import math
+
+import pytest
+
+from nice_tpu.core import base_range
+from nice_tpu.ops import scalar
+
+LIMB = 1 << 24
+MASK32 = 0xFFFFFFFF
+
+
+def popcount32(x: int) -> int:
+    return bin(x & MASK32).count("1")
+
+
+class FastEngineTwin:
+    def __init__(self, base: int):
+        self.base = base
+        e = 1
+        while base ** (e + 1) <= LIMB:
+            e += 1
+        self.chunk_e = e
+        self.chunk_div = base**e
+
+    @staticmethod
+    def from_int(v: int) -> list[int]:
+        limbs = []
+        while v > 0:
+            limbs.append(v & (LIMB - 1))
+            v >>= 24
+        return limbs or [0]
+
+    @staticmethod
+    def to_int(limbs: list[int]) -> int:
+        v = 0
+        for x in reversed(limbs):
+            v = (v << 24) | x
+        return v
+
+    @staticmethod
+    def add_one(limbs: list[int]) -> None:
+        for i in range(len(limbs)):
+            limbs[i] += 1
+            if limbs[i] < LIMB:
+                return
+            limbs[i] = 0
+        limbs.append(1)
+
+    @staticmethod
+    def mul(a: list[int], b: list[int]) -> list[int]:
+        out = [0] * (len(a) + len(b))
+        for i, ai in enumerate(a):
+            carry = 0
+            for j, bj in enumerate(b):
+                t = out[i + j] + ai * bj + carry
+                assert t < 1 << 53  # the f64-exactness contract of the JS
+                carry = t // LIMB
+                out[i + j] = t - carry * LIMB
+            out[i + len(b)] += carry
+        while len(out) > 1 and out[-1] == 0:
+            out.pop()
+        return out
+
+    @staticmethod
+    def divmod_small(limbs: list[int], c: int) -> int:
+        rem = 0
+        for i in range(len(limbs) - 1, -1, -1):
+            cur = rem * LIMB + limbs[i]
+            assert cur < 1 << 53
+            q = cur // c
+            limbs[i] = q
+            rem = cur - q * c
+        while len(limbs) > 1 and limbs[-1] == 0:
+            limbs.pop()
+        return rem
+
+    @staticmethod
+    def is_zero(limbs: list[int]) -> bool:
+        return len(limbs) == 1 and limbs[0] == 0
+
+    def or_digits(self, value: list[int], masks: list[int]) -> None:
+        v = list(value)
+        base = self.base
+        while not self.is_zero(v):
+            rem = self.divmod_small(v, self.chunk_div)
+            last = self.is_zero(v)
+            for _ in range(self.chunk_e):
+                d = rem % base
+                rem = rem // base
+                if d < 32:
+                    masks[0] |= 1 << d
+                else:
+                    masks[1] |= 1 << (d - 32)
+                if last and rem == 0:
+                    break
+
+    def num_uniques(self, n_limbs: list[int]) -> int:
+        sq = self.mul(n_limbs, n_limbs)
+        cu = self.mul(sq, n_limbs)
+        masks = [0, 0]
+        self.or_digits(sq, masks)
+        self.or_digits(cu, masks)
+        return popcount32(masks[0]) + popcount32(masks[1])
+
+
+@pytest.mark.parametrize("base", [10, 17, 33, 40, 50, 64])
+def test_twin_matches_oracle_across_the_range(base):
+    br = base_range.get_base_range(base)
+    if br is None:
+        pytest.skip("no valid range")
+    eng = FastEngineTwin(base)
+    # Sample the start, middle and end of the valid range, plus 2^24-limb
+    # boundary crossers when the range contains one.
+    points = {br[0], (br[0] + br[1]) // 2, br[1] - 65}
+    boundary = ((br[0] >> 24) + 1) << 24
+    if boundary < br[1] - 64:
+        points.add(boundary - 3)
+    for p in points:
+        limbs = eng.from_int(p)
+        for n in range(p, min(p + 64, br[1])):
+            assert eng.num_uniques(limbs) == scalar.get_num_unique_digits(
+                n, base
+            ), (base, n)
+            eng.add_one(limbs)
+            assert eng.to_int(limbs) == n + 1
+
+
+def test_twin_base_ten_finds_69():
+    eng = FastEngineTwin(10)
+    limbs = eng.from_int(47)
+    found = []
+    for n in range(47, 100):
+        if eng.num_uniques(limbs) == 10:
+            found.append(n)
+        eng.add_one(limbs)
+    assert found == [69]
+
+
+def test_chunk_constants_match_js_f64_contract():
+    # chunkDiv <= 2^24 so rem * 2^24 + limb < 2^48 stays exact in f64.
+    for base in range(4, 65):
+        eng = FastEngineTwin(base)
+        assert eng.chunk_div <= LIMB
+        assert eng.chunk_div * base > LIMB  # e is maximal
+        assert eng.chunk_div == base**eng.chunk_e
+
+
+def test_mul_column_sums_fit_f64_for_supported_bases():
+    """The JS engine is gated at base <= 64: verify the worst-case cube
+    column sums stay under 2^53 there (asserted inside mul)."""
+    for base in (50, 60, 64):
+        br = base_range.get_base_range(base)
+        if br is None:
+            continue
+        eng = FastEngineTwin(base)
+        n = eng.from_int(br[1] - 1)
+        sq = eng.mul(n, n)
+        eng.mul(sq, n)  # raises inside mul if any column overflows

@@ -1,9 +1,18 @@
-// Browser search worker: processes a sub-range with a BigInt scalar engine.
+// Browser search worker: fixed-width fast engine + BigInt oracle fallback.
 //
-// The reference ships a WASM build of its Rust engine (wasm-client/src/lib.rs)
-// driven by this worker's twin (web/search/worker.js); here the engine is
-// plain JS BigInt — the same digit-peel algorithm as the scalar oracle
-// (nice_tpu/ops/scalar.py), bit-exact with every other backend.
+// The reference ships a WASM build of its Rust engine (wasm-client/src/lib.rs,
+// ~2x the JS engine per its README:15) driven by this worker's twin
+// (web/search/worker.js). This image has no WASM toolchain, so the compiled
+// engine is replaced by a fixed-width 24-bit-limb engine in plain JS — the
+// same design as the TPU vector engine (nice_tpu/ops/vector_engine.py:
+// fixed limbs, exact f64 24x24->48-bit products, chunked-radix digit peel
+// with a constant small divisor, u32 digit-presence masks + popcount) which
+// avoids BigInt allocation/division in the hot loop entirely.
+//
+// Safety: the fast engine SELF-TESTS against the BigInt oracle on the first
+// candidates of every field and falls back to the oracle on any mismatch
+// (the probe-and-degrade pattern used across this codebase); detailed
+// results are additionally recomputed server-side on submit.
 //
 // NOTE: the reference worker reads a differently-named result field than its
 // WASM emits (a latent mismatch, reference web/search/worker.js:83). Both
@@ -11,7 +20,11 @@
 
 "use strict";
 
-const PROGRESS_CHUNK = 100000n;
+const PROGRESS_CHUNK = 100000;
+
+// ---------------------------------------------------------------------------
+// BigInt oracle (previous engine; kept as self-test reference + fallback)
+// ---------------------------------------------------------------------------
 
 function numUniqueDigits(n, base) {
   const sq = n * n;
@@ -19,11 +32,134 @@ function numUniqueDigits(n, base) {
   let indicator = 0n;
   for (let v = sq; v !== 0n; v /= base) indicator |= 1n << v % base;
   for (let v = cu; v !== 0n; v /= base) indicator |= 1n << v % base;
-  // popcount of a BigInt bitmask
   let count = 0;
   for (let m = indicator; m !== 0n; m &= m - 1n) count++;
   return count;
 }
+
+// ---------------------------------------------------------------------------
+// Fixed-width fast engine: 24-bit limbs in f64 (exact up to 2^53)
+// ---------------------------------------------------------------------------
+
+const LIMB = 1 << 24;
+
+function popcount32(x) {
+  x -= (x >>> 1) & 0x55555555;
+  x = (x & 0x33333333) + ((x >>> 2) & 0x33333333);
+  x = (x + (x >>> 4)) & 0x0f0f0f0f;
+  return (x * 0x01010101) >>> 24;
+}
+
+class FastEngine {
+  // Supports base <= 64 (two u32 digit masks); callers fall back to the
+  // BigInt oracle beyond that.
+  constructor(baseInt) {
+    this.base = baseInt;
+    // Largest e with base^e <= 2^24: every chunk-division intermediate
+    // (rem * 2^24 + limb < chunkDiv * 2^24 <= 2^48) stays exact in f64.
+    let e = 1;
+    while (Math.pow(baseInt, e + 1) <= LIMB) e++;
+    this.chunkE = e;
+    this.chunkDiv = Math.pow(baseInt, e);
+  }
+
+  static fromBigInt(v) {
+    const limbs = [];
+    const mask = BigInt(LIMB - 1);
+    while (v > 0n) {
+      limbs.push(Number(v & mask));
+      v >>= 24n;
+    }
+    if (limbs.length === 0) limbs.push(0);
+    return limbs;
+  }
+
+  static toBigInt(limbs) {
+    let v = 0n;
+    for (let i = limbs.length - 1; i >= 0; i--) v = (v << 24n) | BigInt(limbs[i]);
+    return v;
+  }
+
+  static addOne(limbs) {
+    for (let i = 0; i < limbs.length; i++) {
+      if (++limbs[i] < LIMB) return;
+      limbs[i] = 0;
+    }
+    limbs.push(1);
+  }
+
+  // Schoolbook product; partial-product column sums stay < 2^53 for the
+  // sizes used here (<= ~16 limbs).
+  static mul(a, b) {
+    const out = new Array(a.length + b.length).fill(0);
+    for (let i = 0; i < a.length; i++) {
+      let carry = 0;
+      const ai = a[i];
+      for (let j = 0; j < b.length; j++) {
+        const t = out[i + j] + ai * b[j] + carry;
+        carry = Math.floor(t / LIMB);
+        out[i + j] = t - carry * LIMB;
+      }
+      out[i + b.length] += carry;
+    }
+    while (out.length > 1 && out[out.length - 1] === 0) out.pop();
+    return out;
+  }
+
+  // In-place divide by a small constant (< 2^24); returns the remainder.
+  // Every intermediate rem * 2^24 + limb < 2^48 is exact in f64.
+  static divmodSmall(limbs, c) {
+    let rem = 0;
+    for (let i = limbs.length - 1; i >= 0; i--) {
+      const cur = rem * LIMB + limbs[i];
+      const q = Math.floor(cur / c);
+      limbs[i] = q;
+      rem = cur - q * c;
+    }
+    while (limbs.length > 1 && limbs[limbs.length - 1] === 0) limbs.pop();
+    return rem;
+  }
+
+  static isZero(limbs) {
+    return limbs.length === 1 && limbs[0] === 0;
+  }
+
+  // OR the base-digit presence bits of `value` into masks [lo32, hi32],
+  // chunked-radix: peel chunkE digits per small division.
+  orDigits(value, masks) {
+    const v = value.slice();
+    const base = this.base;
+    while (!FastEngine.isZero(v)) {
+      let rem = FastEngine.divmodSmall(v, this.chunkDiv);
+      const last = FastEngine.isZero(v);
+      for (let p = 0; p < this.chunkE; p++) {
+        const d = rem % base;
+        rem = (rem - d) / base;
+        if (d < 32) masks[0] |= 1 << d;
+        else masks[1] |= 1 << (d - 32);
+        // Final chunk: stop at the value's true digit count (no phantom
+        // leading zeros — interior zeros still emit because rem > 0 or
+        // p-loop continues within a non-final chunk).
+        if (last && rem === 0) break;
+      }
+    }
+  }
+
+  numUniques(nLimbs) {
+    const sq = FastEngine.mul(nLimbs, nLimbs);
+    const cu = FastEngine.mul(sq, nLimbs);
+    const masks = [0, 0];
+    this.orDigits(sq, masks);
+    this.orDigits(cu, masks);
+    return popcount32(masks[0]) + popcount32(masks[1]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Range driver with startup self-test + fallback
+// ---------------------------------------------------------------------------
+
+const SELF_TEST_CANDIDATES = 256;
 
 function processRange(startStr, endStr, baseInt) {
   const base = BigInt(baseInt);
@@ -32,25 +168,62 @@ function processRange(startStr, endStr, baseInt) {
   for (let u = 1; u <= baseInt; u++) distribution[u] = 0;
   const niceNumbers = [];
 
-  let n = BigInt(startStr);
+  const start = BigInt(startStr);
   const end = BigInt(endStr);
-  let sinceProgress = 0n;
-  while (n < end) {
-    const u = numUniqueDigits(n, base);
-    distribution[u] += 1;
-    if (u > cutoff) {
-      niceNumbers.push({ number: n.toString(), num_uniques: u });
-    }
-    n += 1n;
-    sinceProgress += 1n;
-    if (sinceProgress >= PROGRESS_CHUNK) {
-      postMessage({ type: "progress", processed: sinceProgress.toString() });
-      sinceProgress = 0n;
+
+  let fast = null;
+  if (baseInt <= 64) {
+    fast = new FastEngine(baseInt);
+    // Self-test the fast engine against the oracle on this field's first
+    // candidates; any mismatch demotes the whole field to the oracle.
+    const probeEnd = start + BigInt(Math.min(SELF_TEST_CANDIDATES, Number(end - start)));
+    const probeLimbs = FastEngine.fromBigInt(start);
+    for (let p = start; p < probeEnd; p++) {
+      if (fast.numUniques(probeLimbs) !== numUniqueDigits(p, base)) {
+        console.warn(`fast engine mismatch at ${p} (base ${baseInt}); using BigInt engine`);
+        fast = null;
+        break;
+      }
+      FastEngine.addOne(probeLimbs);
     }
   }
-  if (sinceProgress > 0n) {
-    postMessage({ type: "progress", processed: sinceProgress.toString() });
+
+  let sinceProgress = 0;
+  const report = (final) => {
+    if (sinceProgress >= PROGRESS_CHUNK || (final && sinceProgress > 0)) {
+      postMessage({ type: "progress", processed: String(sinceProgress) });
+      sinceProgress = 0;
+    }
+  };
+
+  if (fast !== null) {
+    const nLimbs = FastEngine.fromBigInt(start);
+    const total = Number(end - start);
+    for (let i = 0; i < total; i++) {
+      const u = fast.numUniques(nLimbs);
+      distribution[u] += 1;
+      if (u > cutoff) {
+        niceNumbers.push({
+          number: FastEngine.toBigInt(nLimbs).toString(),
+          num_uniques: u,
+        });
+      }
+      FastEngine.addOne(nLimbs);
+      sinceProgress++;
+      report(false);
+    }
+  } else {
+    for (let n = start; n < end; n += 1n) {
+      const u = numUniqueDigits(n, base);
+      distribution[u] += 1;
+      if (u > cutoff) {
+        niceNumbers.push({ number: n.toString(), num_uniques: u });
+      }
+      sinceProgress++;
+      report(false);
+    }
   }
+  report(true);
   return { distribution, nice_numbers: niceNumbers };
 }
 

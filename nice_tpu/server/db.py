@@ -20,6 +20,7 @@ import os
 import random
 import sqlite3
 import threading
+from contextlib import contextmanager
 from datetime import datetime, timedelta, timezone
 from typing import Optional
 
@@ -124,26 +125,69 @@ def _numbers_from_json(s: str) -> list[NiceNumber]:
 
 
 class Db:
-    """Thread-safe ledger handle (one connection, process-level write lock)."""
+    """Thread-safe ledger handle: one RLock-guarded write connection (atomic
+    claim engine) plus a per-thread WAL read-connection pool."""
 
     def __init__(self, path: str = None):
         self.path = path or os.environ.get("NICE_DATABASE_PATH", "nice.db")
         self._lock = threading.RLock()
-        self._conn = sqlite3.connect(
+        self._conn = self._connect()  # write connection (claim path)
+        # Read pool: one connection per server thread (WAL readers never
+        # block each other or the writer), so analytics endpoints and submit
+        # verification reads don't serialize behind the claim path — the
+        # SQLite analog of the reference's r2d2 Postgres pool
+        # (db_util/mod.rs:39-61). The write connection stays single and
+        # RLock-guarded; BEGIN IMMEDIATE in _txn provides claim-path mutual
+        # exclusion, and busy_timeout makes writers from OTHER processes
+        # (multi-worker deployments, jobs runner alongside the API) wait out
+        # short bursts instead of failing with "database is locked" (the
+        # analog of FOR UPDATE SKIP LOCKED claims, db_util/fields.rs:204-536).
+        self._local = threading.local()
+        # (owner thread, conn); owner None = the write connection. Entries of
+        # dead threads are pruned on the next _read() — ThreadingHTTPServer
+        # spawns a thread per TCP connection, so without pruning the pool
+        # would leak one sqlite connection per request thread.
+        self._pool: list[tuple[Optional[threading.Thread], sqlite3.Connection]] = [
+            (None, self._conn)
+        ]
+        self._pool_lock = threading.Lock()
+        self._closed = False
+        self.init_schema()
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(
             self.path, check_same_thread=False, isolation_level=None
         )
-        self._conn.row_factory = sqlite3.Row
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA foreign_keys=ON")
-        # Cross-process safety: WAL readers never block, and writers from
-        # OTHER processes (multi-worker deployments, jobs runner alongside the
-        # API) wait out short write bursts instead of failing with
-        # "database is locked" (the SQLite analog of the reference's
-        # multi-worker Postgres FOR UPDATE SKIP LOCKED claims,
-        # db_util/fields.rs:204-536; BEGIN IMMEDIATE in _txn provides the
-        # claim-path mutual exclusion).
-        self._conn.execute("PRAGMA busy_timeout=10000")
-        self.init_schema()
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA foreign_keys=ON")
+        conn.execute("PRAGMA busy_timeout=10000")
+        return conn
+
+    def _read(self) -> sqlite3.Connection:
+        """This thread's read connection (created on first use)."""
+        if self._closed:
+            raise sqlite3.ProgrammingError("Db is closed")
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._local.conn = self._connect()
+            with self._pool_lock:
+                # Prune connections whose owner thread has exited (cross-
+                # thread close is safe: connections are opened with
+                # check_same_thread=False).
+                for owner, stale in [
+                    e for e in self._pool if e[0] is not None and not e[0].is_alive()
+                ]:
+                    stale.close()
+                    self._pool.remove((owner, stale))
+                self._pool.append((threading.current_thread(), conn))
+        return conn
+
+    @contextmanager
+    def _read_conn(self):
+        """Read-only access: the calling thread's pooled connection, no lock
+        (WAL readers are concurrent with each other and the writer)."""
+        yield self._read()
 
     def init_schema(self) -> None:
         schema_path = os.path.join(os.path.dirname(__file__), "schema.sql")
@@ -152,8 +196,12 @@ class Db:
                 self._conn.executescript(f.read())
 
     def close(self) -> None:
-        with self._lock:
-            self._conn.close()
+        with self._lock, self._pool_lock:
+            self._closed = True
+            for _, conn in self._pool:
+                conn.close()
+            self._pool.clear()
+            self._local = threading.local()
 
     # -- seeding ----------------------------------------------------------
 
@@ -233,8 +281,8 @@ class Db:
         )
 
     def get_field_by_id(self, field_id: int) -> FieldRecord:
-        with self._lock:
-            row = self._conn.execute(
+        with self._read_conn() as conn:
+            row = conn.execute(
                 "SELECT * FROM fields WHERE id = ?", (field_id,)
             ).fetchone()
         if row is None:
@@ -242,15 +290,15 @@ class Db:
         return self._row_to_field(row)
 
     def get_fields_in_base(self, base: int) -> list[FieldRecord]:
-        with self._lock:
-            rows = self._conn.execute(
+        with self._read_conn() as conn:
+            rows = conn.execute(
                 "SELECT * FROM fields WHERE base_id = ? ORDER BY id ASC", (base,)
             ).fetchall()
         return [self._row_to_field(r) for r in rows]
 
     def get_bases(self) -> list[int]:
-        with self._lock:
-            rows = self._conn.execute("SELECT id FROM bases ORDER BY id ASC").fetchall()
+        with self._read_conn() as conn:
+            rows = conn.execute("SELECT id FROM bases ORDER BY id ASC").fetchall()
         return [r["id"] for r in rows]
 
     def update_field_canon_and_cl(
@@ -356,8 +404,8 @@ class Db:
         raise ValueError(f"unknown strategy {claim_strategy}")
 
     def _max_field_id(self) -> int:
-        with self._lock:
-            row = self._conn.execute("SELECT MAX(id) AS m FROM fields").fetchone()
+        with self._read_conn() as conn:
+            row = conn.execute("SELECT MAX(id) AS m FROM fields").fetchone()
         return row["m"] or 0
 
     def _find_thin_chunk(self, maximum_check_level: int):
@@ -365,8 +413,8 @@ class Db:
         (reference db_util/fields.rs:349-380); ratio computed host-side
         because counts are u128 TEXT columns."""
         col = "checked_niceonly" if maximum_check_level == 0 else "checked_detailed"
-        with self._lock:
-            rows = self._conn.execute(
+        with self._read_conn() as conn:
+            rows = conn.execute(
                 f"SELECT id, {col} AS checked, range_size FROM chunks ORDER BY id ASC"
             ).fetchall()
         for row in rows:
@@ -374,8 +422,8 @@ class Db:
             if size == 0:
                 continue
             if unpad(row["checked"]) / size < DOWNSAMPLE_CUTOFF_PERCENT:
-                with self._lock:
-                    span = self._conn.execute(
+                with self._read_conn() as conn:
+                    span = conn.execute(
                         "SELECT MIN(id) AS lo, MAX(id) AS hi FROM fields"
                         " WHERE chunk_id = ?",
                         (row["id"],),
@@ -451,8 +499,8 @@ class Db:
         )
 
     def get_claim_by_id(self, claim_id: int) -> ClaimRecord:
-        with self._lock:
-            row = self._conn.execute(
+        with self._read_conn() as conn:
+            row = conn.execute(
                 "SELECT * FROM claims WHERE id = ?", (claim_id,)
             ).fetchone()
         if row is None:
@@ -521,8 +569,8 @@ class Db:
         )
 
     def get_submission_by_id(self, submission_id: int) -> SubmissionRecord:
-        with self._lock:
-            row = self._conn.execute(
+        with self._read_conn() as conn:
+            row = conn.execute(
                 "SELECT * FROM submissions WHERE id = ?", (submission_id,)
             ).fetchone()
         if row is None:
@@ -530,8 +578,8 @@ class Db:
         return self._row_to_submission(row)
 
     def get_detailed_submissions_by_field(self, field_id: int) -> list[SubmissionRecord]:
-        with self._lock:
-            rows = self._conn.execute(
+        with self._read_conn() as conn:
+            rows = conn.execute(
                 "SELECT * FROM submissions WHERE field_id = ? AND"
                 " search_mode = 'detailed' AND disqualified = 0 ORDER BY id ASC",
                 (field_id,),
@@ -539,8 +587,8 @@ class Db:
         return [self._row_to_submission(r) for r in rows]
 
     def get_fields_with_detailed_submissions(self, base: int) -> list[FieldRecord]:
-        with self._lock:
-            rows = self._conn.execute(
+        with self._read_conn() as conn:
+            rows = conn.execute(
                 "SELECT DISTINCT f.* FROM fields f JOIN submissions s"
                 " ON f.id = s.field_id WHERE f.base_id = ? AND"
                 " s.search_mode = 'detailed' ORDER BY f.id ASC",
@@ -560,15 +608,15 @@ class Db:
         pivot = random.randint(1, max_id)
         base_pred = "" if base is None else " AND base_id = ?"
         base_args = [] if base is None else [base]
-        with self._lock:
-            row = self._conn.execute(
+        with self._read_conn() as conn:
+            row = conn.execute(
                 "SELECT * FROM fields WHERE id >= ? AND check_level >= 2 AND"
                 f" canon_submission_id IS NOT NULL{base_pred}"
                 " ORDER BY id ASC LIMIT 1",
                 [pivot, *base_args],
             ).fetchone()
             if row is None:
-                row = self._conn.execute(
+                row = conn.execute(
                     "SELECT * FROM fields WHERE check_level >= 2 AND"
                     f" canon_submission_id IS NOT NULL{base_pred}"
                     " ORDER BY id ASC LIMIT 1",
@@ -614,8 +662,8 @@ class Db:
             )
 
     def get_chunks_in_base(self, base: int) -> list[sqlite3.Row]:
-        with self._lock:
-            return self._conn.execute(
+        with self._read_conn() as conn:
+            return conn.execute(
                 "SELECT * FROM chunks WHERE base_id = ? ORDER BY id ASC", (base,)
             ).fetchall()
 
@@ -623,8 +671,8 @@ class Db:
     # PostgREST views over the same tables, web/index.html:203-276) ---------
 
     def get_base_stats(self) -> list[dict]:
-        with self._lock:
-            rows = self._conn.execute(
+        with self._read_conn() as conn:
+            rows = conn.execute(
                 "SELECT * FROM bases ORDER BY id ASC"
             ).fetchall()
         out = []
@@ -654,8 +702,8 @@ class Db:
         if search_mode:
             q += " WHERE search_mode = ?"
             args.append(search_mode)
-        with self._lock:
-            rows = self._conn.execute(q, args).fetchall()
+        with self._read_conn() as conn:
+            rows = conn.execute(q, args).fetchall()
         out = [
             {
                 "search_mode": r["search_mode"],
@@ -678,8 +726,8 @@ class Db:
             q += " WHERE search_mode = ?"
             args.append(search_mode)
         q += " ORDER BY date ASC, search_mode ASC, username ASC"
-        with self._lock:
-            rows = self._conn.execute(q, args).fetchall()
+        with self._read_conn() as conn:
+            rows = conn.execute(q, args).fetchall()
         return [
             {
                 "date": r["date"],

@@ -188,6 +188,56 @@ class _SlowDb:
         return None
 
 
+def test_reads_not_blocked_by_write_lock(tmp_path):
+    """Analytics reads use the per-thread WAL read pool: a held write lock
+    (mid-claim) must not stall them (the SQLite analog of the reference's
+    r2d2 pool, db_util/mod.rs:39-61)."""
+    db = Db(str(tmp_path / "pool.db"))
+    db.seed_base(10, field_size=20)
+    result = {}
+
+    def reader():
+        t0 = time.monotonic()
+        result["bases"] = db.get_bases()
+        result["secs"] = time.monotonic() - t0
+
+    with db._lock:  # simulate a long write section on the claim path
+        db._conn.execute("BEGIN IMMEDIATE")
+        try:
+            t = threading.Thread(target=reader)
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), "reader blocked behind the write lock"
+        finally:
+            db._conn.execute("ROLLBACK")
+    assert result["bases"] == [10]
+    assert result["secs"] < 1.0, result["secs"]
+    db.close()
+
+
+def test_read_pool_prunes_dead_threads(tmp_path):
+    db = Db(str(tmp_path / "prune.db"))
+    db.seed_base(10, field_size=20)
+
+    def reader():
+        db.get_bases()
+
+    for _ in range(5):
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join()
+    db.get_bases()  # current thread's read triggers pruning
+    with db._pool_lock:
+        live = [e for e in db._pool if e[0] is None or e[0].is_alive()]
+        assert len(db._pool) == len(live)
+        assert len(db._pool) <= 3  # write conn + this thread + at most 1 racer
+    db.close()
+    import sqlite3 as sq
+
+    with pytest.raises(sq.ProgrammingError):
+        db.get_bases()  # use-after-close raises, never silently reopens
+
+
 def test_queue_refill_runs_off_the_claim_path():
     db = _SlowDb()
     q = FieldQueue(db, start_thread=True)

@@ -37,6 +37,22 @@ jobs db="nice.db":
 filter-effectiveness base="40":
     python scripts/filter_effectiveness.py --base {{base}}
 
+# grouped survival chart from cached filter-effectiveness measurements
+filter-chart out="/tmp/filters.png":
+    python scripts/filter_effectiveness_chart.py --cache --out {{out}}
+
+# inspect a number's niceness properties across bases
+inspect number="69":
+    python scripts/inspect_number.py {{number}}
+
+# gaussian fit of per-base uniques distributions from the ledger
+gaussian db="nice.db":
+    python scripts/gaussian.py --db {{db}}
+
+# daily + cumulative search-progress charts from the ledger
+progress db="nice.db" out="/tmp/progress":
+    python scripts/progress_charts.py --db {{db}} --out {{out}}
+
 # audit the C++ MSD filter against the Python definition
 msd-crosscheck:
     python scripts/msd_crosscheck.py

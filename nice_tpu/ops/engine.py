@@ -16,6 +16,7 @@ oracle per sub-range.
 
 from __future__ import annotations
 
+import logging
 from collections import deque
 
 import numpy as np
@@ -31,6 +32,8 @@ from nice_tpu.ops import pallas_engine as pe
 from nice_tpu.ops import scalar
 from nice_tpu.ops.limbs import get_plan, int_to_limbs, ints_to_limbs
 from nice_tpu.ops import vector_engine as ve
+
+log = logging.getLogger(__name__)
 
 # Default lanes per device batch. Large enough to amortize dispatch, small
 # enough to keep intermediates comfortably in HBM.
@@ -367,7 +370,8 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     # it (the analog of NICE_GPU_MSD_FLOOR, client_process_gpu.rs:103-184).
     ctrl = adaptive_floor.get_floor_controller("strided")
     t_host0 = time.monotonic()
-    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=ctrl.current())
+    floor_used = ctrl.current()
+    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=floor_used)
 
     k, periods = _pick_stride_depth(base, ranges)
     table = stride_filter.get_stride_table(base, k)
@@ -486,7 +490,9 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
             nice.extend(found)
 
     t_dev0 = time.monotonic()
+    n_desc = 0
     for cols in grouped_columns():
+        n_desc += len(cols[0])
         packed = pack(cols)
         if sharded_step is not None:
             counts = sharded_step(packed)
@@ -499,7 +505,16 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
         collect_one()
     # Device tail includes the rare-path host re-scan — both sit on the far
     # side of the host-filter/device boundary the controller balances.
-    ctrl.observe(host_secs, time.monotonic() - t_dev0)
+    device_secs = time.monotonic() - t_dev0
+    ctrl.observe(host_secs, device_secs)
+    # Per-phase trace (the reference logs its msd/gpu-tail split per field,
+    # client_process_gpu.rs:103-184): floor + depth + phase seconds.
+    log.debug(
+        "niceonly b%d [%d, %d): msd %.3fs (floor %d, %d ranges) | device "
+        "%.3fs (k=%d periods=%d, %d descriptors, %d devices) | %d nice",
+        base, core.start(), core.end(), host_secs, floor_used, len(ranges),
+        device_secs, k, periods, n_desc, n_dev, len(nice),
+    )
     return nice
 
 
@@ -647,9 +662,7 @@ def process_range_niceonly(
                 "pallas niceonly path carries 4 — use backend='jax' (dense "
                 "device scan) or 'native'/'scalar'"
             )
-        import logging
-
-        logging.getLogger(__name__).warning(
+        log.warning(
             "niceonly base %d exceeds 4 u32 limbs; falling back from the "
             "strided pallas path to the dense device scan",
             base,
@@ -714,8 +727,9 @@ def process_range_niceonly(
 
     ctrl = adaptive_floor.get_floor_controller("dense")
     t_host0 = time.monotonic()
+    floor_used = ctrl.current()
     sub_ranges = msd_filter.get_valid_ranges(
-        core, base, min_range_size=ctrl.current()
+        core, base, min_range_size=floor_used
     )
     host_secs = time.monotonic() - t_host0
     t_dev0 = time.monotonic()
@@ -733,7 +747,14 @@ def process_range_niceonly(
             done += valid
     while pending:
         collect_one()
-    ctrl.observe(host_secs, time.monotonic() - t_dev0)
+    device_secs = time.monotonic() - t_dev0
+    ctrl.observe(host_secs, device_secs)
+    log.debug(
+        "niceonly-dense b%d [%d, %d): msd %.3fs (floor %d, %d ranges) | "
+        "device %.3fs | %d nice",
+        base, core.start(), core.end(), host_secs, floor_used,
+        len(sub_ranges), device_secs, len(nice_numbers),
+    )
 
     nice_numbers.sort(key=lambda n: n.number)
     return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Sweep kernel tuning knobs on the real chip and report throughput.
+
+The analog of the reference's measured-sweep methodology (floor sweep
+client_process_gpu.rs:85-94, prefilter gate :407-450): measure, don't guess.
+Run on a TPU host; each configuration times a slice of the chosen benchmark
+field after a same-shape warmup so compile time is excluded.
+
+Usage:
+    python scripts/tune_kernels.py detailed --mode extra-large \
+        --slice 100000000 --batches 24,26,28
+    python scripts/tune_kernels.py niceonly --mode extra-large \
+        --slice 1000000000 --floors 65536,262144,1048576
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def time_detailed(data, batch_size: int, slice_size: int) -> float:
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import engine
+
+    warm = FieldSize(data.range_start, data.range_start + 1)
+    engine.process_range_detailed(warm, data.base, backend="jax",
+                                  batch_size=batch_size)
+    rng = FieldSize(data.range_start, data.range_start + slice_size)
+    t0 = time.monotonic()
+    engine.process_range_detailed(rng, data.base, backend="jax",
+                                  batch_size=batch_size)
+    return time.monotonic() - t0
+
+
+def time_niceonly(data, slice_size: int) -> float:
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import engine
+
+    warm = FieldSize(data.range_start, data.range_start + 1)
+    engine.process_range_niceonly(warm, data.base, backend="jax",
+                                  batch_size=1 << 20)
+    rng = FieldSize(data.range_start, data.range_start + slice_size)
+    t0 = time.monotonic()
+    engine.process_range_niceonly(rng, data.base, backend="jax",
+                                  batch_size=1 << 20)
+    return time.monotonic() - t0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("kind", choices=["detailed", "niceonly"])
+    p.add_argument("--mode", default="extra-large")
+    p.add_argument("--slice", type=int, default=100_000_000)
+    p.add_argument("--batches", default="22,24,26,28",
+                   help="log2 batch sizes to sweep (detailed)")
+    p.add_argument("--floors", default="65536,262144,1048576",
+                   help="MSD floors to sweep (niceonly; pins via env)")
+    args = p.parse_args()
+
+    # Make JAX_PLATFORMS authoritative (some PJRT plugins override the env
+    # var at import time; see nice_tpu/utils/platform.py).
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
+
+    data = get_benchmark_field(BenchmarkMode(args.mode))
+    print(f"{args.kind} {args.mode}: base {data.base}, slice {args.slice:.0e}")
+
+    if args.kind == "detailed":
+        for shift in (int(s) for s in args.batches.split(",")):
+            el = time_detailed(data, 1 << shift, args.slice)
+            print(
+                f"  batch 2^{shift}: {el:8.3f}s  "
+                f"{args.slice / el / 1e6:10.1f} M n/s"
+            )
+    else:
+        from nice_tpu.ops import adaptive_floor
+
+        for floor in (int(f) for f in args.floors.split(",")):
+            os.environ["NICE_TPU_MSD_FLOOR"] = str(floor)
+            adaptive_floor.reset_for_tests()  # re-read the pin
+            el = time_niceonly(data, args.slice)
+            print(
+                f"  floor {floor:>8}: {el:8.3f}s  "
+                f"{args.slice / el / 1e6:10.1f} M n/s"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

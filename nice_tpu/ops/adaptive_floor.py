@@ -27,11 +27,14 @@ from __future__ import annotations
 import os
 import threading
 
-# Below ~250 the device receives virtually the dense range; above the cap the
-# survival rate has saturated (ref sweep data) and host recursion time is
-# already negligible.
+# Below ~250 the device receives virtually the dense range; the cap exists
+# only to bound descriptor-span growth (the reference sweep shows survival
+# saturating, so past some point a coarser floor stops buying host time —
+# but on a 1-core host driving a whole chip the balance point can sit far
+# coarser than the reference's 64k GPU sweet spot, so the cap is generous
+# and the controller finds the knee).
 FLOOR_MIN = 250
-FLOOR_MAX = 1 << 20
+FLOOR_MAX = 1 << 24
 
 # Fields to observe before adapting (one-time jit/compile costs would skew
 # the first ratios).
@@ -42,6 +45,12 @@ MAX_STEP = 1.5
 
 # Phases shorter than this are measurement noise; treat as "free".
 MIN_SECS = 0.002
+
+# Fields whose whole pipeline ran faster than this carry no tuning signal
+# (warm-up probes, benchmark 1-number fields, fully-filtered ranges): one
+# fixed dispatch latency dwarfs the phase split and would walk the floor
+# away from its balance point between real fields.
+TRIVIAL_SECS = 0.25
 
 # Seed calibrated so a 32-core host lands near the reference's 16k sweet
 # spot; fewer cores -> coarser floor (host recursion is the bottleneck).
@@ -76,7 +85,7 @@ class AdaptiveFloor:
             if self._warmup > 0:
                 self._warmup -= 1
                 return
-            if device_secs < MIN_SECS and host_secs < MIN_SECS:
+            if host_secs + device_secs < TRIVIAL_SECS:
                 return  # field too small to tell anything
             if device_secs < MIN_SECS:
                 ratio = MAX_STEP  # device idle: host filter is over-working

@@ -47,6 +47,12 @@ DISPATCH_WINDOW = 32
 # device->host uniques transfer stays modest even when the stats batch is 2^28.
 RARE_SCAN_BATCH = 1 << 20
 
+# In-flight strided descriptor groups: deep enough to hide the per-dispatch
+# device round-trip latency behind compute (the axon tunnel adds tens of ms
+# per result readback; each pending entry holds only the tiny (8, 128) count
+# tile plus six u64 columns, so memory stays negligible).
+STRIDE_WINDOW = 16
+
 
 def _pick_backend(plan, batch_size: int, backend: str) -> str:
     """Resolve "jax" to the Pallas kernels when on TPU and the base/batch
@@ -276,7 +282,7 @@ def _native_threads() -> int:
     return max(1, int(os.environ.get("NICE_THREADS", os.cpu_count() or 1)))
 
 
-def _pick_stride_depth(base: int, ranges, max_k: int = 3) -> tuple[int, int]:
+def _pick_stride_depth(base: int, typical: int, max_k: int = 3) -> tuple[int, int]:
     """Choose the CRT stride depth k and kernel periods for the strided
     device path.
 
@@ -290,37 +296,61 @@ def _pick_stride_depth(base: int, ranges, max_k: int = 3) -> tuple[int, int]:
 
     Deeper k trades a bigger modulus (coarser descriptor spans -> masked-lane
     waste on narrow MSD ranges) for fewer candidate lanes per number. The
-    score is expected device lanes per covered number on the field's median
-    surviving range width; a deeper k must beat the shallower one by >5%
-    (the reference's measured-win gate, which compiled its prefilter out at
-    b42+ where survival made it a loss).
+    score is expected device lanes per covered number on a typical surviving
+    range width; a deeper k must beat the shallower one by >5% (the
+    reference's measured-win gate, which compiled its prefilter out at b42+
+    where survival made it a loss).
 
-    Returns (k, periods) with periods * modulus sized to the median range.
+    `typical` is the expected surviving-range width. Callers derive it from
+    the MSD floor alone (1.5x floor: the adaptive-depth recursion bounds
+    leaves to (floor, 2*floor]), which makes the choice — and therefore the
+    compiled kernel shape — deterministic per (base, floor): a benchmark
+    warm-up field compiles exactly the kernel the timed field will run, and
+    a production client never recompiles between fields at a stable floor.
+    Depths are scored with stride_residue_count (CRT product, no table
+    build); only the chosen depth's table is materialized. periods is a
+    power of two so a drifting adaptive floor reuses shapes.
     """
     from nice_tpu.ops import stride_filter
 
-    if not ranges:
-        return 1, pe.STRIDED_PERIODS
-    widths = sorted(r.size() for r in ranges)
-    typical = max(1, widths[len(widths) // 2])
-
+    typical = max(1, typical)
     best: tuple[float, int, int] | None = None
     for k in range(1, max_k + 1):
         modulus = (base - 1) * base**k
-        if pe.STRIDED_PERIODS * modulus >= 1 << 32:
-            break  # kernel index arithmetic is u32 (StrideSpec contract)
-        table = stride_filter.get_stride_table(base, k)
-        if table.num_residues == 0:
+        if modulus >= 1 << 32:
+            break  # kernel offset arithmetic is u32
+        num_res = stride_filter.stride_residue_count(base, k)
+        if num_res == 0:
             return k, 1  # provably nothing to search at any depth
-        periods = max(1, min(pe.STRIDED_PERIODS, typical // modulus))
+        cap = min(
+            pe.STRIDED_PERIODS_MAX,
+            ((1 << 32) - 1) // modulus,  # u32 span
+            max(1, pe.STRIDED_OFFS_LANES_MAX // num_res),  # VMEM offsets
+        )
+        raw = max(1, min(cap, typical // modulus))
+        periods = 1 << (raw.bit_length() - 1)
         span = periods * modulus
-        # Expected device lanes per covered number on the median range.
+        # Expected device lanes per covered number on the typical range.
         descs = -(-typical // span)
-        score = descs * periods * table.num_residues / typical
+        score = descs * periods * num_res / typical
         if best is None or score < best[0] * 0.95:
             best = (score, k, periods)
     assert best is not None
     return best[1], best[2]
+
+
+def _msd_depth_for(size: int, floor: int) -> int:
+    """Recursion depth that actually reaches `floor`-sized leaves.
+
+    The reference's fixed depth cap (msd_prefix_filter.rs:283, depth 22) was
+    tuned for CPU fields <= 1e9; at device scale (massive = 1e13) a fixed cap
+    silently decouples the adaptive floor from real leaf width (1e13 / 2^22
+    ~ 2.4e6 > any floor), so the cap grows with the field instead.
+    """
+    from nice_tpu.ops import msd_filter
+
+    need = max(0, (max(1, size) // max(1, floor)).bit_length()) + 1
+    return max(msd_filter.MSD_RECURSIVE_MAX_DEPTH, need)
 
 
 def _host_strided_scan(table, base: int, start: int, end: int) -> list[int]:
@@ -360,7 +390,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     plan = get_plan(base)
     # Bases with no valid residues (e.g. 15) provably contain no nice
     # numbers: bail before paying the MSD host filter.
-    if stride_filter.get_stride_table(base, 1).num_residues == 0:
+    if stride_filter.stride_residue_count(base, 1) == 0:
         return []
 
     # Coarse host filter down to the adaptive recursion floor: cheap device
@@ -369,16 +399,21 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     # hold host-filter time ~= device-tail time, and NICE_TPU_MSD_FLOOR pins
     # it (the analog of NICE_GPU_MSD_FLOOR, client_process_gpu.rs:103-184).
     ctrl = adaptive_floor.get_floor_controller("strided")
-    t_host0 = time.monotonic()
     floor_used = ctrl.current()
-    ranges = msd_filter.get_valid_ranges(core, base, min_range_size=floor_used)
-
-    k, periods = _pick_stride_depth(base, ranges)
+    # Kernel shape is a function of (base, floor) only — never of this
+    # field's actual ranges — so warm-up fields compile the exact production
+    # kernel (see _pick_stride_depth).
+    k, periods = _pick_stride_depth(base, floor_used + floor_used // 2)
     table = stride_filter.get_stride_table(base, k)
-    host_secs = time.monotonic() - t_host0
     if table.num_residues == 0:
         # A deeper refinement emptied out: nothing can be nice here.
         return []
+    t_host0 = time.monotonic()
+    ranges = msd_filter.get_valid_ranges(
+        core, base, min_range_size=floor_used,
+        max_depth=_msd_depth_for(core.size(), floor_used),
+    )
+    host_secs = time.monotonic() - t_host0
     spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
     modulus = table.modulus
     if pe._interpret():
@@ -499,7 +534,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
         else:
             counts = pe.niceonly_strided_batch(plan, spec, packed, periods=periods)
         pending.append((cols, counts))
-        if len(pending) >= 4:
+        if len(pending) >= STRIDE_WINDOW:
             collect_one()
     while pending:
         collect_one()
@@ -729,7 +764,8 @@ def process_range_niceonly(
     t_host0 = time.monotonic()
     floor_used = ctrl.current()
     sub_ranges = msd_filter.get_valid_ranges(
-        core, base, min_range_size=floor_used
+        core, base, min_range_size=floor_used,
+        max_depth=_msd_depth_for(core.size(), floor_used),
     )
     host_secs = time.monotonic() - t_host0
     t_dev0 = time.monotonic()

@@ -164,8 +164,8 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
 # Candidates are enumerated by index arithmetic from the CRT stride table —
 # candidate i of a descriptor is n = n0 + offsets[i], where the offset table
 # offsets[i] = (i // R) * M + residues[i % R] is pre-expanded ON THE HOST
-# (u32, periods * M < 2^32 by the StrideSpec contract) and laid out as dense
-# (8, 128) VMEM tiles. This is the TPU analog of the reference GPU's
+# (u32, periods * M < 2^32 checked at kernel build) and laid out as dense
+# (block_rows, 128) VMEM tiles. This is the TPU analog of the reference GPU's
 # on-device candidate reconstruction B0 + (g/R)*M + residues[g%R]
 # (nice_kernels.cu:452-457) — the host expansion replaces the div/mod, keeps
 # every block a full (8, 128) VPU tile at ANY stride depth (a deep-k table
@@ -181,22 +181,29 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
 # scalar-prefetched u32 row; per-descriptor nice counts land in the SMEM
 # stats tile so the host re-scans only descriptors that actually hit.
 
-STRIDED_DESC_MAX = 1024  # descriptors per execution (stats tile rows 0..7)
-STRIDED_PERIODS = 128    # max stride periods per descriptor
-_DESC_WIDTH = 12         # u32 fields per descriptor: n0[4] lo[4] hi[4]
-_TILE_LANES = 8 * 128    # offsets per (8, 128) grid tile
+STRIDED_DESC_MAX = 1024   # descriptors per execution (stats tile rows 0..7)
+STRIDED_PERIODS = 128     # default stride periods per descriptor
+STRIDED_PERIODS_MAX = 1024  # planning cap (span stays far below u32)
+STRIDED_OFFS_LANES_MAX = 1 << 20  # offsets-table VMEM budget (4 MiB of u32)
+_DESC_WIDTH = 12          # u32 fields per descriptor: n0[4] lo[4] hi[4]
+_STRIDED_BLOCK_ROWS_MAX = 256  # offset rows per grid step (32k lanes)
+_STRIDED_STEP_OVERHEAD_ROWS = 16  # Mosaic per-grid-step cost, in row units
 
 
 class StrideSpec:
-    """Hashable trace-time stride constants (modulus + residue table)."""
+    """Hashable trace-time stride constants (modulus + residue table).
+
+    The hash is computed once: deep tables carry ~1e5-1e6 residues and this
+    object keys every lru-cached kernel lookup on the dispatch path."""
 
     def __init__(self, modulus: int, residues: tuple):
-        assert modulus < 1 << 32 and STRIDED_PERIODS * modulus < 1 << 32
+        assert modulus < 1 << 32
         self.modulus = modulus
         self.residues = tuple(int(r) for r in residues)
+        self._hash = hash((self.modulus, self.residues))
 
     def __hash__(self):
-        return hash((self.modulus, self.residues))
+        return self._hash
 
     def __eq__(self, other):
         return (
@@ -210,21 +217,45 @@ class StrideSpec:
         return len(self.residues)
 
 
-def _expanded_offsets(spec: StrideSpec, periods: int) -> np.ndarray:
+def _strided_tiling(total: int) -> tuple[int, int]:
+    """(rows, block_rows) for a `total`-lane offset table.
+
+    block_rows is the per-grid-step row count: big blocks amortize Mosaic's
+    per-step overhead (the original (8, 128) tiles spent ~2x the lane compute
+    on grid-step overhead at 1024 lanes/step), but must divide the padded row
+    count. Minimizes padded_rows + steps * overhead over a small search of
+    8-row paddings."""
+    rows0 = max(1, -(-total // 128))
+    r8 = -(-rows0 // 8) * 8
+    best = None
+    for rows in range(r8, r8 + 137, 8):
+        m = rows // 8
+        d = max(x for x in range(1, _STRIDED_BLOCK_ROWS_MAX // 8 + 1) if m % x == 0)
+        br = 8 * d
+        cost = rows + (rows // br) * _STRIDED_STEP_OVERHEAD_ROWS
+        if best is None or cost < best[0]:
+            best = (cost, rows, br)
+    _, rows, br = best
+    return rows, br
+
+
+def _expanded_offsets(spec: StrideSpec, periods: int) -> tuple[np.ndarray, int]:
     """Dense candidate offsets (i // R) * M + residues[i % R] for one
-    descriptor span, tiled as ((tiles * 8), 128) u32 with zero padding."""
+    descriptor span, as (rows, 128) u32 with zero padding, plus the
+    block_rows each grid step consumes."""
     res = np.asarray(spec.residues, dtype=np.uint32)
     offs = (
         np.arange(periods, dtype=np.uint32)[:, None] * np.uint32(spec.modulus)
         + res[None, :]
     ).reshape(-1)
-    tiles = -(-offs.size // _TILE_LANES)
-    out = np.zeros(tiles * _TILE_LANES, dtype=np.uint32)
+    rows, block_rows = _strided_tiling(offs.size)
+    out = np.zeros(rows * 128, dtype=np.uint32)
     out[: offs.size] = offs
-    return out.reshape(tiles * 8, 128)
+    return out.reshape(rows, 128), block_rows
 
 
-def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int):
+def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int,
+                         block_rows: int):
     total = periods * spec.num_residues
 
     def kernel(desc_ref, offs_ref, out_ref):
@@ -237,14 +268,14 @@ def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int):
                 for c in range(128):
                     out_ref[r, c] = 0
 
-        offs = offs_ref[pl.ds(t * 8, 8), :]
+        offs = offs_ref[pl.ds(t * block_rows, block_rows), :]
         n0 = [
-            jnp.full((8, 128), desc_ref[d, i], dtype=jnp.uint32)
+            jnp.full((block_rows, 128), desc_ref[d, i], dtype=jnp.uint32)
             for i in range(plan.limbs_n)
         ]
         n = ve.add_u32(n0, offs)
 
-        idx = _block_iota(8) + t * _TILE_LANES
+        idx = _block_iota(block_rows) + t * (block_rows * 128)
         lo = [desc_ref[d, 4 + i] for i in range(plan.limbs_n)]
         hi = [desc_ref[d, 8 + i] for i in range(plan.limbs_n)]
         valid = (idx < total) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
@@ -261,13 +292,15 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
                       periods: int):
     assert num_desc <= STRIDED_DESC_MAX
     assert plan.limbs_n <= 4
-    offs = _expanded_offsets(spec, periods)
+    assert periods * spec.modulus < 1 << 32  # u32 offset arithmetic
+    offs, block_rows = _expanded_offsets(spec, periods)
+    assert offs.nbytes <= 4 * STRIDED_OFFS_LANES_MAX  # VMEM budget
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # descriptor table lands in SMEM
-        grid=(num_desc, offs.shape[0] // 8),
+        grid=(num_desc, offs.shape[0] // block_rows),
         in_specs=[
             # Whole offset table resident in VMEM; the kernel dynamic-slices
-            # its (8, 128) tile.
+            # its (block_rows, 128) tile.
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
@@ -275,7 +308,7 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
         ),
     )
     call = pl.pallas_call(
-        _make_strided_kernel(plan, spec, periods),
+        _make_strided_kernel(plan, spec, periods, block_rows),
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
         grid_spec=grid_spec,
         interpret=_interpret(),

@@ -34,25 +34,21 @@ class StrideTable:
         self.modulus = b_minus_1 * b_k
 
         residue_set = np.array(residue_filter.get_residue_filter(base), dtype=np.int64)
-        lsd_bitmap = np.array(
-            lsd_filter.get_valid_multi_lsd_bitmap(base, k), dtype=bool
-        )
+        lsd_bitmap = np.asarray(lsd_filter.get_valid_multi_lsd_bitmap(base, k))
 
         r = np.arange(self.modulus, dtype=np.int64)
         passes_residue = np.isin(r % b_minus_1, residue_set)
         passes_lsd = lsd_bitmap[r % b_k]
         valid = np.nonzero(passes_residue & passes_lsd)[0]
 
-        self.valid_residues: list[int] = [int(v) for v in valid]
-        n = len(self.valid_residues)
-        self.gap_table: list[int] = [
-            (
-                self.valid_residues[i + 1] - self.valid_residues[i]
-                if i + 1 < n
-                else self.modulus - self.valid_residues[i] + self.valid_residues[0]
-            )
-            for i in range(n)
-        ]
+        self.valid_residues: list[int] = valid.tolist()
+        if len(valid):
+            gaps = np.empty(len(valid), dtype=np.int64)
+            gaps[:-1] = valid[1:] - valid[:-1]
+            gaps[-1] = self.modulus - valid[-1] + valid[0]
+            self.gap_table: list[int] = gaps.tolist()
+        else:
+            self.gap_table = []
 
     @property
     def num_residues(self) -> int:
@@ -135,3 +131,16 @@ class StrideTable:
 def get_stride_table(base: int, k: int) -> StrideTable:
     """Shared per-(base, k) table (built once per process)."""
     return StrideTable(base, k)
+
+
+@lru_cache(maxsize=None)
+def stride_residue_count(base: int, k: int) -> int:
+    """num_residues of the (base, k) table WITHOUT building it.
+
+    gcd(b-1, b^k) = 1, so by CRT the count factors into
+    |valid residues mod b-1| * |valid k-suffixes mod b^k| — stride-depth
+    planning scores every depth with this product and materializes only the
+    chosen table (the deep tables are ~100x costlier to build than to score)."""
+    return len(residue_filter.get_residue_filter(base)) * (
+        lsd_filter.valid_multi_lsd_count(base, k)
+    )

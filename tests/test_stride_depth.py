@@ -38,42 +38,57 @@ def test_deeper_tables_are_sparser(base):
 
 
 def test_pick_depth_narrow_ranges_stay_shallow():
-    # Median surviving range far narrower than the k=2 modulus: deeper k
+    # Typical surviving range far narrower than the k=2 modulus: deeper k
     # would waste masked lanes, so the gate keeps k=1.
-    br = base_range.get_base_range(40)
-    ranges = [FieldSize(br[0], br[0] + 4_000)] * 5
-    k, periods = engine._pick_stride_depth(40, ranges)
+    k, periods = engine._pick_stride_depth(40, 4_000)
     assert k == 1
-    assert 1 <= periods <= pe.STRIDED_PERIODS
+    assert 1 <= periods <= pe.STRIDED_PERIODS_MAX
+    assert periods & (periods - 1) == 0  # po2: shapes survive floor drift
 
 
 def test_pick_depth_wide_ranges_go_deeper():
     # Only when ranges dwarf the deep spans does the density gain beat the
-    # tail-padding waste (at 50M-wide ranges the k=2 span of ~8M leaves
-    # ~12% ceil padding, more than the ~8% density win — the gate correctly
-    # stays at k=1 there; measured like the reference compiling its
-    # prefilter out at b42+).
-    br = base_range.get_base_range(40)
+    # tail-padding waste (the reference's measured-win gate, which compiled
+    # its prefilter out at b42+ where survival made it a loss).
     width = 500_000_000
-    ranges = [FieldSize(br[0], br[0] + width)] * 3
-    k, periods = engine._pick_stride_depth(40, ranges)
-    assert k == 2
+    k, periods = engine._pick_stride_depth(40, width)
+    assert k > 1
     span = periods * (39 * 40**k)
     assert span <= width
 
-    narrower = [FieldSize(br[0], br[0] + 50_000_000)] * 3
-    k, _ = engine._pick_stride_depth(40, narrower)
-    assert k == 1  # padding waste > density gain at this width
+    k1, _ = engine._pick_stride_depth(40, 4_000)
+    assert k1 == 1  # padding waste > density gain at narrow widths
 
 
-def test_pick_depth_respects_u32_contract():
+def test_pick_depth_deterministic_per_floor():
+    # The compiled kernel shape is a pure function of (base, typical): a
+    # benchmark warm-up field at the same floor compiles the exact kernel
+    # the timed field will run (no recompile inside the timed region).
+    for base in (40, 50):
+        typ = (1 << 20) * 3 // 2
+        assert engine._pick_stride_depth(base, typ) == engine._pick_stride_depth(
+            base, typ
+        )
+
+
+def test_pick_depth_respects_contracts():
     for base in (40, 50, 60):
-        br = base_range.get_base_range(base)
-        ranges = [FieldSize(br[0], br[0] + 10**9)]
-        k, periods = engine._pick_stride_depth(base, ranges)
-        modulus = (base - 1) * base**k
-        assert pe.STRIDED_PERIODS * modulus < 1 << 32
-        assert periods * modulus < 1 << 32
+        for typ in (10**6, 10**9):
+            k, periods = engine._pick_stride_depth(base, typ)
+            modulus = (base - 1) * base**k
+            assert periods * modulus < 1 << 32  # u32 offset arithmetic
+            num_res = stride_filter.stride_residue_count(base, k)
+            assert periods * num_res <= pe.STRIDED_OFFS_LANES_MAX  # VMEM
+
+
+def test_stride_residue_count_matches_table():
+    # CRT product == materialized table size (the planner scores depths with
+    # the product and must agree with the table it ultimately builds).
+    for base, k in [(10, 1), (10, 3), (40, 1), (40, 2), (50, 2)]:
+        assert (
+            stride_filter.stride_residue_count(base, k)
+            == stride_filter.get_stride_table(base, k).num_residues
+        )
 
 
 def test_strided_kernel_counts_match_host_at_k2():

@@ -40,9 +40,15 @@ MAX_INIT_ATTEMPTS = 3
 # Off-TPU the jnp fallback materializes per-lane intermediates in host RAM and
 # every mode drops to 1<<20.
 _TPU_BATCH = {
+    # Committed sweep (scripts/tune_kernels.py, round 4, 1e9 slices on a
+    # v5e chip, threaded collector): extra-large 2^24..2^30 ->
+    # 125/252/492/862/1333/1324/1266 M n/s (2^28 best; below it per-batch
+    # dispatch overhead dominates, above it tail padding); hi-base
+    # 2^23..2^29 -> 61/122/242/347/328/328/327 M n/s (2^26 best —
+    # compute-bound at b80's 3-limb digit extraction, insensitive beyond).
     ("extra-large", "detailed"): 1 << 28,
     ("extra-large", "niceonly"): 1 << 20,  # strided path; batch is unused
-    ("hi-base", "detailed"): 1 << 24,
+    ("hi-base", "detailed"): 1 << 26,
     ("msd-ineffective", "niceonly"): 1 << 22,
     ("msd-effective", "niceonly"): 1 << 22,
     ("massive", "niceonly"): 1 << 22,
@@ -143,10 +149,16 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
             rng, data.base, backend="jax", batch_size=batch_size
         )
 
-    # Warm-up compile with the SAME batch shape so the timed run measures
-    # throughput, not compile time (kernels are jitted per (base, batch)).
-    warm = FieldSize(data.range_start, data.range_start + 1)
-    run(warm)
+    # Warm-up compile with the SAME kernel shape so the timed run measures
+    # throughput, not compile time. Detailed probes a 1-number field (stats
+    # kernels are jitted per (base, batch)); niceonly warms via
+    # engine.warm_niceonly with the REAL field size — a probe field would
+    # compile a different kernel (the huge-field floor guard shapes the
+    # strided kernel by field size) and leave the real one cold.
+    if kind == "niceonly":
+        engine.warm_niceonly(data.base, data.range_size)
+    else:
+        run(FieldSize(data.range_start, data.range_start + 1))
 
     rng = data.to_field_size()
     t0 = time.monotonic()

@@ -52,6 +52,16 @@ MIN_SECS = 0.002
 # away from its balance point between real fields.
 TRIVIAL_SECS = 0.25
 
+# Fields spanning fewer than this many recursion leaves at the current floor
+# carry no phase-split signal either: the "device" time of a one-leaf field
+# is dominated by one-time kernel compilation and fixed dispatch latency, not
+# lane throughput. Observed failure mode without this gate: a 1-number
+# benchmark warm-up field measured device = 4.7 s (pure Mosaic compile),
+# walked the floor down 1.5x, which flipped the stride-depth plan
+# (k=1/periods=1024 -> k=3/periods=1) and forced a RECOMPILE inside the timed
+# field — niceonly extra-large read 4.6 s instead of its real 0.15 s.
+SIGNAL_MIN_LEAVES = 16
+
 # Seed calibrated so a 32-core host lands near the reference's 16k sweet
 # spot; fewer cores -> coarser floor (host recursion is the bottleneck).
 _SEED_CORE_PRODUCT = 2_097_152
@@ -76,17 +86,38 @@ class AdaptiveFloor:
     def current(self) -> int:
         return int(self.floor)
 
-    def observe(self, host_secs: float, device_secs: float) -> None:
+    def observe(
+        self, host_secs: float, device_secs: float, numbers: int | None = None
+    ) -> None:
         """Record one field's phase split and nudge the floor toward
-        host_secs ~= device_secs. No-op when pinned or warming up."""
+        host_secs ~= device_secs. No-op when pinned or warming up.
+
+        `numbers` is the field size; fields spanning < SIGNAL_MIN_LEAVES
+        recursion leaves at the current floor are ignored (their timing is
+        compile/dispatch latency, not throughput — see SIGNAL_MIN_LEAVES).
+        The warm-up counter is consumed only by signal-bearing fields, so a
+        string of tiny probe fields cannot exhaust it before the first real
+        field (whose device time includes one-time kernel compilation) shows
+        up."""
         if self.pinned:
             return
         with self._lock:
+            down_only = False
+            if numbers is not None and numbers < SIGNAL_MIN_LEAVES * self.floor:
+                # Too few leaves for a trustworthy split. Probe-sized fields
+                # (including compile-dominated warm-ups) carry no signal at
+                # all; larger fields that merely fall under the gate (e.g. a
+                # 5e6-number workload against a coarse seed floor) may still
+                # refine DOWNWARD — without this a too-coarse seed would
+                # freeze the controller for small-field workloads forever.
+                if numbers < SIGNAL_MIN_LEAVES * FLOOR_MIN:
+                    return
+                down_only = True
+            if host_secs + device_secs < TRIVIAL_SECS:
+                return  # field too small to tell anything
             if self._warmup > 0:
                 self._warmup -= 1
                 return
-            if host_secs + device_secs < TRIVIAL_SECS:
-                return  # field too small to tell anything
             if device_secs < MIN_SECS:
                 ratio = MAX_STEP  # device idle: host filter is over-working
             elif host_secs < MIN_SECS:
@@ -94,7 +125,18 @@ class AdaptiveFloor:
             else:
                 ratio = host_secs / device_secs
             ratio = min(max(ratio, 1.0 / MAX_STEP), MAX_STEP)
-            self.floor = min(max(self.floor * ratio, FLOOR_MIN), FLOOR_MAX)
+            if down_only and ratio >= 1.0:
+                return  # sub-gate fields may refine, never coarsen
+            new_floor = self.floor * ratio
+            if ratio > 1.0 and numbers is not None:
+                # Never coarsen past the point where fields of the size we
+                # just observed would fall below the leaf gate: without this
+                # cap a few host-dominated fields ratchet the floor one-way
+                # until 16*floor exceeds the workload's field size and the
+                # controller freezes with no recovery path.
+                new_floor = min(new_floor, numbers / SIGNAL_MIN_LEAVES)
+                new_floor = max(new_floor, self.floor)  # cap, not a shrink
+            self.floor = min(max(new_floor, FLOOR_MIN), FLOOR_MAX)
 
 
 _CONTROLLERS: dict[str, AdaptiveFloor] = {}

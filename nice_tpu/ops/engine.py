@@ -322,6 +322,12 @@ def _pick_stride_depth(base: int, typical: int, max_k: int = 3) -> tuple[int, in
         num_res = stride_filter.stride_residue_count(base, k)
         if num_res == 0:
             return k, 1  # provably nothing to search at any depth
+        if num_res > pe.STRIDED_OFFS_LANES_MAX:
+            # The residue table alone exceeds the offsets-VMEM budget even at
+            # periods=1 (e.g. base 73 at k=3: ~4M residues); this depth cannot
+            # be expanded, so skip it rather than let the periods cap go to 1
+            # and trip the kernel-build assert.
+            continue
         cap = min(
             pe.STRIDED_PERIODS_MAX,
             ((1 << 32) - 1) // modulus,  # u32 span
@@ -371,6 +377,96 @@ def _host_strided_scan(table, base: int, start: int, end: int) -> list[int]:
     return found
 
 
+def _strided_floor(ctrl, field_size: int) -> int:
+    """Effective MSD floor for a strided-device field: the adaptive floor,
+    raised so a field never spans more than ~2^21 recursion leaves.
+
+    The controller converges between fields; a single huge field (massive =
+    1e13) would otherwise run at a floor tuned for 1e9 production fields,
+    which at 1e13 means ~5e5 leaves whose boundary-quantization waste halves
+    the descriptor fill factor. Measured on the massive benchmark (b50,
+    1e13): floor 2^21 -> 1.06M descriptors at 50% fill, 244 s; floor 2^22 ->
+    601k descriptors at 75% fill, 184 s, while survival only rises
+    10.5% -> 11.3% (the MSD filter saturates at scale, so the coarser floor
+    costs almost nothing in extra candidates). A pinned floor
+    (NICE_TPU_MSD_FLOOR) is always honored exactly."""
+    from nice_tpu.ops import adaptive_floor
+
+    if ctrl.pinned:
+        return ctrl.current()
+    return max(ctrl.current(), min(field_size >> 21, adaptive_floor.FLOOR_MAX))
+
+
+def _strided_setup(base: int, field_size: int):
+    """Kernel-shape derivation shared by warm_niceonly and _niceonly_pallas.
+
+    ONE code path decides (floor, stride depth, periods, descriptor rows,
+    sharded step) so a warm-up can never compile a different kernel than the
+    field it warms — the drift that would silently re-introduce timed-region
+    Mosaic compiles. Returns None when the strided device path cannot run
+    this base (too many limbs, or provably no nice numbers); else a dict
+    with plan/ctrl/floor/k/periods/table/spec/desc_max/n_dev/sharded_step.
+    """
+    from nice_tpu.ops import adaptive_floor, stride_filter
+
+    plan = get_plan(base)
+    if plan.limbs_n > 4 or stride_filter.stride_residue_count(base, 1) == 0:
+        return None
+    ctrl = adaptive_floor.get_floor_controller("strided")
+    floor = _strided_floor(ctrl, field_size)
+    k, periods = _pick_stride_depth(base, floor + floor // 2)
+    table = stride_filter.get_stride_table(base, k)
+    if table.num_residues == 0:
+        return None  # a deeper refinement emptied out: nothing can be nice
+    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
+    if pe._interpret():
+        desc_max, periods = 8, min(periods, 8)  # keep interpreter tests fast
+    else:
+        desc_max = pe.STRIDED_DESC_MAX
+    mesh = _mesh_or_none()
+    if mesh is not None:
+        from nice_tpu.parallel import mesh as pmesh
+
+        n_dev = mesh.devices.size
+        sharded_step = pmesh.make_sharded_strided_step(
+            plan, spec, desc_max, periods, mesh
+        )
+    else:
+        n_dev, sharded_step = 1, None
+    return dict(
+        plan=plan, ctrl=ctrl, floor=floor, k=k, periods=periods, table=table,
+        spec=spec, desc_max=desc_max, n_dev=n_dev, sharded_step=sharded_step,
+    )
+
+
+def warm_niceonly(base: int, field_size: int = 0) -> None:
+    """Compile (and execute once, with zero real rows) the exact strided
+    kernel a niceonly field will run at the current adaptive floor.
+    Benchmarks call this before the timed region; a client can call it per
+    claimed field — after the first call per (base, floor) it is a single
+    cached dispatch of an all-padding group.
+
+    The reference has no analog (CUDA JIT-compiles per arch at startup,
+    client_process_gpu.rs:249-259); under XLA, compile happens at first
+    dispatch, so without an explicit warm a benchmark's first field would
+    time Mosaic compilation instead of throughput. field_size feeds the
+    huge-field floor guard (_strided_floor), which shapes the kernel."""
+    s = _strided_setup(base, field_size)
+    if s is None:
+        return
+    packed = np.zeros((s["desc_max"] * s["n_dev"], 12), dtype=np.uint32)
+    if s["sharded_step"] is not None:
+        np.asarray(
+            s["sharded_step"](packed, np.zeros(s["n_dev"], dtype=np.int32))
+        )
+    else:
+        np.asarray(
+            pe.niceonly_strided_batch(
+                s["plan"], s["spec"], packed, periods=s["periods"], n_real=0
+            )
+        )
+
+
 def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     """Device niceonly: host MSD filter (coarse floor) -> stride-compacted
     descriptor batches on the TPU -> host re-scan of hit descriptors.
@@ -385,63 +481,100 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     """
     import time
 
-    from nice_tpu.ops import adaptive_floor, msd_filter, stride_filter
-
-    plan = get_plan(base)
-    # Bases with no valid residues (e.g. 15) provably contain no nice
-    # numbers: bail before paying the MSD host filter.
-    if stride_filter.stride_residue_count(base, 1) == 0:
-        return []
+    from nice_tpu.ops import msd_filter
 
     # Coarse host filter down to the adaptive recursion floor: cheap device
     # lanes make a high floor optimal (reference floor sweep,
     # client_process_gpu.rs:85-94); the controller retunes it per field to
     # hold host-filter time ~= device-tail time, and NICE_TPU_MSD_FLOOR pins
     # it (the analog of NICE_GPU_MSD_FLOOR, client_process_gpu.rs:103-184).
-    ctrl = adaptive_floor.get_floor_controller("strided")
-    floor_used = ctrl.current()
-    # Kernel shape is a function of (base, floor) only — never of this
-    # field's actual ranges — so warm-up fields compile the exact production
-    # kernel (see _pick_stride_depth).
-    k, periods = _pick_stride_depth(base, floor_used + floor_used // 2)
-    table = stride_filter.get_stride_table(base, k)
-    if table.num_residues == 0:
-        # A deeper refinement emptied out: nothing can be nice here.
+    # _strided_setup is shared with warm_niceonly, so a warm-up compiles
+    # EXACTLY this field's kernel; None means provably nothing to search.
+    s = _strided_setup(base, core.size())
+    if s is None:
         return []
-    t_host0 = time.monotonic()
-    ranges = msd_filter.get_valid_ranges(
-        core, base, min_range_size=floor_used,
-        max_depth=_msd_depth_for(core.size(), floor_used),
-    )
-    host_secs = time.monotonic() - t_host0
-    spec = pe.StrideSpec(table.modulus, tuple(table.valid_residues))
+    plan, ctrl, floor_used = s["plan"], s["ctrl"], s["floor"]
+    k, periods, table, spec = s["k"], s["periods"], s["table"], s["spec"]
+    desc_max, n_dev, sharded_step = s["desc_max"], s["n_dev"], s["sharded_step"]
     modulus = table.modulus
-    if pe._interpret():
-        desc_max = 8  # keep interpreter-mode tests fast
-        periods = min(periods, 8)
-    else:
-        desc_max = pe.STRIDED_DESC_MAX
     span = periods * modulus
-
     # Descriptor batches shard across the mesh when >1 device is visible:
     # each device runs the strided kernel on its own desc_max rows and the
     # per-descriptor count tiles are stacked (not reduced — the host needs
     # every count to pick re-scan ranges).
-    mesh = _mesh_or_none()
-    if mesh is not None:
-        from nice_tpu.parallel import mesh as pmesh
-
-        n_dev = mesh.devices.size
-        sharded_step = pmesh.make_sharded_strided_step(
-            plan, spec, desc_max, periods, mesh
-        )
-    else:
-        n_dev = 1
-        sharded_step = None
     group_cap = desc_max * n_dev
 
     nice: list[int] = []
-    pending: deque = deque()
+
+    # --- 3-thread heterogeneous pipeline -----------------------------------
+    # producer thread:  native MSD filter over processing chunks -> q_ranges
+    # dispatcher (this thread): descriptor columns -> device executions
+    # collector thread: count readbacks + host re-scans of hit descriptors
+    #
+    # This is the overlapped thread fan-out of the reference GPU client
+    # (client_process_gpu.rs:589-709: filter threads stream range descriptors
+    # over an mpsc channel into batched launches while the device drains
+    # earlier batches). Field time is max(host filter, device tail), not
+    # host + device: the native filter and the collector's readback/re-scan
+    # both release the GIL, so all three stages make progress even on a
+    # 1-core host, and the count readback RTT (~68 ms/group through the axon
+    # tunnel) comes off the dispatch thread's critical path entirely.
+    import queue as queue_mod
+    import threading
+
+    host_busy = [0.0]   # accumulated native-filter seconds (producer)
+    dev_busy = [0.0]    # accumulated readback+re-scan seconds (collector)
+    prod_err: list = [None]
+    coll_err: list = [None]
+    stop = threading.Event()
+    q_ranges: queue_mod.Queue = queue_mod.Queue(maxsize=8)
+    q_counts: queue_mod.Queue = queue_mod.Queue(maxsize=STRIDE_WINDOW)
+
+    # Producer chunk: enough leaves that each native call amortizes its
+    # ctypes overhead, small enough that the dispatcher starts quickly and
+    # the two stages interleave smoothly (massive = 1e13 numbers -> ~19k
+    # chunks at floor 2^21).
+    chunk = floor_used * 256
+    n_ranges = [0]
+
+    def produce():
+        pos = core.start()
+        try:
+            while pos < core.end() and not stop.is_set():
+                sub_end = min(pos + chunk, core.end())
+                t0 = time.monotonic()
+                rs = msd_filter.get_valid_ranges(
+                    FieldSize(pos, sub_end), base, min_range_size=floor_used,
+                    max_depth=_msd_depth_for(sub_end - pos, floor_used),
+                )
+                host_busy[0] += time.monotonic() - t0
+                while not stop.is_set():
+                    try:
+                        q_ranges.put(rs, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
+                pos = sub_end
+        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
+            prod_err[0] = e
+        finally:
+            while True:
+                try:
+                    q_ranges.put(None, timeout=0.2)  # sentinel
+                    break
+                except queue_mod.Full:
+                    if stop.is_set():
+                        break  # dispatcher exited; nobody waits for us
+
+    def range_stream():
+        while True:
+            rs = q_ranges.get()
+            if rs is None:
+                if prod_err[0] is not None:
+                    raise prod_err[0]
+                return
+            n_ranges[0] += len(rs)
+            yield from rs
 
     # Descriptors stream as numpy COLUMNS, never as a materialized Python
     # list: the massive benchmark (1e13 @ b50) has ~3e7 descriptors, so
@@ -462,7 +595,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     def desc_columns():
         """Yield 6 u64 column arrays (n0_lo, n0_hi, lo_lo, lo_hi, hi_lo,
         hi_hi) per surviving MSD range."""
-        for r in ranges:
+        for r in range_stream():
             lo, hi = r.start(), r.end()
             first = (lo // modulus) * modulus
             k = -(-(hi - first) // span)
@@ -502,8 +635,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     def _at(cols, j: int, g: int) -> int:
         return int(cols[2 * j][g]) | (int(cols[2 * j + 1][g]) << 64)
 
-    def collect_one():
-        cols, counts_dev = pending.popleft()
+    def collect_item(cols, counts_dev):
         # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
         # flat at [d, i] after collapsing each device's tile.
         counts = np.asarray(counts_dev).reshape(n_dev, -1)
@@ -524,31 +656,81 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                 )
             nice.extend(found)
 
-    t_dev0 = time.monotonic()
+    def collect():
+        try:
+            while True:
+                item = q_counts.get()
+                if item is None:
+                    return
+                t0 = time.monotonic()
+                collect_item(*item)
+                dev_busy[0] += time.monotonic() - t0
+        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
+            coll_err[0] = e
+            while q_counts.get() is not None:
+                pass  # drain so the dispatcher's puts never block forever
+
+    producer = threading.Thread(target=produce, name="niceonly-msd", daemon=True)
+    collector = threading.Thread(target=collect, name="niceonly-collect", daemon=True)
+    t_wall0 = time.monotonic()
+    producer.start()
+    collector.start()
     n_desc = 0
-    for cols in grouped_columns():
-        n_desc += len(cols[0])
-        packed = pack(cols)
-        if sharded_step is not None:
-            counts = sharded_step(packed)
-        else:
-            counts = pe.niceonly_strided_batch(plan, spec, packed, periods=periods)
-        pending.append((cols, counts))
-        if len(pending) >= STRIDE_WINDOW:
-            collect_one()
-    while pending:
-        collect_one()
-    # Device tail includes the rare-path host re-scan — both sit on the far
-    # side of the host-filter/device boundary the controller balances.
-    device_secs = time.monotonic() - t_dev0
-    ctrl.observe(host_secs, device_secs)
+    # Dispatcher stall accounting: gen (host desc-gen + waiting on the
+    # producer), disp (jax dispatch call), put (backpressure from the
+    # collector/device window) — the trace tells which stage bounds the wall.
+    t_gen = t_disp = t_put = 0.0
+    try:
+        t0 = time.monotonic()
+        for cols in grouped_columns():
+            t1 = time.monotonic()
+            t_gen += t1 - t0
+            if coll_err[0] is not None:
+                break
+            k_real = len(cols[0])
+            n_desc += k_real
+            packed = pack(cols)
+            if sharded_step is not None:
+                per_dev_real = np.clip(
+                    k_real - np.arange(n_dev) * desc_max, 0, desc_max
+                ).astype(np.int32)
+                counts = sharded_step(packed, per_dev_real)
+            else:
+                counts = pe.niceonly_strided_batch(
+                    plan, spec, packed, periods=periods, n_real=k_real
+                )
+            t2 = time.monotonic()
+            t_disp += t2 - t1
+            q_counts.put((cols, counts))
+            t0 = time.monotonic()
+            t_put += t0 - t2
+    finally:
+        stop.set()  # stops the producer early on dispatch/collector failure
+        q_counts.put(None)
+        collector.join()
+        producer.join()
+    if prod_err[0] is not None:
+        raise prod_err[0]
+    if coll_err[0] is not None:
+        raise coll_err[0]
+    wall = time.monotonic() - t_wall0
+    # The controller balances producer busy-time against collector busy-time
+    # (readback + re-scan): with the stages overlapped, wall ~= max of the
+    # two, and the floor sweet spot is still where they meet. When the
+    # huge-field guard overrode the floor, the split was measured at a floor
+    # the controller is not at, so feeding it back would mis-tune the
+    # production floor — skip.
+    if floor_used == ctrl.current():
+        ctrl.observe(host_busy[0], dev_busy[0], core.size())
     # Per-phase trace (the reference logs its msd/gpu-tail split per field,
-    # client_process_gpu.rs:103-184): floor + depth + phase seconds.
+    # client_process_gpu.rs:103-184): floor + depth + busy seconds per stage.
     log.debug(
-        "niceonly b%d [%d, %d): msd %.3fs (floor %d, %d ranges) | device "
-        "%.3fs (k=%d periods=%d, %d descriptors, %d devices) | %d nice",
-        base, core.start(), core.end(), host_secs, floor_used, len(ranges),
-        device_secs, k, periods, n_desc, n_dev, len(nice),
+        "niceonly b%d [%d, %d): wall %.3fs | msd %.3fs busy (floor %d, %d "
+        "ranges) | collect %.3fs busy (k=%d periods=%d, %d descriptors, %d "
+        "devices) | dispatch gen %.3fs disp %.3fs put %.3fs | %d nice",
+        base, core.start(), core.end(), wall, host_busy[0], floor_used,
+        n_ranges[0], dev_busy[0], k, periods, n_desc, n_dev,
+        t_gen, t_disp, t_put, len(nice),
     )
     return nice
 
@@ -615,10 +797,8 @@ def process_range_detailed(
 
     start = core.start()
     total = core.size()
-    pending: deque = deque()
 
-    def collect_one():
-        batch_start, valid, bh, nm = pending.popleft()
+    def collect_item(batch_start, valid, bh, nm):
         bh = np.asarray(bh, dtype=np.int64)[: plan.base + 2]
         bh[0] -= lanes - valid  # remove tail-padding lanes from bin 0
         np.add(hist, bh, out=hist)
@@ -635,16 +815,48 @@ def process_range_detailed(
                         )
                     )
 
-    done = 0
-    while done < total:
-        valid = min(lanes, total - done)
-        batch_start = start + done
-        pending.append((batch_start, valid) + tuple(dispatch(batch_start, valid)))
-        if len(pending) >= DISPATCH_WINDOW:
-            collect_one()
-        done += valid
-    while pending:
-        collect_one()
+    # Collection (the stats readback + rare-path re-scan) runs on its own
+    # thread: each readback pays the device->host RTT (~68 ms through the
+    # axon tunnel), which at large batches is a sizable fraction of wall
+    # time if paid serially on the dispatch thread (batch 2^28 = 4
+    # readbacks for a 1e9 field). np.asarray blocks in C with the GIL
+    # released, so the two threads genuinely overlap; only the collector
+    # touches hist/nice_numbers.
+    import queue as queue_mod
+    import threading
+
+    coll_err: list = [None]
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=DISPATCH_WINDOW)
+
+    def collect():
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                collect_item(*item)
+        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
+            coll_err[0] = e
+            while q.get() is not None:
+                pass  # drain so the dispatcher's puts never block forever
+
+    collector = threading.Thread(target=collect, name="detailed-collect",
+                                 daemon=True)
+    collector.start()
+    try:
+        done = 0
+        while done < total:
+            if coll_err[0] is not None:
+                break
+            valid = min(lanes, total - done)
+            batch_start = start + done
+            q.put((batch_start, valid) + tuple(dispatch(batch_start, valid)))
+            done += valid
+    finally:
+        q.put(None)
+        collector.join()
+    if coll_err[0] is not None:
+        raise coll_err[0]
 
     nice_numbers.sort(key=lambda n: n.number)
     distribution = tuple(
@@ -784,7 +996,7 @@ def process_range_niceonly(
     while pending:
         collect_one()
     device_secs = time.monotonic() - t_dev0
-    ctrl.observe(host_secs, device_secs)
+    ctrl.observe(host_secs, device_secs, core.size())
     log.debug(
         "niceonly-dense b%d [%d, %d): msd %.3fs (floor %d, %d ranges) | "
         "device %.3fs | %d nice",

@@ -57,25 +57,29 @@ def _bitmap_scalar(base: int, k: int) -> np.ndarray:
     return bitmap
 
 
-def _digit_presence_masks(values: np.ndarray, base: int, k: int):
-    """(lo, hi) u64 digit-presence bitmasks of the low k digits of each value,
-    with the reference's stop-at-zero rule: peel digits LSD-first, always
-    recording the first, and stop once the remaining quotient is zero."""
+def _digit_presence_masks(values: np.ndarray, base: int, k: int) -> np.ndarray:
+    """u64[..., n_words] digit-presence bitmasks of the low k digits of each
+    value, with the reference's stop-at-zero rule: peel digits LSD-first,
+    always recording the first, and stop once the remaining quotient is zero.
+
+    The word count scales with the base (digits span [0, base)): bases up to
+    256 need four u64 words. A fixed two-word layout silently produced
+    `one << (d - 64)` with d >= 128 — a >= 64-bit shift, undefined in numpy —
+    for bases above 128 (advisor finding, round 3)."""
+    n_words = (base + 63) // 64
     one = np.uint64(1)
-    lo = np.zeros(values.shape, dtype=np.uint64)
-    hi = np.zeros(values.shape, dtype=np.uint64)
+    masks = np.zeros(values.shape + (n_words,), dtype=np.uint64)
     rem = values.astype(np.int64)
     alive = np.ones(values.shape, dtype=bool)
     for _ in range(k):
         d = rem % base
         rem = rem // base
-        du = d.astype(np.uint64)
-        bit_lo = np.where(alive & (d < 64), one << (du & np.uint64(63)), 0)
-        bit_hi = np.where(alive & (d >= 64), one << (du - np.uint64(64)), 0)
-        lo |= bit_lo
-        hi |= bit_hi
+        bit = one << (d.astype(np.uint64) & np.uint64(63))
+        word = d >> 6
+        for w in range(n_words):
+            masks[..., w] |= np.where(alive & (word == w), bit, 0)
         alive &= rem != 0
-    return lo, hi
+    return masks
 
 
 @lru_cache(maxsize=None)
@@ -89,9 +93,9 @@ def get_valid_multi_lsd_bitmap(base: int, k: int) -> np.ndarray:
     # (modulus <= 96^3 < 2^20, so modulus^2 < 2^40).
     sq = (s * s) % modulus
     cb = (sq * s) % modulus
-    sq_lo, sq_hi = _digit_presence_masks(sq, base, k)
-    cb_lo, cb_hi = _digit_presence_masks(cb, base, k)
-    bitmap = ((sq_lo & cb_lo) == 0) & ((sq_hi & cb_hi) == 0)
+    sq_masks = _digit_presence_masks(sq, base, k)
+    cb_masks = _digit_presence_masks(cb, base, k)
+    bitmap = ~np.any(sq_masks & cb_masks, axis=-1)
     bitmap.setflags(write=False)
     return bitmap
 

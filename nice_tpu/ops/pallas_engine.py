@@ -258,7 +258,7 @@ def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int,
                          block_rows: int):
     total = periods * spec.num_residues
 
-    def kernel(desc_ref, offs_ref, out_ref):
+    def kernel(nreal_ref, desc_ref, offs_ref, out_ref):
         d = pl.program_id(0)
         t = pl.program_id(1)
 
@@ -268,21 +268,28 @@ def _make_strided_kernel(plan: BasePlan, spec: StrideSpec, periods: int,
                 for c in range(128):
                     out_ref[r, c] = 0
 
-        offs = offs_ref[pl.ds(t * block_rows, block_rows), :]
-        n0 = [
-            jnp.full((block_rows, 128), desc_ref[d, i], dtype=jnp.uint32)
-            for i in range(plan.limbs_n)
-        ]
-        n = ve.add_u32(n0, offs)
+        # Descriptor groups are padded to the kernel's static num_desc so one
+        # compiled shape serves every group size; padded rows (d >= n_real)
+        # skip the whole lane pipeline — without this a small field's single
+        # 8-descriptor group paid the full 1024-descriptor compute (~0.26 s
+        # measured for what is ~2 ms of real work).
+        @pl.when(d < nreal_ref[0])
+        def _():
+            offs = offs_ref[pl.ds(t * block_rows, block_rows), :]
+            n0 = [
+                jnp.full((block_rows, 128), desc_ref[d, i], dtype=jnp.uint32)
+                for i in range(plan.limbs_n)
+            ]
+            n = ve.add_u32(n0, offs)
 
-        idx = _block_iota(block_rows) + t * (block_rows * 128)
-        lo = [desc_ref[d, 4 + i] for i in range(plan.limbs_n)]
-        hi = [desc_ref[d, 8 + i] for i in range(plan.limbs_n)]
-        valid = (idx < total) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
+            idx = _block_iota(block_rows) + t * (block_rows * 128)
+            lo = [desc_ref[d, 4 + i] for i in range(plan.limbs_n)]
+            hi = [desc_ref[d, 8 + i] for i in range(plan.limbs_n)]
+            valid = (idx < total) & ve.limbs_ge(n, lo) & ve.limbs_lt(n, hi)
 
-        uniques = ve.num_uniques_lanes(plan, n)
-        cnt = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
-        out_ref[d // 128, d % 128] += cnt
+            uniques = ve.num_uniques_lanes(plan, n)
+            cnt = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
+            out_ref[d // 128, d % 128] += cnt
 
     return kernel
 
@@ -296,7 +303,7 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
     offs, block_rows = _expanded_offsets(spec, periods)
     assert offs.nbytes <= 4 * STRIDED_OFFS_LANES_MAX  # VMEM budget
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # descriptor table lands in SMEM
+        num_scalar_prefetch=2,  # real-descriptor count + table land in SMEM
         grid=(num_desc, offs.shape[0] // block_rows),
         in_specs=[
             # Whole offset table resident in VMEM; the kernel dynamic-slices
@@ -315,23 +322,27 @@ def _strided_callable(plan: BasePlan, spec: StrideSpec, num_desc: int,
     )
 
     @jax.jit
-    def run(desc):
-        return call(desc, offs)
+    def run(desc, n_real):
+        return call(jnp.reshape(n_real, (1,)).astype(jnp.int32), desc, offs)
 
     return run
 
 
 def niceonly_strided_batch(plan: BasePlan, spec: StrideSpec, desc: np.ndarray,
-                           periods: int = STRIDED_PERIODS):
+                           periods: int = STRIDED_PERIODS,
+                           n_real: int | None = None):
     """Per-descriptor nice counts (i32[8,128], flattened index = descriptor row).
 
     desc: u32[num_desc, 12] rows of (n0 limbs[4], lo limbs[4], hi limbs[4]),
     LSW first, zero-padded. Each descriptor counts nice numbers among stride
     candidates n = n0 + p*M + residues[j] (p < periods) with lo <= n < hi.
+
+    n_real: rows [n_real, num_desc) are padding and skip all lane compute
+    (their counts are 0). Defaults to every row being real.
     """
     assert desc.ndim == 2 and desc.shape[1] == _DESC_WIDTH, desc.shape
     run = _strided_callable(plan, spec, desc.shape[0], periods)
-    return run(desc)
+    return run(desc, np.int32(desc.shape[0] if n_real is None else n_real))
 
 
 # --------------------------------------------------------------------------

@@ -148,19 +148,22 @@ def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
     count tiles are stacked, NOT reduced — the host needs every descriptor's
     count to decide which sub-ranges to re-scan.
 
-    Returns fn(desc u32[n_dev * per_device_desc, 12]) ->
+    Returns fn(desc u32[n_dev * per_device_desc, 12], n_real i32[n_dev]) ->
     i32[n_dev * 8, 128]; descriptor (dev d, local i) count lands at
-    [d * 8 + i // 128, i % 128].
+    [d * 8 + i // 128, i % 128]. n_real[d] is the count of real (non-padding)
+    rows in device d's shard; padded rows skip all lane compute.
     """
     from nice_tpu.ops import pallas_engine as pe
 
-    def device_step(desc):
-        return pe.niceonly_strided_batch(plan, spec, desc, periods=periods)
+    def device_step(desc, n_real):
+        return pe._strided_callable(plan, spec, per_device_desc, periods)(
+            desc, n_real[0]
+        )
 
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
-        in_specs=(P(FIELD_AXIS, None),),
+        in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS)),
         out_specs=P(FIELD_AXIS, None),
         check_vma=False,
     )

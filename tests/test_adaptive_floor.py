@@ -83,3 +83,75 @@ def _clean_singleton():
     af.reset_for_tests()
     yield
     af.reset_for_tests()
+
+
+def test_tiny_fields_carry_no_signal():
+    # A 1-number warm-up field whose "device" time is pure kernel compile
+    # must neither adapt the floor nor consume a warm-up slot (observed
+    # failure: floor drift between warm-up and timed benchmark fields flipped
+    # the stride plan and forced a recompile inside the timed region).
+    c = make()
+    start = c.current()
+    for _ in range(10):
+        c.observe(0.003, 4.7, numbers=1)
+    assert c.current() == start
+    assert c._warmup == af.WARMUP_FIELDS  # warm-up slots untouched
+    # Signal-bearing fields still adapt after warm-up.
+    big = af.SIGNAL_MIN_LEAVES * start * 2
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(1.0, 1.0, numbers=big)
+    c.observe(3.0, 1.0, numbers=big)
+    assert c.current() == int(start * af.MAX_STEP)
+
+
+def test_signal_gate_scales_with_floor():
+    c = make(seed=1 << 20)
+    just_below = af.SIGNAL_MIN_LEAVES * c.current() - 1
+    for _ in range(af.WARMUP_FIELDS + 1):
+        c.observe(5.0, 1.0, numbers=just_below)
+    assert c.current() == 1 << 20  # below the leaf gate: ignored
+
+
+def test_upward_steps_cannot_outrun_the_leaf_gate():
+    # Code-review finding (round 4): host-dominated fields must not ratchet
+    # the floor past the point where the workload's own field size falls
+    # below the leaf gate (a frozen controller with no recovery path).
+    c = make(seed=65536)
+    size = 4_000_000
+    for _ in range(af.WARMUP_FIELDS + 20):
+        c.observe(5.0, 1.0, numbers=size)
+    assert af.SIGNAL_MIN_LEAVES * c.current() <= size
+    # ...and device-dominated fields can still pull it back down.
+    before = c.current()
+    c.observe(0.01, 5.0, numbers=size)
+    assert c.current() < before
+
+
+def test_strided_floor_guard_scales_with_field_size():
+    from nice_tpu.ops import engine
+
+    c = make(seed=1 << 21)
+    # Production-sized fields: adaptive floor wins.
+    assert engine._strided_floor(c, 10**9) == 1 << 21
+    # Huge fields: leaves capped at ~2^21 (massive = 1e13 -> floor ~2^22).
+    assert engine._strided_floor(c, 10**13) == 10**13 >> 21
+    # Pinned floors are always honored exactly.
+    p = af.AdaptiveFloor(pinned=4096)
+    assert engine._strided_floor(p, 10**13) == 4096
+
+
+def test_sub_gate_fields_refine_but_never_coarsen():
+    # Code-review finding (round 4): a workload whose fields all fall under
+    # the leaf gate (e.g. 5e6-number fields against a coarse seed) must still
+    # be able to pull a too-coarse floor DOWN — but may never push it up,
+    # and probe-sized fields still carry no signal at all.
+    c = make(seed=1 << 19)
+    size = 5_000_000  # < 16 * 2^19 = 8.4M: under the gate, but not a probe
+    for _ in range(af.WARMUP_FIELDS):
+        c.observe(0.1, 3.0, numbers=size)
+    start = c.current()
+    c.observe(0.1, 3.0, numbers=size)  # device-dominated: refine allowed
+    assert c.current() < start
+    before = c.current()
+    c.observe(5.0, 0.1, numbers=size)  # host-dominated: coarsen blocked
+    assert c.current() == before

@@ -1,6 +1,9 @@
 """Golden-value tests for the filter cascade, transcribed from the reference
 (residue_filter.rs:27-76, lsd_filter.rs:244-331, stride_filter.rs:162-246)."""
 
+import numpy as np
+import pytest
+
 from nice_tpu.core.types import FieldSize
 from nice_tpu.ops import lsd_filter, msd_filter, residue_filter
 from nice_tpu.ops.stride_filter import StrideTable
@@ -156,3 +159,19 @@ def test_msd_recursive_covers_69():
         assert r.range_start >= prev_end
         assert r.range_end <= 100
         prev_end = r.range_end
+
+
+@pytest.mark.parametrize(
+    "base,k",
+    [(10, 1), (10, 3), (40, 2), (50, 3), (96, 2), (130, 2), (150, 2), (200, 2)],
+)
+def test_lsd_bitmap_matches_scalar_oracle(base, k):
+    # Differential test of the vectorized bitmap against the direct
+    # transcription of the definition. Bases above 128 exercise the 3rd/4th
+    # digit-presence mask words (advisor finding, round 3: the old two-word
+    # layout shifted by >= 64 bits — numpy UB — and produced wrong bitmaps at
+    # bases 130/150/200).
+    assert np.array_equal(
+        lsd_filter._bitmap_scalar(base, k),
+        lsd_filter.get_valid_multi_lsd_bitmap(base, k),
+    )

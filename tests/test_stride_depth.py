@@ -122,3 +122,21 @@ def test_strided_kernel_counts_match_host_at_k2():
             )
         )
         assert counts[i] == want, (i, counts[i], want)
+
+
+def test_pick_depth_skips_over_budget_residue_tables():
+    # Advisor finding (round 3): base 73 at typical = 1.5 * FLOOR_MAX used to
+    # pick k=3 whose residue table ALONE (~4M lanes) exceeds the offsets-VMEM
+    # budget, deterministically tripping the kernel-build assert. The planner
+    # must skip depths whose num_res exceeds the budget at periods=1.
+    from nice_tpu.ops import adaptive_floor as af
+
+    typ = af.FLOOR_MAX + af.FLOOR_MAX // 2
+    for base in range(30, 97):
+        if stride_filter.stride_residue_count(base, 1) == 0:
+            continue
+        k, periods = engine._pick_stride_depth(base, typ)
+        num_res = stride_filter.stride_residue_count(base, k)
+        if num_res == 0:
+            continue
+        assert periods * num_res <= pe.STRIDED_OFFS_LANES_MAX, (base, k)

@@ -155,9 +155,14 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     # engine.warm_niceonly with the REAL field size — a probe field would
     # compile a different kernel (the huge-field floor guard shapes the
     # strided kernel by field size) and leave the real one cold.
-    if kind == "niceonly":
+    import jax
+
+    if kind == "niceonly" and jax.default_backend() == "tpu":
         engine.warm_niceonly(data.base, data.range_size)
     else:
+        # Detailed modes probe a 1-number field; off-TPU niceonly takes the
+        # dense jnp path (which warm_niceonly does not compile), so the
+        # probe field warms whichever kernel the timed run will use.
         run(FieldSize(data.range_start, data.range_start + 1))
 
     rng = data.to_field_size()

@@ -60,3 +60,15 @@ msd-crosscheck:
 # profile the engine hot path with cProfile
 profile mode="large":
     NICE_BENCH_MODE={{mode}} python -m cProfile -s cumtime bench.py | head -40
+
+# tag and push a release: verifies the version is consistent everywhere
+# (package, CHANGELOG) before tagging; the release workflow does the rest
+tag-release:
+    #!/usr/bin/env bash
+    set -euo pipefail
+    v="$(python -c 'import nice_tpu; print(nice_tpu.__version__)')"
+    grep -q "\[$v\]" CHANGELOG.md || { echo "CHANGELOG.md missing [$v]"; exit 1; }
+    [ -z "$(git status --porcelain)" ] || { echo "working tree dirty"; exit 1; }
+    git tag "v$v"
+    git push origin "v$v"
+    echo "tagged v$v; release workflow publishes artifacts + image"

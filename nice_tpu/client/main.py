@@ -93,6 +93,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="device lanes per dispatch (env NICE_BATCH_SIZE)",
     )
     p.add_argument(
+        "--threads",
+        type=int,
+        default=int(_env("THREADS", 0)),
+        help="host threads for the native backend; 0 = all cores "
+        "(env NICE_THREADS; reference client/src/main.rs:64-116)",
+    )
+    p.add_argument(
+        "--progress-secs",
+        type=float,
+        default=float(_env("PROGRESS_SECS", 5)),
+        help="seconds between in-field progress lines; 0 disables "
+        "(env NICE_PROGRESS_SECS)",
+    )
+    p.add_argument(
         "--benchmark",
         default=_env("BENCHMARK", None),
         choices=[m.value for m in BenchmarkMode],
@@ -119,21 +133,54 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _progress_logger(every_secs: float):
+    """Throttled in-field progress callback: % done, live n/s, ETA (the
+    reference's tqdm progress bar, client/src/main.rs:183-196, as log lines
+    — adaptive-unit rendering without a TTY dependency). Thread-safe: the
+    engine may call it from a pipeline worker thread."""
+    if not every_secs or every_secs <= 0:
+        return None
+    import threading
+
+    t0 = time.monotonic()
+    state = {"last": t0}
+    lock = threading.Lock()
+
+    def cb(done: int, total: int) -> None:
+        now = time.monotonic()
+        with lock:
+            if now - state["last"] < every_secs or done <= 0 or done >= total:
+                return
+            state["last"] = now
+        rate = done / max(now - t0, 1e-9)
+        eta = (total - done) / rate if rate > 0 else float("inf")
+        log.info(
+            "progress %5.1f%% (%s / %s) %s numbers/sec, ETA %.0fs",
+            100.0 * done / total, f"{done:,}", f"{total:,}", f"{rate:,.0f}", eta,
+        )
+
+    return cb
+
+
 def process_field(
-    data: DataToClient, mode: SearchMode, backend: str, batch_size: int
+    data: DataToClient, mode: SearchMode, backend: str, batch_size: int,
+    progress_secs: float = 0.0,
 ) -> tuple[FieldResults, float]:
     """Process one field, returning results and elapsed seconds, logging the
     reference's throughput line (client/src/main.rs:361-371)."""
     t0 = time.monotonic()
     rng = data.to_field_size()
+    progress = _progress_logger(progress_secs)
     if mode == SearchMode.DETAILED:
         results = engine.process_range_detailed(
-            rng, data.base, backend=backend, batch_size=batch_size
+            rng, data.base, backend=backend, batch_size=batch_size,
+            progress=progress,
         )
     else:
         stride = get_stride_table(data.base, DEFAULT_LSD_K_VALUE)
         results = engine.process_range_niceonly(
-            rng, data.base, stride_table=stride, backend=backend, batch_size=batch_size
+            rng, data.base, stride_table=stride, backend=backend,
+            batch_size=batch_size, progress=progress,
         )
     elapsed = time.monotonic() - t0
     rate = data.range_size / elapsed if elapsed > 0 else float("inf")
@@ -175,7 +222,7 @@ def run_benchmark(args) -> int:
         mode,
         args.backend,
     )
-    results, elapsed = process_field(data, mode, args.backend, args.batch_size)
+    results, elapsed = process_field(data, mode, args.backend, args.batch_size, args.progress_secs)
     nm_cutoff = number_stats.get_near_miss_cutoff(data.base)
     summary = {
         "benchmark": bench.value,
@@ -215,7 +262,7 @@ def run_validate(args) -> int:
         range_end=vdata.range_end,
         range_size=vdata.range_size,
     )
-    results, _ = process_field(data, SearchMode.DETAILED, args.backend, args.batch_size)
+    results, _ = process_field(data, SearchMode.DETAILED, args.backend, args.batch_size, args.progress_secs)
     ok = True
     canon_dist = {d.num_uniques: d.count for d in vdata.unique_distribution}
     local_dist = {d.num_uniques: d.count for d in results.distribution}
@@ -254,7 +301,7 @@ def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> No
         data.range_start,
         data.range_end,
     )
-    results, _ = process_field(data, mode, args.backend, args.batch_size)
+    results, _ = process_field(data, mode, args.backend, args.batch_size, args.progress_secs)
     submission = compile_results(data, results, mode, args.username)
     api.submit_async(submission).result()
     log.info("submitted claim %d", data.claim_id)
@@ -273,7 +320,7 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
             data.base,
             f"{data.range_size:,}",
         )
-        results, _ = process_field(data, mode, args.backend, args.batch_size)
+        results, _ = process_field(data, mode, args.backend, args.batch_size, args.progress_secs)
         if pending_submit is not None:
             pending_submit.result()  # surface any submit error before queueing next
         submission = compile_results(data, results, mode, args.username)
@@ -287,6 +334,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(
         level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
     )
+    if args.threads > 0:
+        # The native backend sizes its pools from NICE_THREADS (engine
+        # _native_threads); the flag is the CLI face of the same knob
+        # (reference --threads, client/src/main.rs:64-116, 183-196).
+        os.environ["NICE_THREADS"] = str(args.threads)
     # Make JAX_PLATFORMS authoritative: some PJRT plugins override the env
     # var at import time, so a user's JAX_PLATFORMS=cpu would otherwise
     # still grab (or hang on) an accelerator (see nice_tpu/utils/platform.py).

@@ -53,6 +53,62 @@ RARE_SCAN_BATCH = 1 << 20
 # tile plus six u64 columns, so memory stays negligible).
 STRIDE_WINDOW = 16
 
+# Audit every Nth ZERO-count descriptor with a host re-scan (0 disables;
+# NICE_TPU_AUDIT_EVERY overrides). Descriptors with nonzero counts are always
+# host-verified as a side effect of extracting their numbers, so a kernel bug
+# that OVERcounts is caught immediately — but one that undercounts to zero
+# was previously silent (at 1e13 scale, hits=0 rested on one code path).
+# Sampled auditing closes that blind spot for ~1-2% extra collector time on
+# massive fields (soundness analog of client_process_gpu.rs:1289-1324).
+STRIDE_AUDIT_EVERY = 1024
+
+
+class _Collector:
+    """Bounded-queue worker thread applying `fn` to put() items.
+
+    Shared scaffolding for the dispatch pipelines: result readback (and any
+    host re-scan behind it) runs off the dispatch thread — np.asarray blocks
+    in C with the GIL released, so dispatch and collection genuinely overlap.
+    On worker failure the queue is drained so producers' put() calls never
+    block forever; shutdown() joins without raising (safe in a finally) and
+    raise_if_failed() re-raises the worker's exception on the caller."""
+
+    def __init__(self, fn, maxsize: int, name: str):
+        import queue as queue_mod
+        import threading
+
+        self._fn = fn
+        self._err: list = [None]
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=maxsize)
+        self._t = threading.Thread(target=self._run, name=name, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                self._fn(*item)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            self._err[0] = e
+            while self._q.get() is not None:
+                pass  # drain so producers' puts never block forever
+
+    def failed(self) -> bool:
+        return self._err[0] is not None
+
+    def put(self, item) -> None:
+        self._q.put(item)
+
+    def shutdown(self) -> None:
+        self._q.put(None)
+        self._t.join()
+
+    def raise_if_failed(self) -> None:
+        if self._err[0] is not None:
+            raise self._err[0]
+
 
 def _pick_backend(plan, batch_size: int, backend: str) -> str:
     """Resolve "jax" to the Pallas kernels when on TPU and the base/batch
@@ -467,7 +523,7 @@ def warm_niceonly(base: int, field_size: int = 0) -> None:
         )
 
 
-def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
+def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
     """Device niceonly: host MSD filter (coarse floor) -> stride-compacted
     descriptor batches on the TPU -> host re-scan of hit descriptors.
 
@@ -525,10 +581,8 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     host_busy = [0.0]   # accumulated native-filter seconds (producer)
     dev_busy = [0.0]    # accumulated readback+re-scan seconds (collector)
     prod_err: list = [None]
-    coll_err: list = [None]
     stop = threading.Event()
     q_ranges: queue_mod.Queue = queue_mod.Queue(maxsize=8)
-    q_counts: queue_mod.Queue = queue_mod.Queue(maxsize=STRIDE_WINDOW)
 
     # Producer chunk: enough leaves that each native call amortizes its
     # ctypes overhead, small enough that the dispatcher starts quickly and
@@ -555,6 +609,11 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                     except queue_mod.Full:
                         continue
                 pos = sub_end
+                if progress is not None:
+                    # Filter-front progress: the dispatcher/device trail by
+                    # at most the bounded queues, so this tracks field
+                    # completion to within a few descriptor groups.
+                    progress(pos - core.start(), core.size())
         except BaseException as e:  # noqa: BLE001 — re-raised on main thread
             prod_err[0] = e
         finally:
@@ -635,6 +694,11 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
     def _at(cols, j: int, g: int) -> int:
         return int(cols[2 * j][g]) | (int(cols[2 * j + 1][g]) << 64)
 
+    import os
+
+    audit_every = int(os.environ.get("NICE_TPU_AUDIT_EVERY", STRIDE_AUDIT_EVERY))
+    audit_seen = [0]  # zero-count descriptors seen so far (audit phase)
+
     def collect_item(cols, counts_dev):
         # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
         # flat at [d, i] after collapsing each device's tile.
@@ -655,26 +719,35 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                     f"(n0={n0}, [{lo},{hi})): device {count}, host {len(found)}"
                 )
             nice.extend(found)
+        if audit_every:
+            # Sampled undercount audit: host re-scan every audit_every'th
+            # zero-count descriptor; any hit the device missed is a hard
+            # error (see STRIDE_AUDIT_EVERY).
+            zeros = np.nonzero(flat == 0)[0]
+            first = (-audit_seen[0]) % audit_every
+            for j in range(first, len(zeros), audit_every):
+                g = int(zeros[j])
+                n0, lo, hi = _at(cols, 0, g), _at(cols, 1, g), _at(cols, 2, g)
+                found = _host_strided_scan(
+                    table, base, max(lo, n0), min(hi, n0 + span)
+                )
+                if found:
+                    raise RuntimeError(
+                        f"device undercount: descriptor (n0={n0}, "
+                        f"[{lo},{hi})) counted 0 on device but host found "
+                        f"{len(found)} nice numbers (audit)"
+                    )
+            audit_seen[0] += len(zeros)
 
-    def collect():
-        try:
-            while True:
-                item = q_counts.get()
-                if item is None:
-                    return
-                t0 = time.monotonic()
-                collect_item(*item)
-                dev_busy[0] += time.monotonic() - t0
-        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
-            coll_err[0] = e
-            while q_counts.get() is not None:
-                pass  # drain so the dispatcher's puts never block forever
+    def timed_collect_item(cols, counts_dev):
+        t0 = time.monotonic()
+        collect_item(cols, counts_dev)
+        dev_busy[0] += time.monotonic() - t0
 
     producer = threading.Thread(target=produce, name="niceonly-msd", daemon=True)
-    collector = threading.Thread(target=collect, name="niceonly-collect", daemon=True)
     t_wall0 = time.monotonic()
     producer.start()
-    collector.start()
+    collector = _Collector(timed_collect_item, STRIDE_WINDOW, "niceonly-collect")
     n_desc = 0
     # Dispatcher stall accounting: gen (host desc-gen + waiting on the
     # producer), disp (jax dispatch call), put (backpressure from the
@@ -685,7 +758,7 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
         for cols in grouped_columns():
             t1 = time.monotonic()
             t_gen += t1 - t0
-            if coll_err[0] is not None:
+            if collector.failed():
                 break
             k_real = len(cols[0])
             n_desc += k_real
@@ -701,18 +774,16 @@ def _niceonly_pallas(core: FieldSize, base: int) -> list[int]:
                 )
             t2 = time.monotonic()
             t_disp += t2 - t1
-            q_counts.put((cols, counts))
+            collector.put((cols, counts))
             t0 = time.monotonic()
             t_put += t0 - t2
     finally:
         stop.set()  # stops the producer early on dispatch/collector failure
-        q_counts.put(None)
-        collector.join()
+        collector.shutdown()
         producer.join()
     if prod_err[0] is not None:
         raise prod_err[0]
-    if coll_err[0] is not None:
-        raise coll_err[0]
+    collector.raise_if_failed()
     wall = time.monotonic() - t_wall0
     # The controller balances producer busy-time against collector busy-time
     # (readback + re-scan): with the stages overlapped, wall ~= max of the
@@ -740,8 +811,13 @@ def process_range_detailed(
     base: int,
     backend: str = "jax",
     batch_size: int = DEFAULT_BATCH_SIZE,
+    progress=None,
 ) -> FieldResults:
-    """Full histogram + near-miss list, exact, any backend."""
+    """Full histogram + near-miss list, exact, any backend.
+
+    progress: optional callable(done_numbers, total_numbers) invoked from the
+    dispatch loop (the reference client's tqdm per-field progress,
+    client/src/main.rs:183-196); may be called from a worker thread."""
     if backend == "scalar":
         return scalar.process_range_detailed(range_, base)
     if backend == "native":
@@ -819,44 +895,25 @@ def process_range_detailed(
     # thread: each readback pays the device->host RTT (~68 ms through the
     # axon tunnel), which at large batches is a sizable fraction of wall
     # time if paid serially on the dispatch thread (batch 2^28 = 4
-    # readbacks for a 1e9 field). np.asarray blocks in C with the GIL
-    # released, so the two threads genuinely overlap; only the collector
-    # touches hist/nice_numbers.
-    import queue as queue_mod
-    import threading
-
-    coll_err: list = [None]
-    q: queue_mod.Queue = queue_mod.Queue(maxsize=DISPATCH_WINDOW)
-
-    def collect():
-        try:
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                collect_item(*item)
-        except BaseException as e:  # noqa: BLE001 — re-raised on main thread
-            coll_err[0] = e
-            while q.get() is not None:
-                pass  # drain so the dispatcher's puts never block forever
-
-    collector = threading.Thread(target=collect, name="detailed-collect",
-                                 daemon=True)
-    collector.start()
+    # readbacks for a 1e9 field). Only the collector touches
+    # hist/nice_numbers.
+    collector = _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect")
     try:
         done = 0
         while done < total:
-            if coll_err[0] is not None:
+            if collector.failed():
                 break
             valid = min(lanes, total - done)
             batch_start = start + done
-            q.put((batch_start, valid) + tuple(dispatch(batch_start, valid)))
+            collector.put(
+                (batch_start, valid) + tuple(dispatch(batch_start, valid))
+            )
             done += valid
+            if progress is not None:
+                progress(done, total)
     finally:
-        q.put(None)
-        collector.join()
-    if coll_err[0] is not None:
-        raise coll_err[0]
+        collector.shutdown()
+    collector.raise_if_failed()
 
     nice_numbers.sort(key=lambda n: n.number)
     distribution = tuple(
@@ -872,10 +929,14 @@ def process_range_niceonly(
     stride_table=None,
     backend: str = "jax",
     batch_size: int = DEFAULT_BATCH_SIZE,
+    progress=None,
 ) -> FieldResults:
-    """Nice-number search. The jax backend currently runs the dense masked
-    check over MSD-surviving sub-ranges; the stride-compacted device
-    enumeration arrives with the Pallas niceonly kernel."""
+    """Nice-number search via the stride-compacted device pipeline (TPU) or
+    the dense masked scan (jnp fallback).
+
+    progress: optional callable(done_numbers, total_numbers); on the strided
+    path it reports the filter front (see _niceonly_pallas), on the dense
+    path dispatched lanes. May be called from a worker thread."""
     if backend == "scalar":
         return scalar.process_range_niceonly(range_, base, stride_table)
     if backend == "native":
@@ -921,7 +982,7 @@ def process_range_niceonly(
         # stride_table only parameterizes the scalar/host paths).
         nice_numbers.extend(
             NiceNumberSimple(number=n, num_uniques=base)
-            for n in _niceonly_pallas(core, base)
+            for n in _niceonly_pallas(core, base, progress=progress)
         )
         nice_numbers.sort(key=lambda n: n.number)
         return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
@@ -981,6 +1042,8 @@ def process_range_niceonly(
     )
     host_secs = time.monotonic() - t_host0
     t_dev0 = time.monotonic()
+    grand_total = sum(r.size() for r in sub_ranges)
+    grand_done = 0
     for sub_range in sub_ranges:
         start = sub_range.start()
         total = sub_range.size()
@@ -993,6 +1056,9 @@ def process_range_niceonly(
             if len(pending) >= DISPATCH_WINDOW:
                 collect_one()
             done += valid
+            grand_done += valid
+            if progress is not None:
+                progress(grand_done, grand_total)
     while pending:
         collect_one()
     device_secs = time.monotonic() - t_dev0

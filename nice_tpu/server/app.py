@@ -34,12 +34,21 @@ log = logging.getLogger("nice_tpu.server")
 
 
 class Metrics:
-    """Per-endpoint request counters and latency sums (Prometheus text)."""
+    """Per-endpoint request counters and latency histograms (Prometheus text).
+
+    Histogram buckets mirror rocket_prometheus's defaults (reference
+    api/src/main.rs:438-459 exposes per-endpoint response-time histograms),
+    giving p50/p99 visibility rather than just cumulative sums."""
+
+    BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: dict[tuple[str, int], int] = {}
         self._time_sums: dict[str, float] = {}
+        # endpoint -> per-bucket cumulative-style raw counts (+Inf is the
+        # implicit last slot); rendered cumulatively.
+        self._buckets: dict[str, list[int]] = {}
 
     def record(self, endpoint: str, status: int, elapsed: float) -> None:
         with self._lock:
@@ -47,6 +56,15 @@ class Metrics:
                 self._counts.get((endpoint, status), 0) + 1
             )
             self._time_sums[endpoint] = self._time_sums.get(endpoint, 0.0) + elapsed
+            slots = self._buckets.setdefault(
+                endpoint, [0] * (len(self.BUCKETS) + 1)
+            )
+            for i, le in enumerate(self.BUCKETS):
+                if elapsed <= le:
+                    slots[i] += 1
+                    break
+            else:
+                slots[-1] += 1
 
     def render(self) -> str:
         lines = [
@@ -60,13 +78,29 @@ class Metrics:
                     f'status="{status}"}} {count}'
                 )
             lines.append(
-                "# HELP nice_api_request_seconds_total Cumulative request time."
+                "# HELP nice_api_request_seconds Request latency by endpoint."
             )
-            lines.append("# TYPE nice_api_request_seconds_total counter")
-            for endpoint, total in sorted(self._time_sums.items()):
+            lines.append("# TYPE nice_api_request_seconds histogram")
+            for endpoint, slots in sorted(self._buckets.items()):
+                cum = 0
+                for le, raw in zip(self.BUCKETS, slots):
+                    cum += raw
+                    lines.append(
+                        f'nice_api_request_seconds_bucket{{endpoint='
+                        f'"{endpoint}",le="{le}"}} {cum}'
+                    )
+                cum += slots[-1]
                 lines.append(
-                    f'nice_api_request_seconds_total{{endpoint="{endpoint}"}}'
-                    f" {total:.6f}"
+                    f'nice_api_request_seconds_bucket{{endpoint="{endpoint}",'
+                    f'le="+Inf"}} {cum}'
+                )
+                lines.append(
+                    f'nice_api_request_seconds_count{{endpoint="{endpoint}"}}'
+                    f" {cum}"
+                )
+                lines.append(
+                    f'nice_api_request_seconds_sum{{endpoint="{endpoint}"}}'
+                    f" {self._time_sums.get(endpoint, 0.0):.6f}"
                 )
         return "\n".join(lines) + "\n"
 

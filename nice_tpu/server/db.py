@@ -409,29 +409,35 @@ class Db:
         return row["m"] or 0
 
     def _find_thin_chunk(self, maximum_check_level: int):
-        """First chunk with < DOWNSAMPLE_CUTOFF_PERCENT checked for the mode
-        (reference db_util/fields.rs:349-380); ratio computed host-side
-        because counts are u128 TEXT columns."""
+        """First chunk with < DOWNSAMPLE_CUTOFF_PERCENT checked for the mode,
+        in ONE SQL statement (reference db_util/fields.rs:349-380).
+
+        The counts are zero-padded decimal TEXT (u128-capable), but chunk
+        sizes are bounded by base ranges at ~1e12 — far below 2^53 — so
+        CAST(... AS REAL) is EXACT and the ratio predicate can run in SQL
+        instead of a Python scan over every chunk row (which was O(chunks)
+        with a second query per candidate — fine at one seeded base,
+        degrading at the reference's ~9000-chunk scale)."""
         col = "checked_niceonly" if maximum_check_level == 0 else "checked_detailed"
         with self._read_conn() as conn:
-            rows = conn.execute(
-                f"SELECT id, {col} AS checked, range_size FROM chunks ORDER BY id ASC"
-            ).fetchall()
-        for row in rows:
-            size = unpad(row["range_size"])
-            if size == 0:
-                continue
-            if unpad(row["checked"]) / size < DOWNSAMPLE_CUTOFF_PERCENT:
-                with self._read_conn() as conn:
-                    span = conn.execute(
-                        "SELECT MIN(id) AS lo, MAX(id) AS hi FROM fields"
-                        " WHERE chunk_id = ?",
-                        (row["id"],),
-                    ).fetchone()
-                if span["lo"] is None:
-                    continue
-                return row["id"], span["lo"], span["hi"]
-        return None, None, None
+            row = conn.execute(
+                f"""
+                SELECT c.id AS chunk_id,
+                       (SELECT MIN(id) FROM fields WHERE chunk_id = c.id) AS lo,
+                       (SELECT MAX(id) FROM fields WHERE chunk_id = c.id) AS hi
+                FROM chunks c
+                WHERE CAST(c.range_size AS REAL) > 0
+                  AND CAST(c.{col} AS REAL)
+                      < ? * CAST(c.range_size AS REAL)
+                  AND EXISTS (SELECT 1 FROM fields WHERE chunk_id = c.id)
+                ORDER BY c.id ASC
+                LIMIT 1
+                """,
+                (DOWNSAMPLE_CUTOFF_PERCENT,),
+            ).fetchone()
+        if row is None:
+            return None, None, None
+        return row["chunk_id"], row["lo"], row["hi"]
 
     def bulk_claim_fields(
         self,

@@ -1,0 +1,44 @@
+"""Client CLI flag plumbing (reference client/src/main.rs:64-196)."""
+
+import logging
+
+import pytest
+
+from nice_tpu.client import main as cli
+from nice_tpu.ops import engine
+
+
+def test_threads_flag_round_trips(monkeypatch):
+    monkeypatch.delenv("NICE_THREADS", raising=False)
+    args = cli.build_parser().parse_args(["--threads", "7", "detailed"])
+    assert args.threads == 7
+    # main() wires the flag into NICE_THREADS; replicate that wiring and
+    # confirm the native pool sizing sees it.
+    import os
+
+    monkeypatch.setenv("NICE_THREADS", str(args.threads))
+    assert engine._native_threads() == 7
+
+
+def test_threads_env_default(monkeypatch):
+    monkeypatch.setenv("NICE_THREADS", "3")
+    args = cli.build_parser().parse_args(["detailed"])
+    assert args.threads == 3
+
+
+def test_progress_logger_throttles_and_reports(monkeypatch, caplog):
+    cb = cli._progress_logger(0.0)
+    assert cb is None  # disabled
+    cb = cli._progress_logger(1e-9)  # report on (almost) every call
+    with caplog.at_level(logging.INFO, logger="nice_tpu.client"):
+        cb(1, 100)
+        cb(100, 100)  # terminal call suppressed (the summary line covers it)
+    msgs = [r.message for r in caplog.records]
+    assert any("progress" in m and "ETA" in m for m in msgs)
+    assert not any("100.0%" in m for m in msgs)
+
+
+def test_progress_flag_parses(monkeypatch):
+    monkeypatch.setenv("NICE_PROGRESS_SECS", "2.5")
+    args = cli.build_parser().parse_args(["detailed"])
+    assert args.progress_secs == 2.5

@@ -174,3 +174,30 @@ def test_engine_explicit_pallas_backend_b10():
     want = scalar.process_range_detailed(br, 10)
     assert got == want
     assert [(n.number, n.num_uniques) for n in got.nice_numbers] == [(69, 10)]
+
+
+def test_zero_count_audit_catches_device_undercount(monkeypatch):
+    """The sampled audit must turn a silent device undercount into a hard
+    error: zero the kernel's counts over a range known to contain 69 and
+    audit every zero-count descriptor."""
+    import numpy as np
+
+    monkeypatch.setenv("NICE_TPU_AUDIT_EVERY", "1")
+    # Single-device path: the sharded step calls the kernel callable
+    # directly, bypassing the patched batch entry point.
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+
+    def zeroed(plan, spec, desc, periods=pe.STRIDED_PERIODS, n_real=None):
+        return np.zeros((8, 128), dtype=np.int32)
+
+    monkeypatch.setattr(pe, "niceonly_strided_batch", zeroed)
+    br = base_range.get_base_range_field(10)
+    with pytest.raises(RuntimeError, match="undercount"):
+        engine.process_range_niceonly(br, 10, backend="pallas", batch_size=BL)
+
+
+def test_zero_count_audit_passes_on_honest_counts(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_AUDIT_EVERY", "1")
+    br = base_range.get_base_range_field(10)
+    got = engine.process_range_niceonly(br, 10, backend="pallas", batch_size=BL)
+    assert [n.number for n in got.nice_numbers] == [69]

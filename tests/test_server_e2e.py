@@ -101,6 +101,13 @@ def test_full_claim_process_submit_loop(server):
         metrics = r.read().decode()
     assert "nice_api_requests_total" in metrics
     assert 'endpoint="/submit"' in metrics
+    # latency histogram (reference api/src/main.rs:438-459): bucket series,
+    # +Inf terminal bucket, and count/sum pairs per endpoint
+    assert "# TYPE nice_api_request_seconds histogram" in metrics
+    assert 'nice_api_request_seconds_bucket{endpoint="/submit",le="0.005"}' in metrics
+    assert 'nice_api_request_seconds_bucket{endpoint="/submit",le="+Inf"}' in metrics
+    assert 'nice_api_request_seconds_count{endpoint="/submit"}' in metrics
+    assert 'nice_api_request_seconds_sum{endpoint="/submit"}' in metrics
 
 
 def test_submit_verification_rejects_bad_distribution(server):

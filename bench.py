@@ -41,12 +41,12 @@ MAX_INIT_ATTEMPTS = 3
 # every mode drops to 1<<20.
 _TPU_BATCH = {
     # Committed sweep (scripts/tune_kernels.py, round 4, 1e9 slices on a
-    # v5e chip, threaded collector): extra-large 2^24..2^30 ->
-    # 125/252/492/862/1333/1324/1266 M n/s (2^28 best; below it per-batch
-    # dispatch overhead dominates, above it tail padding); hi-base
-    # 2^23..2^29 -> 61/122/242/347/328/328/327 M n/s (2^26 best —
-    # compute-bound at b80's 3-limb digit extraction, insensitive beyond).
-    ("extra-large", "detailed"): 1 << 28,
+    # v5e chip, threaded collector + BLOCK_ROWS=128 + single-division digit
+    # extraction): extra-large 2^27/2^28/2^29 -> 896/1454/1558 M n/s (2^29
+    # best: fewest per-batch dispatch round-trips; 2^30 pays 7% tail
+    # padding); hi-base 2^25/2^26/2^27 -> 242/413/392 M n/s (2^26 best —
+    # compute-bound at b80's 3-limb digit extraction).
+    ("extra-large", "detailed"): 1 << 29,
     ("extra-large", "niceonly"): 1 << 20,  # strided path; batch is unused
     ("hi-base", "detailed"): 1 << 26,
     ("msd-ineffective", "niceonly"): 1 << 22,

@@ -240,7 +240,9 @@ def _split_for_jax(range_: FieldSize, base: int, scalar_fn):
     return core, slivers
 
 
-def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults:
+def _native_detailed(
+    range_: FieldSize, base: int, threads: int, progress=None
+) -> FieldResults:
     """Multi-threaded native CPU detailed loop (the analog of the reference's
     rayon par_iter client, client/src/main.rs:154-207). ctypes releases the
     GIL, so a thread pool gets real parallelism."""
@@ -263,11 +265,12 @@ def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults
     ]
     hist = np.zeros(base + 2, dtype=np.int64)
     nice_numbers: list[NiceNumberSimple] = []
+    done = 0
     with ThreadPoolExecutor(max_workers=threads) as pool:
-        for res in pool.map(
+        for span_, res in zip(spans, pool.map(
             lambda s: native.process_range_detailed(s[0], s[1], base, cutoff),
             spans,
-        ):
+        )):
             if res is None:
                 # Out-of-bounds base or >u128 values; the caller picked the
                 # native backend explicitly, so raise rather than silently
@@ -281,6 +284,9 @@ def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults
             nice_numbers.extend(
                 NiceNumberSimple(number=n, num_uniques=u) for n, u in misses
             )
+            done += span_[1]
+            if progress is not None:
+                progress(done, total)
     nice_numbers.sort(key=lambda n: n.number)
     distribution = tuple(
         UniquesDistributionSimple(num_uniques=i, count=int(hist[i]))
@@ -289,7 +295,9 @@ def _native_detailed(range_: FieldSize, base: int, threads: int) -> FieldResults
     return FieldResults(distribution=distribution, nice_numbers=tuple(nice_numbers))
 
 
-def _native_niceonly(range_: FieldSize, base: int, stride_table, threads: int) -> FieldResults:
+def _native_niceonly(
+    range_: FieldSize, base: int, stride_table, threads: int, progress=None
+) -> FieldResults:
     """Native filter cascade: C++ MSD subdivision -> stride-table gap jumps ->
     early-exit checks, fanned across threads per MSD range."""
     from concurrent.futures import ThreadPoolExecutor
@@ -322,12 +330,17 @@ def _native_niceonly(range_: FieldSize, base: int, stride_table, threads: int) -
         return found
 
     ranges = msd_filter.get_valid_ranges(range_, base)
+    total = sum(r.size() for r in ranges)
+    done = 0
     nice_numbers: list[NiceNumberSimple] = []
     with ThreadPoolExecutor(max_workers=threads) as pool:
-        for found in pool.map(run, ranges):
+        for sub, found in zip(ranges, pool.map(run, ranges)):
             nice_numbers.extend(
                 NiceNumberSimple(number=n, num_uniques=base) for n in found
             )
+            done += sub.size()
+            if progress is not None:
+                progress(done, total)
     nice_numbers.sort(key=lambda n: n.number)
     return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
 
@@ -821,7 +834,7 @@ def process_range_detailed(
     if backend == "scalar":
         return scalar.process_range_detailed(range_, base)
     if backend == "native":
-        return _native_detailed(range_, base, _native_threads())
+        return _native_detailed(range_, base, _native_threads(), progress)
     if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -940,7 +953,9 @@ def process_range_niceonly(
     if backend == "scalar":
         return scalar.process_range_niceonly(range_, base, stride_table)
     if backend == "native":
-        return _native_niceonly(range_, base, stride_table, _native_threads())
+        return _native_niceonly(
+            range_, base, stride_table, _native_threads(), progress
+        )
     if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 

@@ -37,10 +37,13 @@ from jax.experimental.pallas import tpu as pltpu
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.ops.limbs import BasePlan
 
-# Lanes per grid step: 256 sublanes x 128 lanes. Keeps every live (rows, 128)
-# u32 intermediate at 128 KiB so the whole pipeline (~15 live arrays during
-# extraction) sits comfortably in the ~16 MiB of VMEM.
-BLOCK_ROWS = 256
+# Lanes per grid step: 128 sublanes x 128 lanes. Keeps every live (rows, 128)
+# u32 intermediate at 64 KiB so the whole pipeline (~15 live arrays during
+# extraction) sits comfortably in the ~16 MiB of VMEM. Committed sweep
+# (round 4, b40 2^26-lane batch on a v5e): rows 64/128/256/512 ->
+# 1.39/1.39/1.32/1.22 G lanes/s — smaller blocks leave VMEM headroom for
+# Mosaic's pipelining; 128 chosen over 64 for fewer grid steps.
+BLOCK_ROWS = 128
 BLOCK_LANES = BLOCK_ROWS * 128
 
 
@@ -186,7 +189,10 @@ STRIDED_PERIODS = 128     # default stride periods per descriptor
 STRIDED_PERIODS_MAX = 1024  # planning cap (span stays far below u32)
 STRIDED_OFFS_LANES_MAX = 1 << 20  # offsets-table VMEM budget (4 MiB of u32)
 _DESC_WIDTH = 12          # u32 fields per descriptor: n0[4] lo[4] hi[4]
-_STRIDED_BLOCK_ROWS_MAX = 256  # offset rows per grid step (32k lanes)
+# Offset rows per grid step. Committed sweep (round 4, b50 k=1 p=1024 full
+# 1024-descriptor group on a v5e): max 32/64/128/256/512 ->
+# 1.11/1.13/1.12/1.08/0.82 G lanes/s.
+_STRIDED_BLOCK_ROWS_MAX = 64
 _STRIDED_STEP_OVERHEAD_ROWS = 16  # Mosaic per-grid-step cost, in row units
 
 

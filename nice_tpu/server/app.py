@@ -437,12 +437,33 @@ def make_handler(ctx: ApiContext):
         def _try_static(self, path: str) -> bool:
             """Serve the analytics dashboard + browser search page from web/
             (the reference hosts these as a separate static site; co-hosting
-            them keeps the single-binary deployment simple)."""
+            them keeps the single-binary deployment simple).
+
+            The web/ tree ships in checkouts, the sdist, and the docker
+            image, but NOT the wheel (it lives outside the package); a
+            wheel-installed server degrades to API-only with one logged
+            warning rather than silently 404ing."""
             import os
 
-            web_root = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "web"
-            )
+            candidates = [
+                os.path.join(
+                    os.path.dirname(
+                        os.path.dirname(os.path.dirname(__file__))
+                    ),
+                    "web",
+                ),
+                os.path.join(os.getcwd(), "web"),
+            ]
+            web_root = next((c for c in candidates if os.path.isdir(c)), None)
+            if web_root is None:
+                if not getattr(make_handler, "_warned_no_web", False):
+                    make_handler._warned_no_web = True
+                    log.warning(
+                        "no web/ directory found (wheel install?): dashboard "
+                        "disabled, API-only — run from a checkout, the sdist, "
+                        "or the docker image to serve the static site"
+                    )
+                return False
             rel = path.lstrip("/") or "index.html"
             full = os.path.realpath(os.path.join(web_root, rel))
             if os.path.isdir(full):

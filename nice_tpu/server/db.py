@@ -412,12 +412,14 @@ class Db:
         """First chunk with < DOWNSAMPLE_CUTOFF_PERCENT checked for the mode,
         in ONE SQL statement (reference db_util/fields.rs:349-380).
 
-        The counts are zero-padded decimal TEXT (u128-capable), but chunk
-        sizes are bounded by base ranges at ~1e12 — far below 2^53 — so
-        CAST(... AS REAL) is EXACT and the ratio predicate can run in SQL
-        instead of a Python scan over every chunk row (which was O(chunks)
-        with a second query per candidate — fine at one seeded base,
-        degrading at the reference's ~9000-chunk scale)."""
+        The counts are zero-padded decimal TEXT (u128-capable); CAST(... AS
+        REAL) is approximate above 2^53 (hi-base chunks reach ~1e28), so a
+        chunk within ~1 ulp of the cutoff ratio can classify either way —
+        exactly the tolerance the previous Python float division had, and
+        harmless for a 20% exploration threshold. The win is running the
+        predicate in SQL instead of a Python scan over every chunk row with
+        a second query per candidate (fine at one seeded base, degrading at
+        the reference's ~9000-chunk scale)."""
         col = "checked_niceonly" if maximum_check_level == 0 else "checked_detailed"
         with self._read_conn() as conn:
             row = conn.execute(

@@ -42,3 +42,18 @@ def test_progress_flag_parses(monkeypatch):
     monkeypatch.setenv("NICE_PROGRESS_SECS", "2.5")
     args = cli.build_parser().parse_args(["detailed"])
     assert args.progress_secs == 2.5
+
+
+def test_native_backend_reports_progress():
+    from nice_tpu import native
+    from nice_tpu.core import base_range
+    from nice_tpu.core.types import FieldSize
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    br = base_range.get_base_range_field(10)
+    seen = []
+    engine.process_range_detailed(
+        br, 10, backend="native", progress=lambda d, t: seen.append((d, t))
+    )
+    assert seen and seen[-1][0] == seen[-1][1] == br.size()

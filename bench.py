@@ -42,10 +42,11 @@ MAX_INIT_ATTEMPTS = 3
 _TPU_BATCH = {
     # Committed sweep (scripts/tune_kernels.py, round 4, 1e9 slices on a
     # v5e chip, threaded collector + BLOCK_ROWS=128 + single-division digit
-    # extraction): extra-large 2^27/2^28/2^29 -> 896/1454/1558 M n/s (2^29
-    # best: fewest per-batch dispatch round-trips; 2^30 pays 7% tail
-    # padding); hi-base 2^25/2^26/2^27 -> 242/413/392 M n/s (2^26 best —
-    # compute-bound at b80's 3-limb digit extraction).
+    # extraction with free chunk-final digits): extra-large
+    # 2^27/2^28/2^29 -> 896/1454/1698 M n/s (2^29 best: fewest per-batch
+    # dispatch round-trips; 2^30 pays 7% tail padding); hi-base
+    # 2^25/2^26/2^27 -> 242/438/392 M n/s (2^26 best — compute-bound at
+    # b80's 3-limb digit extraction).
     ("extra-large", "detailed"): 1 << 29,
     ("extra-large", "niceonly"): 1 << 20,  # strided path; batch is unused
     ("hi-base", "detailed"): 1 << 26,

@@ -177,19 +177,23 @@ def accumulate_digit_masks(plan: BasePlan, masks: list, limbs: list, num_digits:
         remaining -= plan.chunk_e
         new_hw = halfwords_for(plan.base**remaining)
         hws, rem = _divmod_halfwords(hws, plan.chunk_div, new_hw)
-        for _ in range(plan.chunk_e):
-            # One constant division per digit: d = rem - (rem // b) * b.
-            # (rem % b would be a second division unless the compiler CSEs
-            # the pair — Mosaic does not.)
+        # One constant division per digit — d = rem - (rem // b) * b; rem % b
+        # would be a second division Mosaic does not CSE — and none at all
+        # for the chunk's last digit (rem < b there, so the quotient is
+        # provably zero and rem IS the digit).
+        for _ in range(plan.chunk_e - 1):
             q = rem // base
             masks = set_digit_masks(plan, masks, [rem - q * base])
             rem = q
+        masks = set_digit_masks(plan, masks, [rem])
     assert len(hws) == 1, (plan.base, num_digits, len(hws))
     rem = hws[0]
-    for _ in range(remaining):
+    for _ in range(remaining - 1):
         q = rem // base
         masks = set_digit_masks(plan, masks, [rem - q * base])
         rem = q
+    if remaining > 0:
+        masks = set_digit_masks(plan, masks, [rem])
     return masks
 
 

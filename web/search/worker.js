@@ -224,7 +224,9 @@ function processRange(startStr, endStr, baseInt) {
     }
   }
   report(true);
-  return { distribution, nice_numbers: niceNumbers };
+  // engine attribution: the self-test can demote a base<=64 field to the
+  // BigInt oracle, so report which engine actually ran (bench.html reads it).
+  return { distribution, nice_numbers: niceNumbers, engine: fast !== null ? "fast" : "bigint" };
 }
 
 onmessage = (e) => {

@@ -201,3 +201,47 @@ def test_zero_count_audit_passes_on_honest_counts(monkeypatch):
     br = base_range.get_base_range_field(10)
     got = engine.process_range_niceonly(br, 10, backend="pallas", batch_size=BL)
     assert [n.number for n in got.nice_numbers] == [69]
+
+
+def test_pipeline_propagates_producer_failure(monkeypatch):
+    """An MSD-filter crash in the producer thread must surface on the caller
+    (and never deadlock the dispatcher on a queue that stops filling)."""
+    from nice_tpu.ops import msd_filter
+
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+
+    def boom(*a, **k):
+        raise RuntimeError("filter exploded")
+
+    monkeypatch.setattr(msd_filter, "get_valid_ranges", boom)
+    br = base_range.get_base_range_field(10)
+    with pytest.raises(RuntimeError, match="filter exploded"):
+        engine.process_range_niceonly(br, 10, backend="pallas", batch_size=BL)
+
+
+def test_pipeline_propagates_dispatch_failure(monkeypatch):
+    """A device-dispatch crash must shut down producer and collector cleanly
+    and re-raise on the caller."""
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+
+    def boom(*a, **k):
+        raise RuntimeError("dispatch exploded")
+
+    monkeypatch.setattr(pe, "niceonly_strided_batch", boom)
+    br = base_range.get_base_range_field(10)
+    with pytest.raises(RuntimeError, match="dispatch exploded"):
+        engine.process_range_niceonly(br, 10, backend="pallas", batch_size=BL)
+
+
+def test_detailed_collector_propagates_failure(monkeypatch):
+    """A rare-path re-scan crash inside the detailed collector thread must
+    re-raise on the caller."""
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+
+    def boom(*a, **k):
+        raise RuntimeError("rare path exploded")
+
+    monkeypatch.setattr(engine, "_rare_scan_uniques", boom)
+    br = base_range.get_base_range_field(10)  # contains 69 -> rare path fires
+    with pytest.raises(RuntimeError, match="rare path exploded"):
+        engine.process_range_detailed(br, 10, backend="pallas", batch_size=BL)

@@ -46,8 +46,6 @@ def test_detailed_jnp_matches_scalar(base, frac, size):
 @given(base=st.sampled_from([10, 20, 40, 50]), frac=st.floats(0, 1), size=st.integers(1, 4000))
 def test_niceonly_strided_matches_scalar(base, frac, size):
     fs = _window(base, frac, size)
-    if engine.get_plan(base).limbs_n > 4:
-        return
     got = engine.process_range_niceonly(fs, base, backend="pallas", batch_size=1 << 10)
     want = scalar.process_range_niceonly(fs, base)
     assert [n.number for n in got.nice_numbers] == [
@@ -68,16 +66,28 @@ def test_lsd_bitmap_oracle_property(base, k):
 
 @settings(max_examples=15, deadline=None, derandomize=True)
 @given(base=_BASES, frac=st.floats(0, 1), size=st.integers(2, 20_000))
-def test_msd_filter_never_loses_a_nice_number(base, frac, size):
-    """Soundness: every nice number in a window survives the MSD filter at
-    any floor (the filter may keep extra ranges, never drop a hit)."""
+def test_msd_filter_drops_only_non_nice_spans(base, frac, size):
+    """Soundness, exhaustively per example: every span the MSD filter DROPS
+    from a window must contain zero nice numbers (checked via the stride
+    table's early-exit scan — real nice numbers are too rare for random
+    windows to contain one, so asserting on survivors alone would be
+    vacuous; asserting on the dropped complement tests every example)."""
     fs = _window(base, frac, size)
     table = stride_filter.get_stride_table(base, 1)
     if table.num_residues == 0:
-        return
-    nice = [n.number for n in table.iterate_range(fs, base)]
-    if not nice:
-        return
-    ranges = msd_filter.get_valid_ranges(fs, base, min_range_size=256)
-    for n in nice:
-        assert any(r.start() <= n < r.end() for r in ranges), (base, n)
+        return  # base provably has no nice numbers at all
+    ranges = sorted(
+        msd_filter.get_valid_ranges(fs, base, min_range_size=256),
+        key=lambda r: r.start(),
+    )
+    dropped = []
+    pos = fs.start()
+    for r in ranges:
+        if r.start() > pos:
+            dropped.append((pos, r.start()))
+        pos = max(pos, r.end())
+    if pos < fs.end():
+        dropped.append((pos, fs.end()))
+    for lo, hi in dropped:
+        found = table.iterate_range(FieldSize(lo, hi), base)
+        assert not found, (base, lo, hi, [n.number for n in found])

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 from collections import deque
+from typing import NamedTuple
 
 import numpy as np
 
@@ -466,16 +467,27 @@ def _strided_floor(ctrl, field_size: int) -> int:
     return max(ctrl.current(), min(field_size >> 21, adaptive_floor.FLOOR_MAX))
 
 
-def _strided_setup(base: int, field_size: int):
+class _StridedSetup(NamedTuple):
+    plan: object
+    ctrl: object
+    floor: int
+    k: int
+    periods: int
+    table: object
+    spec: object
+    desc_max: int
+    n_dev: int
+    sharded_step: object  # None on single-device
+
+
+def _strided_setup(base: int, field_size: int) -> "_StridedSetup | None":
     """Kernel-shape derivation shared by warm_niceonly and _niceonly_pallas.
 
     ONE code path decides (floor, stride depth, periods, descriptor rows,
     sharded step) so a warm-up can never compile a different kernel than the
     field it warms — the drift that would silently re-introduce timed-region
     Mosaic compiles. Returns None when the strided device path cannot run
-    this base (too many limbs, or provably no nice numbers); else a dict
-    with plan/ctrl/floor/k/periods/table/spec/desc_max/n_dev/sharded_step.
-    """
+    this base (too many limbs, or provably no nice numbers)."""
     from nice_tpu.ops import adaptive_floor, stride_filter
 
     plan = get_plan(base)
@@ -502,9 +514,9 @@ def _strided_setup(base: int, field_size: int):
         )
     else:
         n_dev, sharded_step = 1, None
-    return dict(
-        plan=plan, ctrl=ctrl, floor=floor, k=k, periods=periods, table=table,
-        spec=spec, desc_max=desc_max, n_dev=n_dev, sharded_step=sharded_step,
+    return _StridedSetup(
+        plan, ctrl, floor, k, periods, table, spec, desc_max, n_dev,
+        sharded_step,
     )
 
 
@@ -523,15 +535,13 @@ def warm_niceonly(base: int, field_size: int = 0) -> None:
     s = _strided_setup(base, field_size)
     if s is None:
         return
-    packed = np.zeros((s["desc_max"] * s["n_dev"], 12), dtype=np.uint32)
-    if s["sharded_step"] is not None:
-        np.asarray(
-            s["sharded_step"](packed, np.zeros(s["n_dev"], dtype=np.int32))
-        )
+    packed = np.zeros((s.desc_max * s.n_dev, 12), dtype=np.uint32)
+    if s.sharded_step is not None:
+        np.asarray(s.sharded_step(packed, np.zeros(s.n_dev, dtype=np.int32)))
     else:
         np.asarray(
             pe.niceonly_strided_batch(
-                s["plan"], s["spec"], packed, periods=s["periods"], n_real=0
+                s.plan, s.spec, packed, periods=s.periods, n_real=0
             )
         )
 
@@ -562,9 +572,9 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
     s = _strided_setup(base, core.size())
     if s is None:
         return []
-    plan, ctrl, floor_used = s["plan"], s["ctrl"], s["floor"]
-    k, periods, table, spec = s["k"], s["periods"], s["table"], s["spec"]
-    desc_max, n_dev, sharded_step = s["desc_max"], s["n_dev"], s["sharded_step"]
+    plan, ctrl, floor_used = s.plan, s.ctrl, s.floor
+    k, periods, table, spec = s.k, s.periods, s.table, s.spec
+    desc_max, n_dev, sharded_step = s.desc_max, s.n_dev, s.sharded_step
     modulus = table.modulus
     span = periods * modulus
     # Descriptor batches shard across the mesh when >1 device is visible:
@@ -747,9 +757,7 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
         # flat at [d, i] after collapsing each device's tile.
         counts = np.asarray(counts_dev).reshape(n_dev, -1)
         k = len(cols[0])
-        flat = np.concatenate(
-            [counts[d, :desc_max] for d in range(n_dev)]
-        )[:k]
+        flat = counts[:, :desc_max].reshape(-1)[:k]
         for g in np.nonzero(flat)[0].tolist():
             n0, lo, hi = _at(cols, 0, g), _at(cols, 1, g), _at(cols, 2, g)
             count = int(flat[g])

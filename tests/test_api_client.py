@@ -1,0 +1,69 @@
+"""Retry/backoff policy unit tests for the client HTTP transport
+(reference client_api_sync.rs:37-89: 2^attempt backoff, 5xx/network
+retryable, 4xx fail-fast)."""
+
+import io
+import urllib.error
+
+import pytest
+
+from nice_tpu.client import api_client
+
+
+def _http_error(code, body=b""):
+    return urllib.error.HTTPError(
+        "http://x/", code, "err", hdrs=None, fp=io.BytesIO(body)
+    )
+
+
+def test_4xx_fails_fast_with_server_detail(monkeypatch):
+    calls = []
+
+    def fake(url, body=None, timeout=None):
+        calls.append(url)
+        raise _http_error(422, b"bad distribution")
+
+    monkeypatch.setattr(api_client, "_request_json", fake)
+    with pytest.raises(api_client.ApiError, match="422.*bad distribution"):
+        api_client.retry_request("http://x/submit", max_retries=5)
+    assert len(calls) == 1  # no retries on client error
+
+
+def test_5xx_retries_with_exponential_backoff(monkeypatch):
+    delays = []
+    monkeypatch.setattr(api_client.time, "sleep", delays.append)
+    attempts = [0]
+
+    def fake(url, body=None, timeout=None):
+        attempts[0] += 1
+        if attempts[0] <= 3:
+            raise _http_error(503)
+        return {"ok": True}
+
+    monkeypatch.setattr(api_client, "_request_json", fake)
+    assert api_client.retry_request("http://x/claim", max_retries=5) == {"ok": True}
+    assert delays == [1, 2, 4]  # 2^attempt seconds
+
+
+def test_network_error_exhausts_retries(monkeypatch):
+    monkeypatch.setattr(api_client.time, "sleep", lambda s: None)
+
+    def fake(url, body=None, timeout=None):
+        raise urllib.error.URLError("connection refused")
+
+    monkeypatch.setattr(api_client, "_request_json", fake)
+    with pytest.raises(api_client.ApiError, match="after 2 retries"):
+        api_client.retry_request("http://x/claim", max_retries=2)
+
+
+def test_backoff_is_capped(monkeypatch):
+    delays = []
+    monkeypatch.setattr(api_client.time, "sleep", delays.append)
+
+    def fake(url, body=None, timeout=None):
+        raise _http_error(500)
+
+    monkeypatch.setattr(api_client, "_request_json", fake)
+    with pytest.raises(api_client.ApiError):
+        api_client.retry_request("http://x/", max_retries=12)
+    assert max(delays) == api_client.MAX_BACKOFF_SECS  # 2^11 > 512 cap

@@ -1,0 +1,68 @@
+"""Daemon unit tests: CPU sampling math and client process lifecycle
+(reference daemon/src/main.rs:39-215)."""
+
+import subprocess
+import sys
+import time
+
+from nice_tpu.daemon import main as daemon
+
+
+def test_read_cpu_times_shape():
+    idle, total = daemon.read_cpu_times()
+    assert 0 <= idle <= total
+
+
+def test_cpu_monitor_usage_math(monkeypatch):
+    # Deterministic /proc/stat: 100 jiffies pass, 25 idle -> 75% usage.
+    readings = iter([(1000, 10_000), (1025, 10_100)])
+    monkeypatch.setattr(daemon, "read_cpu_times", lambda: next(readings))
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    m = daemon.CpuMonitor(interval_secs=0)
+    assert abs(m.sample() - 0.75) < 1e-9
+
+
+def test_cpu_monitor_zero_delta(monkeypatch):
+    readings = iter([(1000, 10_000), (1000, 10_000)])
+    monkeypatch.setattr(daemon, "read_cpu_times", lambda: next(readings))
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    m = daemon.CpuMonitor(interval_secs=0)
+    assert m.sample() == 0.0  # no jiffies elapsed: report idle, not NaN
+
+
+def test_process_manager_lifecycle(monkeypatch):
+    # Substitute a trivial child so the test never launches a real client.
+    calls = []
+
+    real_popen = subprocess.Popen
+
+    def fake_popen(cmd, *a, **k):
+        calls.append(cmd)
+        return real_popen([sys.executable, "-c", "import time; time.sleep(60)"])
+
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
+    pm = daemon.ProcessManager(["--repeat", "niceonly"])
+    assert not pm.running()
+    assert not pm.reap()
+    pm.start()
+    assert pm.running()
+    assert calls and calls[0][-2:] == ["--repeat", "niceonly"]
+    pm.start()  # idempotent while running
+    assert len(calls) == 1
+    pm.stop()
+    assert not pm.running()
+
+
+def test_process_manager_reaps_exited_client(monkeypatch):
+    real_popen = subprocess.Popen
+    monkeypatch.setattr(
+        subprocess,
+        "Popen",
+        lambda cmd, *a, **k: real_popen([sys.executable, "-c", "pass"]),
+    )
+    pm = daemon.ProcessManager([])
+    pm.start()
+    pm.proc.wait()
+    assert pm.reap()
+    assert pm.proc is None
+    assert not pm.reap()  # second reap is a no-op

@@ -609,9 +609,11 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
 
     # Producer chunk: enough leaves that each native call amortizes its
     # ctypes overhead, small enough that the dispatcher starts quickly and
-    # the two stages interleave smoothly (massive = 1e13 numbers -> ~19k
-    # chunks at floor 2^21).
-    chunk = floor_used * 256
+    # the two stages interleave smoothly. The size//256 term keeps huge
+    # fields at ~256 chunks total (massive at floor*256 alone would make
+    # ~19k native calls, ~0.8 s of pure ctypes overhead) — 256 chunks is
+    # still far finer than the pipeline needs for overlap.
+    chunk = max(floor_used * 256, core.size() // 256)
     n_ranges = [0]
 
     def produce():

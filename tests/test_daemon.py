@@ -1,13 +1,23 @@
 """Daemon unit tests: CPU sampling math and client process lifecycle
 (reference daemon/src/main.rs:39-215)."""
 
+import os
 import subprocess
 import sys
 import time
 
+import pytest
+
 from nice_tpu.daemon import main as daemon
 
+# /proc/stat tests are Linux-only (same convention as test_native.py); the
+# monkeypatched CpuMonitor math tests stub the reader so they run anywhere.
+linux_only = pytest.mark.skipif(
+    not os.path.exists("/proc/stat"), reason="needs /proc/stat (Linux)"
+)
 
+
+@linux_only
 def test_read_cpu_times_shape():
     idle, total = daemon.read_cpu_times()
     assert 0 <= idle <= total
@@ -42,14 +52,16 @@ def test_process_manager_lifecycle(monkeypatch):
 
     monkeypatch.setattr(subprocess, "Popen", fake_popen)
     pm = daemon.ProcessManager(["--repeat", "niceonly"])
-    assert not pm.running()
-    assert not pm.reap()
-    pm.start()
-    assert pm.running()
-    assert calls and calls[0][-2:] == ["--repeat", "niceonly"]
-    pm.start()  # idempotent while running
-    assert len(calls) == 1
-    pm.stop()
+    try:
+        assert not pm.running()
+        assert not pm.reap()
+        pm.start()
+        assert pm.running()
+        assert calls and calls[0][-2:] == ["--repeat", "niceonly"]
+        pm.start()  # idempotent while running
+        assert len(calls) == 1
+    finally:
+        pm.stop()  # never leak the sleeper child, even on assert failure
     assert not pm.running()
 
 

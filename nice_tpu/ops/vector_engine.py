@@ -15,6 +15,11 @@ mask-based instead of warp-divergent early exit):
 
 Correctness contract: the processed range must lie inside the base's valid
 range (engine.py enforces; the exact-digit-count theorem holds there).
+
+Why u32 limbs and not f32 24-bit limbs (the browser engine's trick): measured
+VPU op throughput on a v5e is at parity (u32 mul 0.25 T ops/s serial-chain vs
+f32 mul 0.22 / f32 fma 0.24; u32 div-by-const 0.19), so an f32 engine would
+only add the ~1.33x limb-count overhead of 24-bit limbs. Measured round 4.
 """
 
 from __future__ import annotations

@@ -11,6 +11,8 @@ Usage:
         --slice 100000000 --batches 24,26,28
     python scripts/tune_kernels.py niceonly --mode extra-large \
         --slice 1000000000 --floors 65536,262144,1048576
+    python scripts/tune_kernels.py blocks --mode extra-large
+    python scripts/tune_kernels.py stride-blocks --mode massive
 """
 
 from __future__ import annotations
@@ -52,15 +54,103 @@ def time_niceonly(data, slice_size: int) -> float:
     return time.monotonic() - t0
 
 
+def sweep_stats_blocks(data, rows_list, batch_shift: int) -> None:
+    """Raw stats-kernel lanes/s per block_rows (source of the committed
+    BLOCK_ROWS sweep in ops/pallas_engine.py)."""
+    import numpy as np
+
+    from nice_tpu.core import base_range
+    from nice_tpu.ops import pallas_engine as pe
+    from nice_tpu.ops.limbs import get_plan, int_to_limbs
+
+    plan = get_plan(data.base)
+    br = base_range.get_base_range(data.base)
+    start = int_to_limbs(br[0] + 1000, plan.limbs_n)
+    batch = 1 << batch_shift
+    for rows in rows_list:
+        # detailed_batch clamps to a block that tiles the batch exactly;
+        # report the EFFECTIVE rows so the sweep never labels a
+        # configuration that did not run. (No cache_clear needed:
+        # block_rows is part of the callable's cache key.)
+        eff = pe._effective_block_rows(batch, rows)
+        h, _ = pe.detailed_batch(plan, batch, start, np.int32(batch),
+                                 block_rows=rows)
+        np.asarray(h)
+        t0 = time.monotonic()
+        reps = 5
+        for _ in range(reps):
+            h, _ = pe.detailed_batch(plan, batch, start, np.int32(batch),
+                                     block_rows=rows)
+        np.asarray(h)
+        el = (time.monotonic() - t0) / reps
+        print(f"  stats block_rows={eff:4d}: {el*1e3:7.1f} ms = "
+              f"{batch/el/1e9:.2f} G lanes/s")
+
+
+def sweep_stride_blocks(data, rows_list) -> None:
+    """Raw strided-kernel lanes/s per _STRIDED_BLOCK_ROWS_MAX (source of the
+    committed sweep in ops/pallas_engine.py). Uses the field's planned
+    (k, periods) at the current floor on a full descriptor group."""
+    import numpy as np
+
+    from nice_tpu.core import base_range
+    from nice_tpu.ops import engine, pallas_engine as pe
+    from nice_tpu.ops.limbs import get_plan, int_to_limbs
+
+    base = data.base
+    plan = get_plan(base)
+    s = engine._strided_setup(base, data.range_size)
+    if s is None:
+        print("  strided path unavailable for this base")
+        return
+    spec, periods = s.spec, s.periods
+    span = periods * spec.modulus
+    br = base_range.get_base_range(base)
+    lo = br[0] + 1000
+    packed = np.zeros((1024, 12), dtype=np.uint32)
+    for i in range(1024):
+        n0 = (lo // spec.modulus) * spec.modulus + i * span
+        packed[i, 0:4] = int_to_limbs(n0, 4)
+        packed[i, 4:8] = int_to_limbs(lo, 4)
+        packed[i, 8:12] = int_to_limbs(lo + 1024 * span, 4)
+    lanes = 1024 * periods * spec.num_residues
+    saved = pe._STRIDED_BLOCK_ROWS_MAX
+    try:
+        for rows in rows_list:
+            pe._STRIDED_BLOCK_ROWS_MAX = rows
+            pe._strided_callable.cache_clear()
+            run = pe._strided_callable(plan, spec, 1024, periods)
+            np.asarray(run(packed, np.int32(1024)))
+            t0 = time.monotonic()
+            reps = 10
+            for _ in range(reps):
+                r = run(packed, np.int32(1024))
+            np.asarray(r)
+            el = (time.monotonic() - t0) / reps
+            print(f"  stride block_rows_max={rows:4d} (k={s.k} p={periods}): "
+                  f"{el*1e3:7.1f} ms/group = {lanes/el/1e9:.2f} G lanes/s")
+    finally:
+        pe._STRIDED_BLOCK_ROWS_MAX = saved
+        pe._strided_callable.cache_clear()
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("kind", choices=["detailed", "niceonly"])
+    p.add_argument(
+        "kind", choices=["detailed", "niceonly", "blocks", "stride-blocks"]
+    )
     p.add_argument("--mode", default="extra-large")
     p.add_argument("--slice", type=int, default=100_000_000)
     p.add_argument("--batches", default="22,24,26,28",
-                   help="log2 batch sizes to sweep (detailed)")
+                   help="log2 batch sizes to sweep (detailed); the blocks "
+                   "sweep uses --block-batch instead")
+    p.add_argument("--block-batch", type=int, default=26,
+                   help="log2 batch for the blocks sweep (26 matches the "
+                   "committed BLOCK_ROWS sweep in ops/pallas_engine.py)")
     p.add_argument("--floors", default="65536,262144,1048576",
                    help="MSD floors to sweep (niceonly; pins via env)")
+    p.add_argument("--rows", default="32,64,128,256,512",
+                   help="block rows to sweep (blocks / stride-blocks)")
     args = p.parse_args()
 
     # Make JAX_PLATFORMS authoritative (some PJRT plugins override the env
@@ -76,7 +166,13 @@ def main() -> int:
     data = get_benchmark_field(BenchmarkMode(args.mode))
     print(f"{args.kind} {args.mode}: base {data.base}, slice {args.slice:.0e}")
 
-    if args.kind == "detailed":
+    if args.kind == "blocks":
+        sweep_stats_blocks(
+            data, [int(r) for r in args.rows.split(",")], args.block_batch
+        )
+    elif args.kind == "stride-blocks":
+        sweep_stride_blocks(data, [int(r) for r in args.rows.split(",")])
+    elif args.kind == "detailed":
         for shift in (int(s) for s in args.batches.split(",")):
             el = time_detailed(data, 1 << shift, args.slice)
             print(

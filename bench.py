@@ -17,6 +17,12 @@ occasionally unavailable) re-execs this process after a backoff so jax's
 cached backend state is reset; after the final attempt a JSON line with an
 "error" key is printed — never a bare traceback.
 
+Variance note: modes finishing under ~0.3 s (msd-ineffective, msd-effective,
+niceonly extra-large) are bounded by ONE device->host readback round-trip,
+whose latency through the axon tunnel swings 30-110 ms hour to hour — their
+lines jitter 2-3x run to run with no code change. Only modes >= ~2 s
+(hi-base, massive, the detailed headline) are stable benchmarks of compute.
+
 Env knobs:
   NICE_BENCH_MODE    run only this mode (e.g. "extra-large")
   NICE_BENCH_SUITE   comma-separated mode:kind list overriding the default

@@ -1,45 +1,72 @@
-"""Benchmark runner: one JSON line per suite mode; headline line LAST.
+"""Benchmark runner: one JSON line per suite mode; headline line FIRST and LAST.
 
 Runs the reference's benchmark suite (BASELINE.md / ref common/src/benchmark.rs
 :40-76) end-to-end through the engine on the available accelerator and reports
-numbers/sec/chip per mode. The final stdout line is the headline metric
-(detailed extra-large — 1e9 @ base 40, one production server field) with the
-whole suite embedded under "suite", so a driver that records only the last
-JSON line still captures everything.
+numbers/sec/chip per mode.
+
+The record is designed to be UN-LOSABLE under a driver wall-clock kill:
+
+- The headline mode (detailed extra-large — 1e9 @ base 40, one production
+  server field) runs FIRST and its line is printed immediately as a
+  provisional record, so even a kill one second later leaves a headline on
+  stdout. It is printed again as the FINAL line with the whole suite embedded
+  under "suite" (a driver that records only the last JSON line captures
+  everything; a driver that kills mid-suite still has the provisional line).
+- The whole process tree (init attempts included) runs under a wall budget
+  (NICE_BENCH_BUDGET, default 480 s) measured from NICE_BENCH_T0 — set once
+  and carried across init re-execs via the environment (CLOCK_MONOTONIC is
+  boot-relative, so the value stays comparable across execve). A mode whose
+  conservative cost estimate exceeds the remaining budget is skipped with an
+  explicit {"skipped": "budget"} line instead of the process dying mid-mode.
+- Every mode additionally runs under a hard wall cap in a worker thread; a
+  mode that exceeds its cap is recorded as an error line, the (possibly
+  wedged) device is not handed the remaining modes ({"skipped":
+  "timeout-wedge"}), and the final headline line is still printed.
+- TPU init is guarded with SHORT, budget-aware attempt timeouts (60/90/120 s,
+  clamped to the remaining budget): a transient backend failure (the axon
+  tunnel is occasionally unavailable) re-execs this process so jax's cached
+  backend state is reset; after the final attempt a JSON line with an
+  "error" key is printed — never a bare traceback, and never a silent
+  budget-consuming hang.
 
 vs_baseline for detailed modes compares against the north-star per-chip target
 of 1.25e8 numbers/sec/chip (BASELINE.json: 1e9 field in <1 s on a v5e-8, >50x
 the reference CUDA client). Niceonly modes compare against 20x that, the
 reference's measured niceonly-vs-detailed speedup (ref common/src/lib.rs:49-50).
 
-TPU init is guarded: a transient backend failure (the axon tunnel is
-occasionally unavailable) re-execs this process after a backoff so jax's
-cached backend state is reset; after the final attempt a JSON line with an
-"error" key is printed — never a bare traceback.
+Per-field engine phase traces (floor, stride depth, descriptor count, per-stage
+busy seconds — engine.py's niceonly trace) are emitted at INFO on stderr during
+the run, so the driver artifact's tail carries the phase split of every mode.
 
-Variance note: modes finishing under ~0.3 s (msd-ineffective, msd-effective,
-niceonly extra-large) are bounded by ONE device->host readback round-trip,
-whose latency through the axon tunnel swings 30-110 ms hour to hour — their
-lines jitter 2-3x run to run with no code change. Only modes >= ~2 s
-(hi-base, massive, the detailed headline) are stable benchmarks of compute.
+Variance note: modes finishing under ~0.3 s (msd-effective, and
+msd-ineffective before the round-5 host fast path) are bounded by ONE
+device->host readback round-trip, whose latency through the axon tunnel swings
+30-110 ms hour to hour — their lines jitter 2-3x run to run with no code
+change. Only modes >= ~2 s are stable benchmarks of compute.
 
 Env knobs:
   NICE_BENCH_MODE    run only this mode (e.g. "extra-large")
   NICE_BENCH_SUITE   comma-separated mode:kind list overriding the default
                      suite (kind = detailed|niceonly)
   NICE_BENCH_BATCH   lanes per dispatch (default: per-mode table below)
+  NICE_BENCH_BUDGET  wall budget in seconds for the whole run (default 480)
+  NICE_BENCH_INIT_TIMEOUT  cap on EACH backend-init attempt (default 60/90/120
+                     by attempt, always clamped to the remaining budget)
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
+import threading
 import time
 
 NORTH_STAR_DETAILED = 1.25e8  # numbers/sec/chip, BASELINE.json north star
 NICEONLY_SPEEDUP = 20.0  # ref common/src/lib.rs:49-50, README.md:70
 MAX_INIT_ATTEMPTS = 3
+DEFAULT_BUDGET = 480.0
 
 # (mode, kind): batch lanes on TPU. Large bases carry more u32 limbs per lane,
 # so their per-batch VMEM/HBM footprint is bigger and the batch shrinks.
@@ -61,19 +88,43 @@ _TPU_BATCH = {
     ("massive", "niceonly"): 1 << 22,
 }
 
-# Default suite: fast modes first, the headline (detailed extra-large) last so
-# it is the final stdout line. The filter cascade makes even the huge niceonly
-# modes cheap: msd-effective (1e12 @ b50) is FULLY killed by the host MSD
-# prefix filter at its range start (0 surviving candidates, ~ms), and massive
-# (1e13 @ b50) survives at ~11% into ~5e5 stride descriptors (measured; ~1.4 s
-# host filter at floor 2^20 on one core).
+# Conservative per-mode wall-cost estimates (first-run Mosaic/XLA compile
+# INCLUDED — each distinct kernel shape costs ~20-40 s to compile in a fresh
+# process). Used only for the skip-vs-run budget decision; the hard per-mode
+# cap is separate (below). Measured landmarks: r3 driver artifact + round-4/5
+# builder runs.
+_EST_SECS = {
+    ("extra-large", "detailed"): 75.0,
+    ("msd-effective", "niceonly"): 45.0,
+    ("msd-ineffective", "niceonly"): 20.0,
+    ("extra-large", "niceonly"): 45.0,
+    ("hi-base", "detailed"): 60.0,
+    ("massive", "niceonly"): 230.0,
+}
+_EST_DEFAULT = 60.0
+
+# Hard per-mode wall caps (worker-thread join timeout). A mode that blows its
+# cap has almost certainly wedged on the device tunnel; the run is recorded
+# as an error and the remaining non-headline modes are skipped.
+_CAP_SECS = {
+    ("massive", "niceonly"): 330.0,
+}
+_CAP_DEFAULT = 150.0
+
+# Default suite: the HEADLINE (detailed extra-large) first so its provisional
+# line exists from the first seconds of the run; cheap modes next; massive
+# (the only multi-minute mode) last so a budget overrun can only ever cost
+# massive itself. The filter cascade makes even the huge niceonly modes
+# cheap: msd-effective (1e12 @ b50) is FULLY killed by the host MSD prefix
+# filter at its range start (0 surviving candidates, ~ms), and massive
+# (1e13 @ b50) survives at ~11% into ~4e5 stride descriptors.
 DEFAULT_SUITE = (
-    ("msd-ineffective", "niceonly"),
-    ("msd-effective", "niceonly"),
-    ("hi-base", "detailed"),
-    ("extra-large", "niceonly"),
-    ("massive", "niceonly"),
     ("extra-large", "detailed"),
+    ("msd-effective", "niceonly"),
+    ("msd-ineffective", "niceonly"),
+    ("extra-large", "niceonly"),
+    ("hi-base", "detailed"),
+    ("massive", "niceonly"),
 )
 HEADLINE = ("extra-large", "detailed")
 
@@ -85,8 +136,36 @@ _MODE_KIND = {
     "msd-ineffective": "niceonly",
 }
 
+# Shrinking-attempt init timeouts (VERDICT r4 weak #5: two judge-side runs
+# spent their whole allocation inside 180 s init watchdogs). First attempt is
+# short — a healthy tunnel initializes in ~15-40 s; a slow-but-alive chip gets
+# progressively longer later attempts, and every attempt is clamped to the
+# remaining budget so init can never eat the suite.
+_INIT_TIMEOUTS = (60.0, 90.0, 120.0)
 
-def _init_jax():
+
+def _budget_clock():
+    """(remaining_fn, budget): wall budget accounting shared across re-execs."""
+    t0 = os.environ.get("NICE_BENCH_T0")
+    if t0 is None:
+        t0 = repr(time.monotonic())
+        os.environ["NICE_BENCH_T0"] = t0
+    t0 = float(t0)
+    budget = float(os.environ.get("NICE_BENCH_BUDGET", DEFAULT_BUDGET))
+    return (lambda: budget - (time.monotonic() - t0)), budget
+
+
+def _error_line(metric: str, error: str) -> dict:
+    return {
+        "metric": metric,
+        "value": 0,
+        "unit": "numbers/sec/chip",
+        "vs_baseline": 0,
+        "error": error,
+    }
+
+
+def _init_jax(remaining):
     """Import jax and force backend init, re-exec'ing on transient failure.
 
     Two failure shapes are handled (both observed on the axon tunnel):
@@ -105,28 +184,29 @@ def _init_jax():
     from nice_tpu.utils.platform import probe_backend
 
     attempt = int(os.environ.get("NICE_BENCH_ATTEMPT", "1"))
+    default_timeout = _INIT_TIMEOUTS[
+        min(attempt - 1, len(_INIT_TIMEOUTS) - 1)
+    ]
+    timeout = float(os.environ.get("NICE_BENCH_INIT_TIMEOUT", default_timeout))
+    # Leave enough budget after init for at least the headline mode.
+    timeout = max(15.0, min(timeout, remaining() - 90.0))
     n_chips, exc = probe_backend(
-        timeout_s=float(os.environ.get("NICE_BENCH_INIT_TIMEOUT", "180")),
+        timeout_s=timeout,
         platform=os.environ.get("NICE_BENCH_PLATFORM"),
     )
 
     if exc is not None:
-        if attempt < MAX_INIT_ATTEMPTS:
-            time.sleep(10 * attempt)
+        if attempt < MAX_INIT_ATTEMPTS and remaining() > 120.0:
+            time.sleep(5 * attempt)
             env = dict(os.environ, NICE_BENCH_ATTEMPT=str(attempt + 1))
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
         print(
             json.dumps(
-                {
-                    "metric": "numbers/sec/chip (benchmark suite)",
-                    "value": 0,
-                    "unit": "numbers/sec/chip",
-                    "vs_baseline": 0,
-                    "error": (
-                        f"jax backend init failed after {attempt} attempts: "
-                        f"{exc!r}"
-                    ),
-                },
+                _error_line(
+                    "numbers/sec/chip (benchmark suite)",
+                    f"jax backend init failed after {attempt} attempts "
+                    f"(last timeout {timeout:.0f}s): {exc!r}",
+                )
             ),
             flush=True,
         )
@@ -165,7 +245,7 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     import jax
 
     if kind == "niceonly" and jax.default_backend() == "tpu":
-        engine.warm_niceonly(data.base, data.range_size)
+        engine.warm_niceonly(data.base, data.range_size, data.range_start)
     else:
         # Detailed modes probe a 1-number field; off-TPU niceonly takes the
         # dense jnp path (which warm_niceonly does not compile), so the
@@ -196,6 +276,38 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     }
 
 
+def _run_mode_capped(
+    mode: str, kind: str, batch_size: int, n_chips: int, cap: float
+) -> tuple[dict, bool]:
+    """Run one mode under a hard wall cap in a worker thread.
+
+    Returns (line, wedged): wedged=True means the worker blew the cap and is
+    still running (almost certainly blocked on the device tunnel) — the
+    device must not be handed further work this process."""
+    box: dict = {}
+
+    def work():
+        try:
+            box["line"] = _run_mode(mode, kind, batch_size, n_chips)
+        except Exception as exc:  # noqa: BLE001 — reported as a JSON line
+            box["exc"] = exc
+
+    t = threading.Thread(target=work, name=f"bench-{mode}", daemon=True)
+    t.start()
+    t.join(cap)
+    metric = f"numbers/sec/chip {kind} ({mode})"
+    if t.is_alive():
+        return (
+            _error_line(
+                metric, f"mode exceeded its {cap:.0f}s wall cap (wedged?)"
+            ),
+            True,
+        )
+    if "exc" in box:
+        return _error_line(metric, repr(box["exc"])), False
+    return box["line"], False
+
+
 def _parse_suite(raw: str) -> tuple:
     suite = []
     for entry in raw.split(","):
@@ -210,7 +322,15 @@ def _parse_suite(raw: str) -> tuple:
 
 
 def main() -> int:
-    jax, n_chips = _init_jax()
+    remaining, budget = _budget_clock()
+    # Engine per-field phase traces (floor, stride depth, descriptors,
+    # per-stage busy seconds) go to stderr so the driver tail records them.
+    logging.basicConfig(
+        level=logging.INFO,
+        stream=sys.stderr,
+        format="INFO:%(name)s: %(message)s",
+    )
+    jax, n_chips = _init_jax(remaining)
 
     try:
         if os.environ.get("NICE_BENCH_SUITE"):
@@ -226,13 +346,7 @@ def main() -> int:
         # Still a JSON line, never a bare traceback (driver contract).
         print(
             json.dumps(
-                {
-                    "metric": "numbers/sec/chip (benchmark suite)",
-                    "value": 0,
-                    "unit": "numbers/sec/chip",
-                    "vs_baseline": 0,
-                    "error": str(exc),
-                }
+                _error_line("numbers/sec/chip (benchmark suite)", str(exc))
             ),
             flush=True,
         )
@@ -245,24 +359,36 @@ def main() -> int:
         suite = tuple((m, k) for (m, k) in suite if m != "massive") or suite
     results: dict[tuple, dict] = {}
     headline = None
+    wedged = False
     for mode, kind in suite:
-        default_batch = _TPU_BATCH.get((mode, kind), 1 << 22) if on_tpu else 1 << 20
-        batch = int(os.environ.get("NICE_BENCH_BATCH", default_batch))
-        try:
-            line = _run_mode(mode, kind, batch, n_chips)
-        except Exception as exc:  # noqa: BLE001 — report and keep benching
-            line = {
-                "metric": f"numbers/sec/chip {kind} ({mode})",
-                "value": 0,
-                "unit": "numbers/sec/chip",
-                "vs_baseline": 0,
-                "error": repr(exc),
-            }
-        results[(mode, kind)] = line
-        if (mode, kind) == HEADLINE:
-            headline = line  # print last
+        metric = f"numbers/sec/chip {kind} ({mode})"
+        if wedged:
+            line = dict(_error_line(metric, ""), skipped="timeout-wedge")
+            del line["error"]
+        elif (
+            (mode, kind) != HEADLINE
+            and _EST_SECS.get((mode, kind), _EST_DEFAULT) > remaining()
+        ):
+            line = dict(_error_line(metric, ""), skipped="budget")
+            del line["error"]
+            line["budget_remaining_secs"] = round(remaining(), 1)
         else:
-            print(json.dumps(line), flush=True)
+            default_batch = (
+                _TPU_BATCH.get((mode, kind), 1 << 22) if on_tpu else 1 << 20
+            )
+            batch = int(os.environ.get("NICE_BENCH_BATCH", default_batch))
+            cap = _CAP_SECS.get((mode, kind), _CAP_DEFAULT)
+            if (mode, kind) == HEADLINE:
+                # The headline always gets a chance to run, but never more
+                # wall than would erase the final print.
+                cap = max(30.0, min(cap, remaining() - 10.0))
+            else:
+                cap = max(10.0, min(cap, remaining() - 15.0))
+            line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
+        results[(mode, kind)] = line
+        print(json.dumps(line), flush=True)  # every mode flushes immediately
+        if (mode, kind) == HEADLINE:
+            headline = line  # provisional record; re-printed last with suite
 
     if headline is None:
         # Single-mode run: re-print that mode's line last as the headline.
@@ -272,10 +398,14 @@ def main() -> int:
         f"{kind}/{mode}": {
             k: v
             for k, v in r.items()
-            if k in ("value", "vs_baseline", "elapsed_secs", "error", "hits")
+            if k
+            in ("value", "vs_baseline", "elapsed_secs", "error", "hits",
+                "skipped")
         }
         for (mode, kind), r in results.items()
     }
+    headline["budget_secs"] = budget
+    headline["budget_used_secs"] = round(budget - remaining(), 1)
     print(json.dumps(headline), flush=True)
     return 1 if any("error" in r for r in results.values()) else 0
 

@@ -47,8 +47,19 @@ class StrideTable:
             gaps[:-1] = valid[1:] - valid[:-1]
             gaps[-1] = self.modulus - valid[-1] + valid[0]
             self.gap_table: list[int] = gaps.tolist()
+            # ndarray twins for the native engine: zero-copy pointer passing
+            # (a per-call ctypes rebuild of a depth-3 table once dominated the
+            # whole native niceonly path) and the u32 residue array keys the
+            # polynomial-residue fast kernel (modulus < 2^32 always holds —
+            # deeper tables are rejected by the depth planner's u32 guard).
+            self.gap_array = gaps.astype(np.uint64)
+            self.gap_array.setflags(write=False)
+            self.residues_u32 = valid.astype(np.uint32)
+            self.residues_u32.setflags(write=False)
         else:
             self.gap_table = []
+            self.gap_array = np.empty(0, dtype=np.uint64)
+            self.residues_u32 = np.empty(0, dtype=np.uint32)
 
     @property
     def num_residues(self) -> int:

@@ -13,6 +13,7 @@ import argparse
 import json
 import logging
 import random
+import sqlite3
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -101,6 +102,20 @@ class Metrics:
                 lines.append(
                     f'nice_api_request_seconds_sum{{endpoint="{endpoint}"}}'
                     f" {self._time_sums.get(endpoint, 0.0):.6f}"
+                )
+            # Back-compat: the round-3 metric name, kept for one release so
+            # scrape rules keyed on it keep working (advisor r4; the rename
+            # is also called out in CHANGELOG.md). Same value as
+            # nice_api_request_seconds_sum.
+            lines.append(
+                "# HELP nice_api_request_seconds_total DEPRECATED alias of "
+                "nice_api_request_seconds_sum; remove after one release."
+            )
+            lines.append("# TYPE nice_api_request_seconds_total counter")
+            for endpoint, total in sorted(self._time_sums.items()):
+                lines.append(
+                    f'nice_api_request_seconds_total{{endpoint="{endpoint}"}}'
+                    f" {total:.6f}"
                 )
         return "\n".join(lines) + "\n"
 
@@ -405,6 +420,35 @@ def make_handler(ctx: ApiContext):
                     self._send(
                         200, ctx.db.get_search_rate(qs.get("mode", [None])[0])
                     )
+                elif method in ("GET", "POST") and path == "/query":
+                    # Public read-only ad-hoc SQL, the PostgREST-equivalent
+                    # surface (reference schema/schema.sql:82-87 grants a
+                    # web_anon role SELECT over the whole schema). GET takes
+                    # ?sql=...; POST takes {"sql": ..., "params": [...]}.
+                    # Hard-sandboxed in Db.public_query (read-only conn,
+                    # authorizer, row/step caps).
+                    if method == "GET":
+                        qs = parse_qs(urlparse(self.path).query)
+                        sql = qs.get("sql", [None])[0]
+                        qparams: list = []
+                    else:
+                        length = int(self.headers.get("Content-Length", 0))
+                        try:
+                            payload = json.loads(self.rfile.read(length))
+                        except json.JSONDecodeError as e:
+                            raise ApiError(400, f"Invalid JSON body: {e}")
+                        sql = payload.get("sql")
+                        qparams = payload.get("params", [])
+                        if not isinstance(qparams, list):
+                            raise ApiError(400, "params must be a list")
+                    if not sql or not isinstance(sql, str):
+                        raise ApiError(400, "missing sql")
+                    try:
+                        self._send(
+                            200, ctx.db.public_query(sql, tuple(qparams))
+                        )
+                    except sqlite3.Error as e:
+                        raise ApiError(400, f"query rejected: {e}")
                 elif method == "GET" and self._try_static(path):
                     pass  # served from web/
                 elif method == "POST" and path == "/submit":
@@ -452,8 +496,15 @@ def make_handler(ctx: ApiContext):
                     ),
                     "web",
                 ),
-                os.path.join(os.getcwd(), "web"),
             ]
+            # A cwd-relative web/ is served ONLY when the operator opts in
+            # via NICE_WEB_ROOT (advisor r4: an implicit cwd fallback would
+            # publish whatever ./web happens to exist in the launch
+            # directory, with CORS *). NICE_WEB_ROOT also allows pointing at
+            # any custom static tree.
+            explicit = os.environ.get("NICE_WEB_ROOT")
+            if explicit:
+                candidates.insert(0, explicit)
             web_root = next((c for c in candidates if os.path.isdir(c)), None)
             if web_root is None:
                 if not getattr(make_handler, "_warned_no_web", False):

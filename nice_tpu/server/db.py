@@ -413,18 +413,24 @@ class Db:
         in ONE SQL statement (reference db_util/fields.rs:349-380).
 
         The counts are zero-padded decimal TEXT (u128-capable); CAST(... AS
-        REAL) is approximate above 2^53 (hi-base chunks reach ~1e28), so a
-        chunk within ~1 ulp of the cutoff ratio can classify either way —
-        exactly the tolerance the previous Python float division had, and
-        harmless for a 20% exploration threshold. The win is running the
-        predicate in SQL instead of a Python scan over every chunk row with
-        a second query per candidate (fine at one seeded base, degrading at
-        the reference's ~9000-chunk scale)."""
+        REAL) is approximate above 2^53 (hi-base chunks reach ~1e28), so the
+        SQL predicate runs with a 1-ulp-widened cutoff as a PREFILTER and
+        the returned candidates are re-checked exactly in Python with
+        integer arithmetic (advisor r4: a pure-REAL predicate could
+        permanently misclassify a chunk sitting within a float ulp of the
+        20% boundary). The win over a full Python scan remains: SQL rejects
+        all clearly-checked chunks; Python only sees boundary candidates,
+        virtually always exactly one row."""
+        from fractions import Fraction
+
+        cutoff = Fraction(str(DOWNSAMPLE_CUTOFF_PERCENT))
         col = "checked_niceonly" if maximum_check_level == 0 else "checked_detailed"
         with self._read_conn() as conn:
-            row = conn.execute(
+            rows = conn.execute(
                 f"""
                 SELECT c.id AS chunk_id,
+                       c.range_size AS range_size,
+                       c.{col} AS checked,
                        (SELECT MIN(id) FROM fields WHERE chunk_id = c.id) AS lo,
                        (SELECT MAX(id) FROM fields WHERE chunk_id = c.id) AS hi
                 FROM chunks c
@@ -433,13 +439,16 @@ class Db:
                       < ? * CAST(c.range_size AS REAL)
                   AND EXISTS (SELECT 1 FROM fields WHERE chunk_id = c.id)
                 ORDER BY c.id ASC
-                LIMIT 1
                 """,
-                (DOWNSAMPLE_CUTOFF_PERCENT,),
-            ).fetchone()
-        if row is None:
-            return None, None, None
-        return row["chunk_id"], row["lo"], row["hi"]
+                (DOWNSAMPLE_CUTOFF_PERCENT * (1.0 + 1e-9),),
+            )
+            for row in rows:
+                size = int(row["range_size"])
+                if size > 0 and int(row["checked"]) * cutoff.denominator < (
+                    cutoff.numerator * size
+                ):
+                    return row["chunk_id"], row["lo"], row["hi"]
+        return None, None, None
 
     def bulk_claim_fields(
         self,
@@ -674,6 +683,78 @@ class Db:
             return conn.execute(
                 "SELECT * FROM chunks WHERE base_id = ? ORDER BY id ASC", (base,)
             ).fetchall()
+
+    # -- public ad-hoc query surface (the reference exposes its DB through
+    # PostgREST with a read-only web_anon role, schema/schema.sql:82-87 —
+    # third parties can run arbitrary SELECTs; this is the SQLite analog) ---
+
+    # Tables third parties may read. claims/submissions are included (the
+    # reference grants web_anon the whole public schema) but their user_ip
+    # column reads as NULL via the authorizer's SQLITE_IGNORE.
+    PUBLIC_QUERY_TABLES = frozenset(
+        {
+            "bases",
+            "chunks",
+            "fields",
+            "claims",
+            "submissions",
+            "cache_search_rate_daily",
+            "cache_search_leaderboard",
+            "sqlite_master",  # lets clients discover the schema, like
+            # PostgREST's OpenAPI root
+        }
+    )
+    PUBLIC_QUERY_MAX_ROWS = 1000
+    PUBLIC_QUERY_MAX_VM_STEPS = 50_000_000  # aborts runaway scans (~100 ms)
+
+    def public_query(self, sql: str, params: tuple = ()) -> dict:
+        """Run one read-only SELECT with third-party privileges.
+
+        Defense in depth, mirroring web_anon's capabilities: a fresh
+        read-only (mode=ro) connection with PRAGMA query_only, an authorizer
+        that allows SELECT over PUBLIC_QUERY_TABLES only (user_ip columns
+        read as NULL), a VM-step budget against runaway scans, and a row cap.
+        Raises sqlite3 errors for invalid/unauthorized SQL (mapped to 400 by
+        the API layer).
+        """
+        deny_cols = {"user_ip"}
+
+        def authorize(action, arg1, arg2, dbname, trigger):
+            if action == sqlite3.SQLITE_SELECT:
+                return sqlite3.SQLITE_OK
+            if action == sqlite3.SQLITE_READ:
+                if arg1 in self.PUBLIC_QUERY_TABLES:
+                    if arg2 in deny_cols:
+                        return sqlite3.SQLITE_IGNORE  # reads as NULL
+                    return sqlite3.SQLITE_OK
+                return sqlite3.SQLITE_DENY
+            if action == sqlite3.SQLITE_FUNCTION:
+                return sqlite3.SQLITE_OK  # query_only blocks side effects
+            return sqlite3.SQLITE_DENY
+
+        conn = sqlite3.connect(
+            f"file:{self.path}?mode=ro", uri=True, isolation_level=None
+        )
+        try:
+            conn.execute("PRAGMA query_only=1")
+            conn.execute("PRAGMA busy_timeout=2000")
+            # First callback fires after MAX_VM_STEPS instructions; returning
+            # nonzero aborts the statement with SQLITE_INTERRUPT.
+            conn.set_progress_handler(
+                lambda: 1, self.PUBLIC_QUERY_MAX_VM_STEPS
+            )
+            conn.set_authorizer(authorize)
+            cur = conn.execute(sql, params)
+            columns = [d[0] for d in cur.description] if cur.description else []
+            rows = cur.fetchmany(self.PUBLIC_QUERY_MAX_ROWS)
+            truncated = cur.fetchone() is not None
+            return {
+                "columns": columns,
+                "rows": [list(r) for r in rows],
+                "truncated": truncated,
+            }
+        finally:
+            conn.close()
 
     # -- analytics (dashboard REST surface; reference serves these via
     # PostgREST views over the same tables, web/index.html:203-276) ---------

@@ -22,3 +22,10 @@ force_virtual_cpu(os.environ, 8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Small niceonly fields route to the native host engine by default
+# (engine._host_route_niceonly) — which would silently divert every
+# backend="pallas" niceonly test off the device pipeline. Default the route
+# OFF here so the suite keeps exercising the (scarcer) device path; tests
+# that target the host route set this env explicitly.
+os.environ.setdefault("NICE_TPU_HOST_NICEONLY_MAX", "0")

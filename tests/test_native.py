@@ -119,3 +119,91 @@ def test_native_strided_iteration_wraparound():
     got = native.iterate_range_strided(first, idx, fs.end(), base, table.gap_table)
     want = [n.number for n in table.iterate_range(fs, base)]
     assert got == want
+
+
+@pytest.mark.parametrize("base", [10, 25, 40, 50, 64])
+def test_fast_strided_matches_generic(base):
+    """The magic-divide + polynomial-residue fast filters (round 5) against
+    the generic limb loop over identical ranges, both via the same entry
+    point (nice_native.cpp routes internally; the hook forces the slow path).
+    Spans several stride wraps so per-wrap constant recomputation is hit."""
+    if not native.available():
+        pytest.skip("no native toolchain")
+    br = base_range.get_base_range(base)
+    if br is None:
+        pytest.skip("no base range")
+    for k in (1, 3):
+        table = stride_filter.get_stride_table(base, k)
+        if table.num_residues == 0:
+            continue
+        start = br[0] + 17
+        end = min(br[1], start + 3 * table.modulus + 50_000)
+        first, idx = table.first_valid_at_or_after(start)
+        if first >= end:
+            continue
+        args = (first, idx, end, base, table.gap_array)
+        kwargs = dict(modulus=table.modulus, residues=table.residues_u32)
+        prev = native.strided_fast_enabled(True)
+        try:
+            fast = native.iterate_range_strided(*args, **kwargs)
+            native.strided_fast_enabled(False)
+            slow = native.iterate_range_strided(*args, **kwargs)
+        finally:
+            native.strided_fast_enabled(prev)
+        assert fast == slow, (base, k)
+
+
+def test_fast_strided_finds_nice_numbers():
+    """b10 golden: 69 is nice; the fast path must report it (guards against a
+    fast filter that silently rejects everything)."""
+    if not native.available():
+        pytest.skip("no native toolchain")
+    base = 10
+    table = stride_filter.get_stride_table(base, 1)
+    br = base_range.get_base_range(base)
+    first, idx = table.first_valid_at_or_after(br[0])
+    got = native.iterate_range_strided(
+        first, idx, br[1], base, table.gap_array,
+        modulus=table.modulus, residues=table.residues_u32,
+    )
+    assert 69 in got
+
+
+def test_host_route_niceonly_small_field(monkeypatch):
+    """Small niceonly fields route to the native host engine on the device
+    path and return identical results to the scalar oracle. (conftest turns
+    the route off suite-wide so device tests keep their coverage; this test
+    opts back in.)"""
+    monkeypatch.setenv("NICE_TPU_HOST_NICEONLY_MAX", str(1 << 25))
+    base = 40
+    br = base_range.get_base_range_field(base)
+    fs = FieldSize(br.start(), min(br.end(), br.start() + 200_000))
+    assert engine._host_route_niceonly(fs, base) == native.available()
+    if not native.available():
+        pytest.skip("no native toolchain")
+    got = engine._native_niceonly(
+        fs, base, None, 1, msd_floor=max(1 << 20, fs.size() // 8)
+    )
+    want = scalar.process_range_niceonly(fs, base)
+    assert sorted(n.number for n in got.nice_numbers) == sorted(
+        n.number for n in want.nice_numbers
+    )
+
+
+def test_host_route_integration_never_touches_device(monkeypatch):
+    """With the route enabled, a small backend="pallas" niceonly field must
+    resolve entirely on the host: poison the device kernel and expect exact
+    results anyway."""
+    if not native.available():
+        pytest.skip("no native toolchain")
+    from nice_tpu.ops import pallas_engine as pe
+
+    monkeypatch.setenv("NICE_TPU_HOST_NICEONLY_MAX", str(1 << 25))
+
+    def boom(*a, **k):
+        raise AssertionError("device kernel dispatched for a host-routed field")
+
+    monkeypatch.setattr(pe, "niceonly_strided_batch", boom)
+    br = base_range.get_base_range_field(10)
+    got = engine.process_range_niceonly(br, 10, backend="pallas", batch_size=128)
+    assert [n.number for n in got.nice_numbers] == [69]

@@ -245,3 +245,51 @@ def test_detailed_collector_propagates_failure(monkeypatch):
     br = base_range.get_base_range_field(10)  # contains 69 -> rare path fires
     with pytest.raises(RuntimeError, match="rare path exploded"):
         engine.process_range_detailed(br, 10, backend="pallas", batch_size=BL)
+
+
+def test_producer_fans_msd_filter_across_threads(monkeypatch):
+    """The niceonly producer must run MSD filter calls CONCURRENTLY (the
+    reference fans its filter across N CPU threads feeding the GPU,
+    client_process_gpu.rs:624-660): with NICE_THREADS=4 and a filter stub
+    that blocks until two calls are in flight, the field only completes if
+    real fan-out happens — and chunk results must still come out in order."""
+    import threading as th
+
+    from nice_tpu.ops import msd_filter
+
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    monkeypatch.setenv("NICE_THREADS", "4")
+    # A b20 field big enough for >= 4 producer chunks at the pinned floor.
+    monkeypatch.setenv("NICE_TPU_MSD_FLOOR", "256")
+    from nice_tpu.ops import adaptive_floor
+
+    adaptive_floor.reset_for_tests()
+
+    real = msd_filter.get_valid_ranges
+    barrier = th.Barrier(2)
+    overlapped = th.Event()
+    seen_starts = []
+    lock = th.Lock()
+
+    def instrumented(range_, base, **kw):
+        if not overlapped.is_set():
+            try:
+                barrier.wait(timeout=10)
+                overlapped.set()
+            except th.BrokenBarrierError:
+                pass  # < 2 concurrent calls: overlapped stays unset
+        with lock:
+            seen_starts.append(range_.start())
+        return real(range_, base, **kw)
+
+    monkeypatch.setattr(msd_filter, "get_valid_ranges", instrumented)
+    base = 40  # range is ~6.5e12 wide: the 600k slice spans ~9 producer chunks
+    br = base_range.get_base_range_field(base)
+    fs = FieldSize(br.start(), min(br.end(), br.start() + 600_000))
+    got = engine.process_range_niceonly(fs, base, backend="pallas", batch_size=BL)
+    want = scalar.process_range_niceonly(fs, base)
+    assert sorted(n.number for n in got.nice_numbers) == sorted(
+        n.number for n in want.nice_numbers
+    )
+    assert overlapped.is_set(), "filter calls never overlapped"
+    assert len(seen_starts) >= 4

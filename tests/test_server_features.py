@@ -309,3 +309,47 @@ def test_two_process_concurrent_claims(tmp_path):
     a, b = results
     assert a and b, (a, b)
     assert not (set(a) & set(b)), f"double-claimed fields: {set(a) & set(b)}"
+
+
+def test_public_query_surface(server):
+    """/query: the PostgREST-equivalent read-only SQL surface (reference
+    schema/schema.sql:82-87 web_anon role). Allowed SELECTs work with
+    parameters; writes, non-public tables, and user_ip reads are sandboxed."""
+    base_url, db_path = server
+    from urllib.parse import quote
+
+    # GET with ad-hoc SQL over a public table
+    r = _get(base_url + "/query?sql=" + quote(
+        "SELECT id, range_size FROM bases ORDER BY id"))
+    assert r["columns"] == ["id", "range_size"]
+    assert [row[0] for row in r["rows"]] == [10]
+    assert r["truncated"] is False
+
+    # POST with bound params
+    r = _post(base_url + "/query", {
+        "sql": "SELECT COUNT(*) AS n FROM fields WHERE base_id = ?",
+        "params": [10],
+    })
+    assert r["columns"] == ["n"]
+    assert r["rows"][0][0] > 0
+
+    # schema discovery (PostgREST's OpenAPI-root analog)
+    r = _post(base_url + "/query", {
+        "sql": "SELECT name FROM sqlite_master WHERE type='table' ORDER BY name",
+    })
+    assert ["bases"] in r["rows"]
+
+    # writes are rejected (query_only + authorizer)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base_url + "/query", {"sql": "DELETE FROM bases"})
+    assert exc.value.code == 400
+
+    # user_ip is redacted to NULL, not exposed
+    _submit_one(base_url, "alice")
+    r = _post(base_url + "/query", {
+        "sql": "SELECT username, user_ip FROM submissions LIMIT 5"})
+    assert r["rows"], "expected at least one submission row"
+    assert all(row[1] is None for row in r["rows"])
+    r2 = _post(base_url + "/query", {
+        "sql": "SELECT COUNT(*) FROM submissions WHERE user_ip IS NOT NULL"})
+    assert r2["rows"][0][0] == 0

@@ -151,8 +151,28 @@ def _budget_clock():
         t0 = repr(time.monotonic())
         os.environ["NICE_BENCH_T0"] = t0
     t0 = float(t0)
+    _PHASE_T0[0] = t0  # phase-line timeline shares the budget clock origin
     budget = float(os.environ.get("NICE_BENCH_BUDGET", DEFAULT_BUDGET))
     return (lambda: budget - (time.monotonic() - t0)), budget
+
+
+# Phase-stamped JSON progress lines (stderr, flushed): a killed or wedged run
+# still leaves a parseable timeline saying which phase was in flight. The
+# timeline clock t is seconds since NICE_BENCH_T0, so lines from re-exec'd
+# init attempts stay on one monotonic axis (BENCH r4/r5 both captured zero
+# numbers AND zero evidence of where init died; these lines are the fix).
+_PHASE_T0 = [None]
+
+
+def _phase(phase: str, event: str, **fields) -> None:
+    t0 = _PHASE_T0[0]
+    rec = {
+        "bench_phase": phase,
+        "event": event,
+        "t": round(time.monotonic() - t0, 3) if t0 is not None else None,
+    }
+    rec.update(fields)
+    print(json.dumps(rec), file=sys.stderr, flush=True)
 
 
 def _error_line(metric: str, error: str) -> dict:
@@ -190,12 +210,17 @@ def _init_jax(remaining):
     timeout = float(os.environ.get("NICE_BENCH_INIT_TIMEOUT", default_timeout))
     # Leave enough budget after init for at least the headline mode.
     timeout = max(15.0, min(timeout, remaining() - 90.0))
+    _phase("backend-init", "begin", attempt=attempt, timeout_s=timeout)
     n_chips, exc = probe_backend(
         timeout_s=timeout,
         platform=os.environ.get("NICE_BENCH_PLATFORM"),
     )
 
     if exc is not None:
+        # probe_backend's TimeoutError message names the stalled init phase
+        # (import-jax / configure / devices) — carry it into the timeline so
+        # a wedged device lease is diagnosable from the phase lines alone.
+        _phase("backend-init", "error", attempt=attempt, error=repr(exc))
         if attempt < MAX_INIT_ATTEMPTS and remaining() > 120.0:
             time.sleep(5 * attempt)
             env = dict(os.environ, NICE_BENCH_ATTEMPT=str(attempt + 1))
@@ -212,6 +237,7 @@ def _init_jax(remaining):
         )
         os._exit(1)  # a hung init thread cannot be joined; exit hard
 
+    _phase("backend-init", "end", attempt=attempt, n_chips=n_chips)
     import jax
 
     return jax, n_chips
@@ -360,11 +386,14 @@ def main() -> int:
     results: dict[tuple, dict] = {}
     headline = None
     wedged = False
+    _phase("suite", "begin", modes=[f"{k}/{m}" for m, k in suite],
+           n_chips=n_chips, backend=jax.default_backend())
     for mode, kind in suite:
         metric = f"numbers/sec/chip {kind} ({mode})"
         if wedged:
             line = dict(_error_line(metric, ""), skipped="timeout-wedge")
             del line["error"]
+            _phase(f"mode.{kind}.{mode}", "skip", reason="timeout-wedge")
         elif (
             (mode, kind) != HEADLINE
             and _EST_SECS.get((mode, kind), _EST_DEFAULT) > remaining()
@@ -372,6 +401,8 @@ def main() -> int:
             line = dict(_error_line(metric, ""), skipped="budget")
             del line["error"]
             line["budget_remaining_secs"] = round(remaining(), 1)
+            _phase(f"mode.{kind}.{mode}", "skip", reason="budget",
+                   budget_remaining_secs=round(remaining(), 1))
         else:
             default_batch = (
                 _TPU_BATCH.get((mode, kind), 1 << 22) if on_tpu else 1 << 20
@@ -384,7 +415,18 @@ def main() -> int:
                 cap = max(30.0, min(cap, remaining() - 10.0))
             else:
                 cap = max(10.0, min(cap, remaining() - 15.0))
+            _phase(f"mode.{kind}.{mode}", "begin", batch=batch,
+                   cap_secs=cap)
             line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
+            _phase(
+                f"mode.{kind}.{mode}",
+                "error" if ("error" in line or wedged) else "end",
+                **{
+                    k: line[k]
+                    for k in ("value", "elapsed_secs", "error")
+                    if k in line
+                },
+            )
         results[(mode, kind)] = line
         print(json.dumps(line), flush=True)  # every mode flushes immediately
         if (mode, kind) == HEADLINE:
@@ -406,6 +448,7 @@ def main() -> int:
     }
     headline["budget_secs"] = budget
     headline["budget_used_secs"] = round(budget - remaining(), 1)
+    _phase("suite", "end", budget_used_secs=round(budget - remaining(), 1))
     print(json.dumps(headline), flush=True)
     return 1 if any("error" in r for r in results.values()) else 0
 

@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
 from nice_tpu.core.types import DataToClient, DataToServer, SearchMode, ValidationData
+from nice_tpu.obs.series import CLIENT_REQUEST_SECONDS, CLIENT_RETRIES
 
 log = logging.getLogger(__name__)
 
@@ -51,13 +52,25 @@ def retry_request(
     body: Optional[dict] = None,
     max_retries: int = DEFAULT_MAX_RETRIES,
     timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
+    endpoint: str = "other",
 ) -> Any:
-    """GET/POST with exponential backoff on 5xx and network errors."""
+    """GET/POST with exponential backoff on 5xx and network errors.
+
+    endpoint labels the per-attempt latency histogram and retry counter
+    (claim / submit / validate / other)."""
     attempt = 0
     while True:
+        t0 = time.monotonic()
         try:
-            return _request_json(url, body, timeout)
+            result = _request_json(url, body, timeout)
+            CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
+                time.monotonic() - t0
+            )
+            return result
         except urllib.error.HTTPError as e:
+            CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
+                time.monotonic() - t0
+            )
             if e.code < 500:
                 detail = ""
                 try:
@@ -67,9 +80,13 @@ def retry_request(
                 raise ApiError(f"HTTP {e.code} from {url}: {detail}") from e
             err: Exception = e
         except (urllib.error.URLError, TimeoutError, OSError) as e:
+            CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
+                time.monotonic() - t0
+            )
             err = e
         if attempt >= max_retries:
             raise ApiError(f"request to {url} failed after {attempt} retries: {err}")
+        CLIENT_RETRIES.labels(endpoint).inc()
         delay = min(2**attempt, MAX_BACKOFF_SECS)
         log.warning("request failed (%s); retry %d in %ds", err, attempt + 1, delay)
         time.sleep(delay)
@@ -82,14 +99,19 @@ def get_field_from_server(
     """GET /claim/{detailed|niceonly} (reference client_api_sync.rs:104-129)."""
     endpoint = "detailed" if mode == SearchMode.DETAILED else "niceonly"
     url = f"{api_base}/claim/{endpoint}?username={urllib.request.quote(username)}"
-    return DataToClient.from_json(retry_request(url, max_retries=max_retries))
+    return DataToClient.from_json(
+        retry_request(url, max_retries=max_retries, endpoint="claim")
+    )
 
 
 def submit_field_to_server(
     api_base: str, submit_data: DataToServer, max_retries: int = DEFAULT_MAX_RETRIES
 ) -> None:
     """POST /submit (reference client_api_sync.rs:144-172)."""
-    retry_request(f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries)
+    retry_request(
+        f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries,
+        endpoint="submit",
+    )
 
 
 def get_validation_data_from_server(
@@ -100,7 +122,9 @@ def get_validation_data_from_server(
     url = f"{api_base}/claim/validate?username={urllib.request.quote(username)}"
     if base is not None:
         url += f"&base={base}"
-    return ValidationData.from_json(retry_request(url, max_retries=max_retries))
+    return ValidationData.from_json(
+        retry_request(url, max_retries=max_retries, endpoint="validate")
+    )
 
 
 class AsyncApi:

@@ -23,8 +23,13 @@ import sys
 import time
 from typing import Optional
 
-from nice_tpu import CLIENT_VERSION
+from nice_tpu import CLIENT_VERSION, obs
 from nice_tpu.client import api_client
+from nice_tpu.obs.series import (
+    CLIENT_FIELD_SECONDS,
+    CLIENT_FIELDS,
+    CLIENT_NUMBERS,
+)
 from nice_tpu.core import number_stats
 from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
 from nice_tpu.core.types import (
@@ -171,18 +176,26 @@ def process_field(
     t0 = time.monotonic()
     rng = data.to_field_size()
     progress = _progress_logger(progress_secs)
-    if mode == SearchMode.DETAILED:
-        results = engine.process_range_detailed(
-            rng, data.base, backend=backend, batch_size=batch_size,
-            progress=progress,
-        )
-    else:
-        stride = get_stride_table(data.base, DEFAULT_LSD_K_VALUE)
-        results = engine.process_range_niceonly(
-            rng, data.base, stride_table=stride, backend=backend,
-            batch_size=batch_size, progress=progress,
-        )
+    mode_label = "detailed" if mode == SearchMode.DETAILED else "niceonly"
+    with obs.span(
+        "client.process_field", base=data.base, size=data.range_size,
+        mode=mode_label, backend=backend,
+    ), obs.profiler("process_field"):
+        if mode == SearchMode.DETAILED:
+            results = engine.process_range_detailed(
+                rng, data.base, backend=backend, batch_size=batch_size,
+                progress=progress,
+            )
+        else:
+            stride = get_stride_table(data.base, DEFAULT_LSD_K_VALUE)
+            results = engine.process_range_niceonly(
+                rng, data.base, stride_table=stride, backend=backend,
+                batch_size=batch_size, progress=progress,
+            )
     elapsed = time.monotonic() - t0
+    CLIENT_FIELD_SECONDS.labels(mode_label).observe(elapsed)
+    CLIENT_FIELDS.labels(mode_label).inc()
+    CLIENT_NUMBERS.inc(data.range_size)
     rate = data.range_size / elapsed if elapsed > 0 else float("inf")
     log.info(
         "processed %s numbers in %.2fs (%s numbers/sec)",
@@ -311,6 +324,11 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
     """claim N+1 || process N || submit N-1 (reference client/src/main.rs:411-562)."""
     pending_submit = None
     next_claim = api.claim_async(mode)
+    stats_every = float(_env("STATS_SECS", 60))
+    t_start = time.monotonic()
+    last_stats = t_start
+    fields = 0
+    numbers = 0
     while True:
         data = next_claim.result()
         next_claim = api.claim_async(mode)  # overlap with processing
@@ -325,6 +343,17 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
             pending_submit.result()  # surface any submit error before queueing next
         submission = compile_results(data, results, mode, args.username)
         pending_submit = api.submit_async(submission)
+        fields += 1
+        numbers += data.range_size
+        now = time.monotonic()
+        if stats_every > 0 and now - last_stats >= stats_every:
+            last_stats = now
+            up = now - t_start
+            log.info(
+                "session stats: %d fields, %s numbers in %.0fs "
+                "(%s numbers/sec average)",
+                fields, f"{numbers:,}", up, f"{numbers / up:,.0f}",
+            )
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -334,6 +363,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(
         level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
     )
+    # Local /metrics endpoint (NICE_TPU_METRICS_PORT): exposes the client's
+    # field/latency series plus the engine pipeline registry.
+    obs.maybe_serve_metrics()
     if args.threads > 0:
         # The native backend sizes its pools from NICE_THREADS (engine
         # _native_threads); the flag is the CLI face of the same knob

@@ -17,6 +17,9 @@ import sys
 import time
 from typing import Optional
 
+from nice_tpu import obs
+from nice_tpu.obs.series import DAEMON_CPU, DAEMON_HEARTBEAT, DAEMON_RESTARTS
+
 log = logging.getLogger("nice_tpu.daemon")
 
 
@@ -64,6 +67,7 @@ class ProcessManager:
         cmd = [sys.executable, "-m", "nice_tpu.client", *self.client_args]
         log.info("starting client: %s", " ".join(cmd))
         self.proc = subprocess.Popen(cmd)
+        DAEMON_RESTARTS.inc()
 
     def stop(self) -> None:
         if not self.running():
@@ -115,6 +119,9 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
 
+    # Local /metrics (NICE_TPU_METRICS_PORT): heartbeat gauge + restart
+    # counter make a silently-dead supervisor loop externally detectable.
+    obs.maybe_serve_metrics()
     monitor = CpuMonitor(args.sample_interval)
     manager = ProcessManager(args.client_args or ["--repeat"])
     idle_since: Optional[float] = None
@@ -122,6 +129,8 @@ def main(argv=None) -> int:
     try:
         while True:
             usage = monitor.sample()
+            DAEMON_HEARTBEAT.set(time.time())
+            DAEMON_CPU.set(usage)
             manager.reap()
             if manager.running():
                 # While our client runs the CPU is busy by design; only stop it

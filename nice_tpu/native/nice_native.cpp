@@ -866,7 +866,7 @@ void iterate_strided_poly(u64 first, u64 start_idx, u64 end, const PolyCtx& p,
             // (carry selected by whether Y overflowed its two blocks).
             for (int j = 0; j < PL; ++j) {
                 okm[j] = ~(u64)0;
-                u64 X = lFR1[j] + lC[j];
+                u64 X = lC[j];
                 u64 t2 = magic_div(X, c.m_d3);
                 track(j, X - t2 * d3);
                 u64 X2 = lFR1[j] + t2;
@@ -886,7 +886,7 @@ void iterate_strided_poly(u64 first, u64 start_idx, u64 end, const PolyCtx& p,
         } else {
             for (int j = 0; j < PL; ++j) {
                 okm[j] = ~(u64)0;
-                u64 X = lFR1[j] + lC[j];
+                u64 X = lC[j];
                 u64 t2 = magic_div(X, c.m_d3);
                 track(j, X - t2 * d3);
                 u64 X2 = lFR1[j] + t2;
@@ -1080,6 +1080,10 @@ void nice_iterate_range_strided_poly(u64 first_lo, u64 first_hi, u64 start_idx,
     }
     u64 d3 = base * base * base;
     if (modulus % d3 != 0 || modulus >= ((u64)1 << 32)) return;
+    // Require n >= base^4.5 (first^2 >= d3^3 == base^9): below that, n^2 has
+    // fewer than three full base^3 blocks and the fixed block-1/2 decompose
+    // misclassifies digits. Small n fall back to the generic limb loop.
+    if ((u128)first_lo * first_lo < (u128)d3 * d3 * d3) return;
     // 2*(M/d3)*q*res < 2*(base-1)*base^(k-3)*...*n stays under 2^63 when
     // end * 2 * (M/d3) * (d3 margin) does; and F*Q1 ~ end^2 / d3^3 < 2^62.
     u64 mdiv = modulus / d3;

@@ -1,0 +1,51 @@
+"""nice_tpu.obs — zero-hard-dependency observability layer.
+
+Three pieces, all stdlib-only at import time:
+
+- ``metrics``: a process-wide Prometheus-text registry (counters, gauges,
+  histograms) shared by the HTTP server, the client's local /metrics port,
+  and the engine pipeline.
+- ``trace``: ``span(name)`` / ``trace_event`` structured JSON trace events
+  (begin flushed *before* the body runs, so hangs leave evidence), plus an
+  opt-in ``profiler`` wrapper around jax.profiler.
+- ``series``: the well-known series names, declared once so emitters and
+  scrapers can't drift apart.
+
+Env vars: NICE_TPU_METRICS_PORT (serve /metrics locally), NICE_TPU_TRACE
+(span sink: "stderr"/"1" or a file path), NICE_TPU_PROFILE (jax profiler
+output dir).
+"""
+
+from . import series  # noqa: F401 — importing pre-seeds the series
+from .metrics import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    render,
+)
+from .serve import maybe_serve_metrics, serve_metrics  # noqa: F401
+from .trace import profiler, span, trace_enabled, trace_event  # noqa: F401
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "render",
+    "series",
+    "serve_metrics",
+    "maybe_serve_metrics",
+    "span",
+    "trace_event",
+    "trace_enabled",
+    "profiler",
+]

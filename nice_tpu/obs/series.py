@@ -1,0 +1,129 @@
+"""Well-known series shared across layers.
+
+Declared centrally (and dependency-light) so the server — which must never
+import jax — can still render every engine pipeline series at zero, and so
+spelling stays consistent between the emitting module and the scrape-side
+smoke test. Importing this module pre-seeds the common label combinations.
+"""
+
+from __future__ import annotations
+
+from . import metrics
+
+# --- engine pipeline (ops/engine.py) ------------------------------------
+ENGINE_BATCH_KERNEL_SECONDS = metrics.histogram(
+    "nice_engine_batch_kernel_seconds",
+    "Device kernel wall time per collected batch, by pipeline path.",
+    labelnames=("path",),
+)
+ENGINE_DISPATCH_OCCUPANCY = metrics.gauge(
+    "nice_engine_dispatch_window_occupancy",
+    "In-flight batches in the detailed/dense dispatch window.",
+)
+ENGINE_STRIDE_OCCUPANCY = metrics.gauge(
+    "nice_engine_stride_window_occupancy",
+    "In-flight descriptor batches in the strided dispatch window.",
+)
+ENGINE_HOST_FALLBACK = metrics.counter(
+    "nice_engine_host_fallback_total",
+    "Work routed to the host engine instead of the device, by reason.",
+    labelnames=("reason",),
+)
+ENGINE_AUDITS = metrics.counter(
+    "nice_engine_audit_total",
+    "Device-vs-host audit re-checks performed on strided batches.",
+)
+ENGINE_DESCRIPTORS = metrics.counter(
+    "nice_engine_stride_descriptors_total",
+    "Stride descriptors dispatched to the device.",
+)
+ENGINE_NUMBERS = metrics.counter(
+    "nice_engine_numbers_total",
+    "Candidate numbers whose range processing completed, by mode.",
+    labelnames=("mode",),
+)
+
+# --- pallas + mesh dispatch ---------------------------------------------
+PALLAS_DISPATCH_SECONDS = metrics.histogram(
+    "nice_pallas_dispatch_seconds",
+    "Wall time of one pallas kernel dispatch call (async enqueue under jit;"
+    " synchronous execution in interpreter mode).",
+    labelnames=("kernel",),
+)
+MESH_DISPATCH_SECONDS = metrics.histogram(
+    "nice_mesh_dispatch_seconds",
+    "Wall time of one sharded mesh step dispatch.",
+    labelnames=("mode",),
+)
+MESH_DEVICES = metrics.gauge(
+    "nice_mesh_devices",
+    "Devices in the most recently constructed mesh.",
+)
+
+# --- backend init (utils/platform.py) -----------------------------------
+BACKEND_INIT_SECONDS = metrics.histogram(
+    "nice_backend_init_seconds",
+    "Wall time of each jax backend init phase.",
+    labelnames=("phase",),
+)
+
+# --- client (client/main.py, client/api_client.py) ----------------------
+CLIENT_REQUEST_SECONDS = metrics.histogram(
+    "nice_client_request_seconds",
+    "API round-trip latency per attempt, by endpoint.",
+    labelnames=("endpoint",),
+)
+CLIENT_RETRIES = metrics.counter(
+    "nice_client_retries_total",
+    "Failed API attempts that triggered a backoff retry, by endpoint.",
+    labelnames=("endpoint",),
+)
+CLIENT_FIELDS = metrics.counter(
+    "nice_client_fields_total",
+    "Fields fully processed by this client, by mode.",
+    labelnames=("mode",),
+)
+CLIENT_NUMBERS = metrics.counter(
+    "nice_client_numbers_total",
+    "Candidate numbers processed by this client.",
+)
+CLIENT_FIELD_SECONDS = metrics.histogram(
+    "nice_client_field_seconds",
+    "Wall time to process one claimed field, by mode.",
+    labelnames=("mode",),
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+             600.0, 1800.0),
+)
+
+# --- daemon (daemon/main.py) --------------------------------------------
+DAEMON_HEARTBEAT = metrics.gauge(
+    "nice_daemon_heartbeat_timestamp_seconds",
+    "Unix time of the daemon supervisor loop's last tick.",
+)
+DAEMON_RESTARTS = metrics.counter(
+    "nice_daemon_client_restarts_total",
+    "Client processes (re)started by the daemon.",
+)
+DAEMON_CPU = metrics.gauge(
+    "nice_daemon_cpu_usage_ratio",
+    "Most recent whole-machine CPU usage sample (0..1).",
+)
+
+# Pre-seed the label combinations every layer emits, so a scrape of a fresh
+# process (or of the jax-free server) still shows each series at zero.
+for _path in ("detailed", "dense", "strided"):
+    ENGINE_BATCH_KERNEL_SECONDS.labels(_path)
+for _reason in ("sliver", "host-route", "limbs"):
+    ENGINE_HOST_FALLBACK.labels(_reason)
+for _mode in ("detailed", "niceonly"):
+    ENGINE_NUMBERS.labels(_mode)
+    MESH_DISPATCH_SECONDS.labels(_mode)
+    CLIENT_FIELDS.labels(_mode)
+    CLIENT_FIELD_SECONDS.labels(_mode)
+for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques"):
+    PALLAS_DISPATCH_SECONDS.labels(_kernel)
+for _phase in ("import-jax", "configure", "devices"):
+    BACKEND_INIT_SECONDS.labels(_phase)
+for _endpoint in ("claim", "submit", "validate"):
+    CLIENT_REQUEST_SECONDS.labels(_endpoint)
+    CLIENT_RETRIES.labels(_endpoint)

@@ -1,0 +1,73 @@
+"""Local /metrics HTTP endpoint for processes that aren't the API server.
+
+The client and daemon run hot loops with no HTTP surface of their own; a
+tiny stdlib ThreadingHTTPServer on a localhost port makes their registry
+scrapeable. Opt-in via NICE_TPU_METRICS_PORT (port 0 picks a free one).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import metrics
+
+log = logging.getLogger("nice_tpu.obs")
+
+_started_lock = threading.Lock()
+_started: Optional[ThreadingHTTPServer] = None
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_error(404)
+            return
+        body = metrics.render().encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        log.debug("metrics server: " + fmt, *args)
+
+
+def serve_metrics(port: int, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Start a daemon-thread metrics server; returns the server (read the
+    bound port from ``server.server_address[1]`` when port=0)."""
+    server = ThreadingHTTPServer((host, port), _MetricsHandler)
+    t = threading.Thread(
+        target=server.serve_forever, name="nice-metrics", daemon=True
+    )
+    t.start()
+    return server
+
+
+def maybe_serve_metrics() -> Optional[ThreadingHTTPServer]:
+    """Start the local /metrics endpoint iff NICE_TPU_METRICS_PORT is set.
+    Idempotent per process; a busy port logs a warning instead of raising."""
+    global _started
+    raw = os.environ.get("NICE_TPU_METRICS_PORT", "")
+    if not raw:
+        return None
+    with _started_lock:
+        if _started is not None:
+            return _started
+        try:
+            port = int(raw)
+        except ValueError:
+            log.warning("NICE_TPU_METRICS_PORT=%r is not an integer", raw)
+            return None
+        try:
+            _started = serve_metrics(port)
+        except OSError as exc:
+            log.warning("cannot serve /metrics on port %d: %s", port, exc)
+            return None
+        log.info("serving /metrics on 127.0.0.1:%d",
+                 _started.server_address[1])
+        return _started

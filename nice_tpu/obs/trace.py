@@ -1,0 +1,174 @@
+"""Structured JSON trace spans — the "where did it wedge" layer.
+
+``span(name)`` emits a *begin* event immediately (flushed) and an *end* event
+with wall/process durations on exit. Because the begin line hits the sink
+before the body runs, a hang inside the span (the classic wedged axon device
+lease) still leaves a begin-without-end record naming the exact stalled
+phase; BENCH rounds 4/5 died with no such evidence.
+
+Sink selection via ``NICE_TPU_TRACE``:
+  unset / "" / "0"  -> disabled (spans still feed the duration histogram)
+  "1" or "stderr"   -> JSON lines on stderr
+  anything else     -> append to that file path
+
+The env var is re-read when its value changes, so tests can redirect the
+sink per-test with monkeypatch. ``profiler(name)`` additionally wraps a
+block in ``jax.profiler.trace`` when ``NICE_TPU_PROFILE`` points at an
+output directory — import-guarded so the module stays jax-free otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from . import metrics
+
+__all__ = ["span", "trace_event", "trace_enabled", "profiler"]
+
+SPAN_SECONDS = metrics.histogram(
+    "nice_trace_span_seconds",
+    "Wall-clock duration of named trace spans.",
+    labelnames=("span",),
+)
+
+_lock = threading.Lock()
+_sink_env: Optional[str] = None
+_sink: Optional[io.TextIOBase] = None
+_local = threading.local()
+
+
+def _get_sink() -> Optional[io.TextIOBase]:
+    global _sink_env, _sink
+    env = os.environ.get("NICE_TPU_TRACE", "")
+    with _lock:
+        if env == _sink_env:
+            return _sink
+        # Env changed: close a previously opened file sink (never stderr).
+        if _sink is not None and _sink is not sys.stderr:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        _sink_env = env
+        if env in ("", "0"):
+            _sink = None
+        elif env in ("1", "stderr"):
+            _sink = sys.stderr
+        else:
+            try:
+                _sink = open(env, "a", encoding="utf-8")
+            except OSError as exc:
+                print(f"nice_tpu.obs: cannot open trace sink {env!r}: {exc}",
+                      file=sys.stderr)
+                _sink = None
+        return _sink
+
+
+def trace_enabled() -> bool:
+    return _get_sink() is not None
+
+
+def _emit(record: dict) -> None:
+    sink = _get_sink()
+    if sink is None:
+        return
+    line = json.dumps(record, default=repr, separators=(",", ":"))
+    with _lock:
+        try:
+            sink.write(line + "\n")
+            sink.flush()  # hang evidence must hit the sink before the body
+        except (OSError, ValueError):
+            pass
+
+
+def trace_event(name: str, event: str = "instant", **fields) -> None:
+    """One flushed JSON line outside any span lifecycle."""
+    rec = {"ts": time.time(), "name": name, "event": event}
+    rec.update(fields)
+    _emit(rec)
+
+
+def _stack() -> list:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Context manager: begin event now, end event (with wall_secs and
+    process_secs) on exit. Nesting is tracked per-thread via parent/depth."""
+    st = _stack()
+    parent = st[-1] if st else None
+    depth = len(st)
+    enabled = trace_enabled()
+    if enabled:
+        rec = {
+            "ts": time.time(),
+            "name": name,
+            "event": "begin",
+            "depth": depth,
+        }
+        if parent:
+            rec["parent"] = parent
+        if attrs:
+            rec.update(attrs)
+        _emit(rec)
+    st.append(name)
+    t0 = time.perf_counter()
+    p0 = time.process_time()
+    status = "ok"
+    try:
+        yield
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        wall = time.perf_counter() - t0
+        st.pop()
+        SPAN_SECONDS.observe(wall, (name,))
+        if enabled:
+            rec = {
+                "ts": time.time(),
+                "name": name,
+                "event": "end",
+                "depth": depth,
+                "status": status,
+                "wall_secs": wall,
+                "process_secs": time.process_time() - p0,
+            }
+            if parent:
+                rec["parent"] = parent
+            _emit(rec)
+
+
+@contextlib.contextmanager
+def profiler(name: str):
+    """Opt-in jax.profiler capture: active only when NICE_TPU_PROFILE names
+    an output directory. Degrades to a no-op (with one warning) when jax or
+    its profiler is unavailable."""
+    out_dir = os.environ.get("NICE_TPU_PROFILE", "")
+    if not out_dir:
+        yield
+        return
+    try:
+        import jax.profiler as jprof
+    except Exception as exc:  # noqa: BLE001 — optional dependency
+        print(f"nice_tpu.obs: NICE_TPU_PROFILE set but jax.profiler"
+              f" unavailable ({exc}); skipping capture", file=sys.stderr)
+        yield
+        return
+    trace_event("profiler", "begin", span=name, dir=out_dir)
+    try:
+        with jprof.trace(out_dir):
+            yield
+    finally:
+        trace_event("profiler", "end", span=name, dir=out_dir)

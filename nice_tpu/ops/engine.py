@@ -22,6 +22,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from nice_tpu import obs
 from nice_tpu.core import base_range
 from nice_tpu.core.types import (
     FieldResults,
@@ -33,6 +34,15 @@ from nice_tpu.ops import pallas_engine as pe
 from nice_tpu.ops import scalar
 from nice_tpu.ops.limbs import get_plan, int_to_limbs, ints_to_limbs
 from nice_tpu.ops import vector_engine as ve
+from nice_tpu.obs.series import (
+    ENGINE_AUDITS,
+    ENGINE_BATCH_KERNEL_SECONDS,
+    ENGINE_DESCRIPTORS,
+    ENGINE_DISPATCH_OCCUPANCY,
+    ENGINE_HOST_FALLBACK,
+    ENGINE_NUMBERS,
+    ENGINE_STRIDE_OCCUPANCY,
+)
 
 log = logging.getLogger(__name__)
 
@@ -72,15 +82,22 @@ class _Collector:
     in C with the GIL released, so dispatch and collection genuinely overlap.
     On worker failure the queue is drained so producers' put() calls never
     block forever; shutdown() joins without raising (safe in a finally) and
-    raise_if_failed() re-raises the worker's exception on the caller."""
+    raise_if_failed() re-raises the worker's exception on the caller.
 
-    def __init__(self, fn, maxsize: int, name: str, on_fail=None):
+    occupancy: optional obs gauge tracking the in-flight window depth (queue
+    backlog + the item being processed) — the live measure of whether the
+    pipeline is dispatch-bound (gauge near 0) or collection-bound (gauge
+    pinned at maxsize)."""
+
+    def __init__(self, fn, maxsize: int, name: str, on_fail=None,
+                 occupancy=None):
         import queue as queue_mod
         import threading
 
         self._fn = fn
         self._err: list = [None]
         self._on_fail = on_fail
+        self._occupancy = occupancy
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=maxsize)
         self._t = threading.Thread(target=self._run, name=name, daemon=True)
         self._t.start()
@@ -92,6 +109,8 @@ class _Collector:
                 if item is None:
                     return
                 self._fn(*item)
+                if self._occupancy is not None:
+                    self._occupancy.set(self._q.qsize())
         except BaseException as e:  # noqa: BLE001 — re-raised on the caller
             self._err[0] = e
             if self._on_fail is not None:
@@ -108,6 +127,8 @@ class _Collector:
 
     def put(self, item) -> None:
         self._q.put(item)
+        if self._occupancy is not None:
+            self._occupancy.set(self._q.qsize() + 1)
 
     def shutdown(self) -> None:
         self._q.put(None)
@@ -244,7 +265,12 @@ def _split_for_jax(range_: FieldSize, base: int, scalar_fn):
     outside the base range — caller should go fully scalar).
     """
     pre, core, post = _clamp_to_base_range(range_, base)
-    slivers = [scalar_fn(part) for part in (pre, post) if part is not None]
+    slivers = []
+    for part in (pre, post):
+        if part is None:
+            continue
+        ENGINE_HOST_FALLBACK.labels("sliver").inc()
+        slivers.append(scalar_fn(part))
     return core, slivers
 
 
@@ -922,19 +948,22 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
                         f"[{lo},{hi})) counted 0 on device but host found "
                         f"{len(found)} nice numbers (audit)"
                     )
+                ENGINE_AUDITS.inc()
             audit_seen[0] += len(zeros)
 
     def timed_collect_item(cols, counts_dev):
         t0 = time.monotonic()
         collect_item(cols, counts_dev)
-        dev_busy[0] += time.monotonic() - t0
+        secs = time.monotonic() - t0
+        dev_busy[0] += secs
+        ENGINE_BATCH_KERNEL_SECONDS.labels("strided").observe(secs)
 
     producer = threading.Thread(target=produce, name="niceonly-msd", daemon=True)
     t_wall0 = time.monotonic()
     producer.start()
     collector = _Collector(
         timed_collect_item, STRIDE_WINDOW, "niceonly-collect",
-        on_fail=stop.set,
+        on_fail=stop.set, occupancy=ENGINE_STRIDE_OCCUPANCY,
     )
     n_desc = 0
     # Dispatcher stall accounting: gen (host desc-gen + waiting on the
@@ -950,6 +979,7 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
                 break
             k_real = len(cols[0])
             n_desc += k_real
+            ENGINE_DESCRIPTORS.inc(k_real)
             packed = pack(cols)
             if sharded_step is not None:
                 per_dev_real = np.clip(
@@ -1068,7 +1098,10 @@ def process_range_detailed(
     start = core.start()
     total = core.size()
 
+    import time as _time
+
     def collect_item(batch_start, valid, bh, nm):
+        t0 = _time.monotonic()
         bh = np.asarray(bh, dtype=np.int64)[: plan.base + 2]
         bh[0] -= lanes - valid  # remove tail-padding lanes from bin 0
         np.add(hist, bh, out=hist)
@@ -1084,6 +1117,9 @@ def process_range_detailed(
                             number=sub_start + i, num_uniques=int(uniques[i])
                         )
                     )
+        ENGINE_BATCH_KERNEL_SECONDS.labels("detailed").observe(
+            _time.monotonic() - t0
+        )
 
     # Collection (the stats readback + rare-path re-scan) runs on its own
     # thread: each readback pays the device->host RTT (~68 ms through the
@@ -1091,23 +1127,26 @@ def process_range_detailed(
     # time if paid serially on the dispatch thread (batch 2^28 = 4
     # readbacks for a 1e9 field). Only the collector touches
     # hist/nice_numbers.
-    collector = _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect")
+    collector = _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
+                           occupancy=ENGINE_DISPATCH_OCCUPANCY)
     try:
-        done = 0
-        while done < total:
-            if collector.failed():
-                break
-            valid = min(lanes, total - done)
-            batch_start = start + done
-            collector.put(
-                (batch_start, valid) + tuple(dispatch(batch_start, valid))
-            )
-            done += valid
-            if progress is not None:
-                progress(done, total)
+        with obs.span("engine.detailed", base=base, size=total):
+            done = 0
+            while done < total:
+                if collector.failed():
+                    break
+                valid = min(lanes, total - done)
+                batch_start = start + done
+                collector.put(
+                    (batch_start, valid) + tuple(dispatch(batch_start, valid))
+                )
+                done += valid
+                if progress is not None:
+                    progress(done, total)
     finally:
         collector.shutdown()
     collector.raise_if_failed()
+    ENGINE_NUMBERS.labels("detailed").inc(range_.size())
 
     nice_numbers.sort(key=lambda n: n.number)
     distribution = tuple(
@@ -1171,6 +1210,7 @@ def process_range_niceonly(
             "strided pallas path to the dense device scan",
             base,
         )
+        ENGINE_HOST_FALLBACK.labels("limbs").inc()
         backend = "jnp"
     if backend == "pallas":
         if _host_route_niceonly(core, base):
@@ -1181,23 +1221,28 @@ def process_range_niceonly(
             # Coarse MSD floor: per-range Python+ctypes overhead (~80 us) is
             # the dominant cost at this scale, and sub-RTT fields are mostly
             # ones the MSD filter cannot prune anyway (else they'd be cheap).
-            sub = _native_niceonly(
-                core, base, None, _native_threads(), progress,
-                msd_floor=max(1 << 20, core.size() // 8),
-            )
+            ENGINE_HOST_FALLBACK.labels("host-route").inc()
+            with obs.span("engine.niceonly-host", base=base, size=core.size()):
+                sub = _native_niceonly(
+                    core, base, None, _native_threads(), progress,
+                    msd_floor=max(1 << 20, core.size() // 8),
+                )
             nice_numbers.extend(sub.nice_numbers)
             nice_numbers.sort(key=lambda n: n.number)
+            ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
             return FieldResults(
                 distribution=(), nice_numbers=tuple(nice_numbers)
             )
         # Stride-compacted device path (picks its own table depth via
         # _pick_stride_depth and expands offsets host-side; any passed
         # stride_table only parameterizes the scalar/host paths).
-        nice_numbers.extend(
-            NiceNumberSimple(number=n, num_uniques=base)
-            for n in _niceonly_pallas(core, base, progress=progress)
-        )
+        with obs.span("engine.niceonly-strided", base=base, size=core.size()):
+            nice_numbers.extend(
+                NiceNumberSimple(number=n, num_uniques=base)
+                for n in _niceonly_pallas(core, base, progress=progress)
+            )
         nice_numbers.sort(key=lambda n: n.number)
+        ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
         return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
 
     mesh = _mesh_or_none()
@@ -1228,7 +1273,11 @@ def process_range_niceonly(
     pending: deque = deque()
 
     def collect_one():
+        import time as _time
+
+        t0 = _time.monotonic()
         batch_start, valid, count = pending.popleft()
+        ENGINE_DISPATCH_OCCUPANCY.set(len(pending))
         if int(count) > 0:
             for sub_start, uniques in _rare_scan_uniques(
                 plan, batch_start, valid, lanes, backend
@@ -1237,6 +1286,9 @@ def process_range_niceonly(
                     nice_numbers.append(
                         NiceNumberSimple(number=sub_start + i, num_uniques=base)
                     )
+        ENGINE_BATCH_KERNEL_SECONDS.labels("dense").observe(
+            _time.monotonic() - t0
+        )
 
     # Same adaptive host-filter floor as the strided device path: the dense
     # device scan is cheap per lane, so a fine (250) floor would be
@@ -1257,23 +1309,25 @@ def process_range_niceonly(
     t_dev0 = time.monotonic()
     grand_total = sum(r.size() for r in sub_ranges)
     grand_done = 0
-    for sub_range in sub_ranges:
-        start = sub_range.start()
-        total = sub_range.size()
-        done = 0
-        while done < total:
-            valid = min(lanes, total - done)
-            batch_start = start + done
-            count = dispatch(batch_start, valid, sub_range.end())
-            pending.append((batch_start, valid, count))
-            if len(pending) >= DISPATCH_WINDOW:
-                collect_one()
-            done += valid
-            grand_done += valid
-            if progress is not None:
-                progress(grand_done, grand_total)
-    while pending:
-        collect_one()
+    with obs.span("engine.niceonly-dense", base=base, size=core.size()):
+        for sub_range in sub_ranges:
+            start = sub_range.start()
+            total = sub_range.size()
+            done = 0
+            while done < total:
+                valid = min(lanes, total - done)
+                batch_start = start + done
+                count = dispatch(batch_start, valid, sub_range.end())
+                pending.append((batch_start, valid, count))
+                ENGINE_DISPATCH_OCCUPANCY.set(len(pending))
+                if len(pending) >= DISPATCH_WINDOW:
+                    collect_one()
+                done += valid
+                grand_done += valid
+                if progress is not None:
+                    progress(grand_done, grand_total)
+        while pending:
+            collect_one()
     device_secs = time.monotonic() - t_dev0
     ctrl.observe(host_secs, device_secs, core.size())
     log.info(
@@ -1282,6 +1336,7 @@ def process_range_niceonly(
         base, core.start(), core.end(), host_secs, floor_used,
         len(sub_ranges), device_secs, len(nice_numbers),
     )
+    ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
 
     nice_numbers.sort(key=lambda n: n.number)
     return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))

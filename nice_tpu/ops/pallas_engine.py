@@ -34,6 +34,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from nice_tpu.obs.series import PALLAS_DISPATCH_SECONDS
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.ops.limbs import BasePlan
 
@@ -144,12 +145,29 @@ def _stats_callable(plan: BasePlan, mode: str, batch_size: int, block_rows: int)
     return run
 
 
+import contextlib
+import time as _time
+
+
+@contextlib.contextmanager
+def _timed(kernel: str):
+    """Per-dispatch timing for the public kernel entry points (under jit the
+    call is an async enqueue, so this measures dispatch cost; in interpreter
+    mode it is the full synchronous kernel execution)."""
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        PALLAS_DISPATCH_SECONDS.labels(kernel).observe(_time.perf_counter() - t0)
+
+
 def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
                    block_rows: int = BLOCK_ROWS):
     """(histogram i32[128] (bins 0..base+1), near_miss_count i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
     run = _stats_callable(plan, "detailed", batch_size, block_rows)
-    return run(start_limbs, valid_count)
+    with _timed("detailed"):
+        return run(start_limbs, valid_count)
 
 
 def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
@@ -157,7 +175,8 @@ def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
     """Count of fully nice lanes in a dense range batch (i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
     run = _stats_callable(plan, "niceonly", batch_size, block_rows)
-    return run(start_limbs, valid_count)[1]
+    with _timed("niceonly_dense"):
+        return run(start_limbs, valid_count)[1]
 
 
 # --------------------------------------------------------------------------
@@ -348,7 +367,8 @@ def niceonly_strided_batch(plan: BasePlan, spec: StrideSpec, desc: np.ndarray,
     """
     assert desc.ndim == 2 and desc.shape[1] == _DESC_WIDTH, desc.shape
     run = _strided_callable(plan, spec, desc.shape[0], periods)
-    return run(desc, np.int32(desc.shape[0] if n_real is None else n_real))
+    with _timed("niceonly_strided"):
+        return run(desc, np.int32(desc.shape[0] if n_real is None else n_real))
 
 
 # --------------------------------------------------------------------------
@@ -392,4 +412,5 @@ def uniques_batch(plan: BasePlan, batch_size: int, start_limbs,
                   block_rows: int = BLOCK_ROWS):
     """Per-lane num_uniques for one batch (i32[batch_size])."""
     block_rows = _effective_block_rows(batch_size, block_rows)
-    return _uniques_callable(plan, batch_size, block_rows)(start_limbs)
+    with _timed("uniques"):
+        return _uniques_callable(plan, batch_size, block_rows)(start_limbs)

@@ -21,15 +21,37 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nice_tpu.obs.series import MESH_DEVICES, MESH_DISPATCH_SECONDS
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.ops.limbs import BasePlan
 
 FIELD_AXIS = "field"
 
 
+def _timed_step(fn, mode: str):
+    """Wrap a jitted sharded step so each dispatch lands in
+    nice_mesh_dispatch_seconds{mode=...} (async enqueue cost under jit)."""
+    import time as _time
+
+    import functools as _functools
+
+    @_functools.wraps(fn)
+    def timed(*args, **kwargs):
+        t0 = _time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            MESH_DISPATCH_SECONDS.labels(mode).observe(
+                _time.perf_counter() - t0
+            )
+
+    return timed
+
+
 def make_mesh(devices=None) -> Mesh:
     """1-D mesh over all (or given) devices; the axis shards the number line."""
     devices = devices if devices is not None else jax.devices()
+    MESH_DEVICES.set(len(devices))
     return Mesh(np.asarray(devices), (FIELD_AXIS,))
 
 
@@ -136,7 +158,7 @@ def make_sharded_stats_step(
         out_specs=(P(), P()) if mode == "detailed" else P(),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return _timed_step(jax.jit(sharded), mode)
 
 
 @functools.lru_cache(maxsize=None)
@@ -167,7 +189,7 @@ def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
         out_specs=P(FIELD_AXIS, None),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return _timed_step(jax.jit(sharded), "strided")
 
 
 def make_sharded_niceonly_step(plan: BasePlan, per_device_batch: int, mesh: Mesh):

@@ -19,6 +19,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from nice_tpu import obs
 from nice_tpu.core import distribution_stats, number_stats
 from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
 from nice_tpu.core.types import (
@@ -37,6 +38,13 @@ log = logging.getLogger("nice_tpu.server")
 class Metrics:
     """Per-endpoint request counters and latency histograms (Prometheus text).
 
+    Built on the shared nice_tpu.obs registry machinery; each ApiContext
+    keeps a private Registry so parallel test servers don't cross-count,
+    while render() appends the process-global registry so the server's
+    /metrics also exposes the engine pipeline series (batch kernel time,
+    dispatch-window occupancy, host-fallback/audit counters — at zero when
+    this process never runs the engine, which is the normal server case).
+
     Histogram buckets mirror rocket_prometheus's defaults (reference
     api/src/main.rs:438-459 exposes per-endpoint response-time histograms),
     giving p50/p99 visibility rather than just cumulative sums."""
@@ -44,79 +52,43 @@ class Metrics:
     BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: dict[tuple[str, int], int] = {}
-        self._time_sums: dict[str, float] = {}
-        # endpoint -> per-bucket cumulative-style raw counts (+Inf is the
-        # implicit last slot); rendered cumulatively.
-        self._buckets: dict[str, list[int]] = {}
+        self.registry = obs.Registry()
+        self._requests = self.registry.counter(
+            "nice_api_requests_total",
+            "Requests by endpoint and status.",
+            labelnames=("endpoint", "status"),
+        )
+        self._latency = self.registry.histogram(
+            "nice_api_request_seconds",
+            "Request latency by endpoint.",
+            labelnames=("endpoint",),
+            buckets=self.BUCKETS,
+        )
 
     def record(self, endpoint: str, status: int, elapsed: float) -> None:
-        with self._lock:
-            self._counts[(endpoint, status)] = (
-                self._counts.get((endpoint, status), 0) + 1
-            )
-            self._time_sums[endpoint] = self._time_sums.get(endpoint, 0.0) + elapsed
-            slots = self._buckets.setdefault(
-                endpoint, [0] * (len(self.BUCKETS) + 1)
-            )
-            for i, le in enumerate(self.BUCKETS):
-                if elapsed <= le:
-                    slots[i] += 1
-                    break
-            else:
-                slots[-1] += 1
+        self._requests.labels(endpoint, str(status)).inc()
+        self._latency.labels(endpoint).observe(elapsed)
 
     def render(self) -> str:
-        lines = [
-            "# HELP nice_api_requests_total Requests by endpoint and status.",
-            "# TYPE nice_api_requests_total counter",
-        ]
-        with self._lock:
-            for (endpoint, status), count in sorted(self._counts.items()):
-                lines.append(
-                    f'nice_api_requests_total{{endpoint="{endpoint}",'
-                    f'status="{status}"}} {count}'
-                )
+        lines = [self.registry.render().rstrip("\n")]
+        # Back-compat: the round-3 metric name, kept for one release so
+        # scrape rules keyed on it keep working (advisor r4; the rename
+        # is also called out in CHANGELOG.md). Same value as
+        # nice_api_request_seconds_sum.
+        lines.append(
+            "# HELP nice_api_request_seconds_total DEPRECATED alias of "
+            "nice_api_request_seconds_sum; remove after one release."
+        )
+        lines.append("# TYPE nice_api_request_seconds_total counter")
+        for (endpoint,), (total, _count) in sorted(
+            self._latency.label_sums().items()
+        ):
             lines.append(
-                "# HELP nice_api_request_seconds Request latency by endpoint."
+                f'nice_api_request_seconds_total{{endpoint="{endpoint}"}}'
+                f" {total:.6f}"
             )
-            lines.append("# TYPE nice_api_request_seconds histogram")
-            for endpoint, slots in sorted(self._buckets.items()):
-                cum = 0
-                for le, raw in zip(self.BUCKETS, slots):
-                    cum += raw
-                    lines.append(
-                        f'nice_api_request_seconds_bucket{{endpoint='
-                        f'"{endpoint}",le="{le}"}} {cum}'
-                    )
-                cum += slots[-1]
-                lines.append(
-                    f'nice_api_request_seconds_bucket{{endpoint="{endpoint}",'
-                    f'le="+Inf"}} {cum}'
-                )
-                lines.append(
-                    f'nice_api_request_seconds_count{{endpoint="{endpoint}"}}'
-                    f" {cum}"
-                )
-                lines.append(
-                    f'nice_api_request_seconds_sum{{endpoint="{endpoint}"}}'
-                    f" {self._time_sums.get(endpoint, 0.0):.6f}"
-                )
-            # Back-compat: the round-3 metric name, kept for one release so
-            # scrape rules keyed on it keep working (advisor r4; the rename
-            # is also called out in CHANGELOG.md). Same value as
-            # nice_api_request_seconds_sum.
-            lines.append(
-                "# HELP nice_api_request_seconds_total DEPRECATED alias of "
-                "nice_api_request_seconds_sum; remove after one release."
-            )
-            lines.append("# TYPE nice_api_request_seconds_total counter")
-            for endpoint, total in sorted(self._time_sums.items()):
-                lines.append(
-                    f'nice_api_request_seconds_total{{endpoint="{endpoint}"}}'
-                    f" {total:.6f}"
-                )
+        # Engine pipeline + span series live in the process-global registry.
+        lines.append(obs.render().rstrip("\n"))
         return "\n".join(lines) + "\n"
 
 

@@ -15,7 +15,9 @@ check_level = 0 special case) are preserved exactly.
 
 from __future__ import annotations
 
+import base64
 import json
+import math
 import os
 import random
 import sqlite3
@@ -706,6 +708,21 @@ class Db:
     )
     PUBLIC_QUERY_MAX_ROWS = 1000
     PUBLIC_QUERY_MAX_VM_STEPS = 50_000_000  # aborts runaway scans (~100 ms)
+    PUBLIC_QUERY_MAX_LENGTH = 1 << 20  # 1 MiB cap on any string/blob value
+
+    @staticmethod
+    def _public_value(v):
+        """Coerce one result cell to something json.dumps can emit. sqlite
+        can synthesize values JSON has no spelling for (zeroblob() bytes,
+        nan/inf floats); returning a tagged repr beats a 500."""
+        if v is None or isinstance(v, (int, str)):
+            return v
+        if isinstance(v, float):
+            return v if math.isfinite(v) else repr(v)
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            b = bytes(v)
+            return {"blob_base64": base64.b64encode(b).decode("ascii")}
+        return repr(v)
 
     def public_query(self, sql: str, params: tuple = ()) -> dict:
         """Run one read-only SELECT with third-party privileges.
@@ -736,6 +753,13 @@ class Db:
             f"file:{self.path}?mode=ro", uri=True, isolation_level=None
         )
         try:
+            if hasattr(conn, "setlimit"):  # Python 3.11+
+                # Caps any single string/blob the VM materializes — closes
+                # the zeroblob(1e9) memory-amplification hole (oversized
+                # values raise SQLITE_TOOBIG -> DataError -> 400).
+                conn.setlimit(
+                    sqlite3.SQLITE_LIMIT_LENGTH, self.PUBLIC_QUERY_MAX_LENGTH
+                )
             conn.execute("PRAGMA query_only=1")
             conn.execute("PRAGMA busy_timeout=2000")
             # First callback fires after MAX_VM_STEPS instructions; returning
@@ -750,7 +774,7 @@ class Db:
             truncated = cur.fetchone() is not None
             return {
                 "columns": columns,
-                "rows": [list(r) for r in rows],
+                "rows": [[self._public_value(v) for v in r] for r in rows],
                 "truncated": truncated,
             }
         finally:

@@ -37,26 +37,59 @@ def force_virtual_cpu(env: MutableMapping[str, str], n_devices: int = 8) -> None
     env["XLA_FLAGS"] = " ".join(flags)
 
 
-def probe_backend(timeout_s: float = 60.0, platform: str | None = None):
+def probe_backend(
+    timeout_s: float = 60.0,
+    platform: str | None = None,
+    _devices_fn=None,
+):
     """(device_count | None, error | None): import jax, optionally force a
     platform via jax.config, and count devices — inside a watchdog thread.
 
     Returns (n, None) on success; (None, exc) on an init exception; and
-    (None, TimeoutError) when init hangs past timeout_s. The hung daemon
-    thread cannot be joined — callers that need a clean retry should re-exec
-    or subprocess (jax also caches a FAILED backend, so in-process retries
-    see the same error)."""
-    import threading
+    (None, TimeoutError) when init hangs past timeout_s. The TimeoutError
+    message names the phase that was running when the watchdog fired
+    (import-jax / configure / devices), and each phase runs inside an
+    obs span, so a wedged device lease leaves a begin-without-end trace
+    record identifying exactly where init stalled. The hung daemon thread
+    cannot be joined — callers that need a clean retry should re-exec or
+    subprocess (jax also caches a FAILED backend, so in-process retries
+    see the same error).
 
-    result: dict = {}
+    _devices_fn is a test hook replacing the `len(jax.devices())` step so a
+    hang can be simulated without wedging a real backend."""
+    import threading
+    import time
+
+    from nice_tpu import obs
+    from nice_tpu.obs.series import BACKEND_INIT_SECONDS
+
+    result: dict = {"phase": "import-jax"}
+
+    def phase(name):
+        result["phase"] = name
+        result["t_phase"] = time.perf_counter()
+        return obs.span("backend-init." + name, platform=platform or "default")
+
+    def observe_phase():
+        BACKEND_INIT_SECONDS.observe(
+            time.perf_counter() - result["t_phase"], (result["phase"],)
+        )
 
     def probe():
         try:
-            import jax
-
+            with phase("import-jax"):
+                import jax
+            observe_phase()
             if platform:
-                jax.config.update("jax_platforms", platform)
-            result["n"] = len(jax.devices())
+                with phase("configure"):
+                    jax.config.update("jax_platforms", platform)
+                observe_phase()
+            with phase("devices"):
+                if _devices_fn is not None:
+                    result["n"] = _devices_fn()
+                else:
+                    result["n"] = len(jax.devices())
+            observe_phase()
         except Exception as exc:  # noqa: BLE001 — callers decide retryability
             result["exc"] = exc
 
@@ -65,9 +98,13 @@ def probe_backend(timeout_s: float = 60.0, platform: str | None = None):
     t.join(timeout_s)
     if "n" in result:
         return result["n"], None
-    return None, result.get(
-        "exc",
-        TimeoutError(
-            f"jax backend init hung >{timeout_s:.0f}s (wedged device lease?)"
-        ),
+    if "exc" in result:
+        return None, result["exc"]
+    stalled = result["phase"]
+    obs.trace_event(
+        "backend-init", "timeout", phase=stalled, timeout_s=timeout_s
+    )
+    return None, TimeoutError(
+        f"jax backend init hung >{timeout_s:.0f}s in phase"
+        f" '{stalled}' (wedged device lease?)"
     )

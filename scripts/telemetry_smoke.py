@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""CI telemetry smoke: run a tiny instrumented field search and verify the
+pipeline metrics and trace spans actually come out the other end.
+
+Runs a small detailed field on the scalar and jax backends with
+NICE_TPU_TRACE pointed at a temp file, then greps the rendered /metrics text
+for the engine series names and the trace file for span events. Exits
+nonzero (with a diff of what's missing) if any expected signal is absent —
+catching the failure mode where a refactor silently disconnects the
+instrumentation while the tests that merely import obs still pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+EXPECTED_SERIES = [
+    "nice_engine_batch_kernel_seconds_bucket",
+    'nice_engine_batch_kernel_seconds_count{path="detailed"}',
+    "nice_engine_dispatch_window_occupancy",
+    "nice_engine_stride_window_occupancy",
+    "nice_engine_host_fallback_total",
+    "nice_engine_audit_total",
+    'nice_engine_numbers_total{mode="detailed"}',
+    "nice_mesh_devices",
+    "nice_backend_init_seconds",
+    "nice_client_request_seconds",
+    "nice_trace_span_seconds",
+]
+
+EXPECTED_SPANS = ["engine.detailed"]
+
+
+def main() -> int:
+    trace_path = os.path.join(tempfile.mkdtemp(prefix="nice-obs-"), "trace.jsonl")
+    os.environ["NICE_TPU_TRACE"] = trace_path
+    os.environ.setdefault("NICE_TPU_SHARD", "0")  # single-chip engine path
+
+    from nice_tpu import obs
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.obs.series import ENGINE_NUMBERS
+    from nice_tpu.ops import engine, scalar
+
+    rng = FieldSize(47, 100)  # base 10's full valid range: tiny but real
+    want = scalar.process_range_detailed(rng, 10)
+    got = engine.process_range_detailed(rng, 10, backend="jax", batch_size=256)
+    if got != want:
+        print("FAIL: instrumented jax run diverged from scalar", file=sys.stderr)
+        return 1
+
+    failures = []
+
+    text = obs.render()
+    for name in EXPECTED_SERIES:
+        if name not in text:
+            failures.append(f"metrics: missing series {name!r}")
+    if ENGINE_NUMBERS.labels("detailed").value() < rng.range_size:
+        failures.append("metrics: nice_engine_numbers_total did not count the run")
+
+    try:
+        with open(trace_path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        events = []
+        failures.append(f"trace: no sink written at {trace_path}")
+    names = {e.get("name") for e in events}
+    for span in EXPECTED_SPANS:
+        if span not in names:
+            failures.append(f"trace: no span events for {span!r} (saw {sorted(names)})")
+    for e in events:
+        if e.get("event") == "end" and "wall_secs" not in e:
+            failures.append(f"trace: end event without wall_secs: {e}")
+
+    if failures:
+        print("telemetry smoke FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+
+    print(
+        f"telemetry smoke OK: {len(EXPECTED_SERIES)} series present, "
+        f"{len(events)} trace events in {trace_path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -153,6 +153,36 @@ def test_fast_strided_matches_generic(base):
         assert fast == slow, (base, k)
 
 
+@pytest.mark.parametrize("base", [40, 50])
+def test_fast_strided_accept_rich_low_range(base):
+    """Accept-rich differential: below the base range (n far under b^(b/5))
+    the square+cube digit count stays <= base, so digit-distinct survivors
+    are plentiful — the fast/slow comparison can never pass on
+    empty-vs-empty. start=1e8 keeps n >= base^4.5 (40^4.5≈1.6e7,
+    50^4.5≈4.4e7) so the polynomial path stays eligible past its gate."""
+    if not native.available():
+        pytest.skip("no native toolchain")
+    table = stride_filter.get_stride_table(base, 3)
+    if table.num_residues == 0:
+        pytest.skip("empty stride table")
+    start = 100_000_000
+    end = start + 3 * table.modulus
+    first, idx = table.first_valid_at_or_after(start)
+    assert first < end
+    args = (first, idx, end, base, table.gap_array)
+    kwargs = dict(modulus=table.modulus, residues=table.residues_u32)
+    prev = native.strided_fast_enabled(True)
+    try:
+        fast = native.iterate_range_strided(*args, **kwargs)
+        native.strided_fast_enabled(False)
+        slow = native.iterate_range_strided(*args, **kwargs)
+    finally:
+        native.strided_fast_enabled(prev)
+    assert slow, (base, "generic path found no digit-distinct survivors;"
+                  " the differential would be vacuous")
+    assert fast == slow, (base, len(fast), len(slow))
+
+
 def test_fast_strided_finds_nice_numbers():
     """b10 golden: 69 is nice; the fast path must report it (guards against a
     fast filter that silently rejects everything)."""

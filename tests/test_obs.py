@@ -1,0 +1,243 @@
+"""Telemetry layer tests: metrics registry text output, span nesting/timing,
+engine pipeline counters during real field runs, client/server /metrics
+surfaces, and the simulated backend-init hang naming its stalled phase."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nice_tpu import obs
+from nice_tpu.core.types import FieldSize
+from nice_tpu.obs import metrics as obs_metrics
+from nice_tpu.obs import series
+from nice_tpu.ops import engine, scalar
+
+
+# --- metrics registry ------------------------------------------------------
+
+def test_counter_gauge_text_output():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_requests_total", "help text", labelnames=("ep",))
+    c.labels("a").inc()
+    c.labels("a").inc(2)
+    c.labels("b").inc()
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7)
+    text = reg.render()
+    assert "# HELP t_requests_total help text" in text
+    assert "# TYPE t_requests_total counter" in text
+    assert 't_requests_total{ep="a"} 3' in text
+    assert 't_requests_total{ep="b"} 1' in text
+    assert "# TYPE t_depth gauge" in text
+    assert "t_depth 7" in text
+
+
+def test_histogram_cumulative_buckets():
+    reg = obs_metrics.Registry()
+    h = reg.histogram(
+        "t_seconds", "latency", labelnames=("op",), buckets=(0.1, 1.0, 10.0)
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.labels("x").observe(v)
+    text = reg.render()
+    assert 't_seconds_bucket{op="x",le="0.1"} 1' in text
+    assert 't_seconds_bucket{op="x",le="1.0"} 3' in text
+    assert 't_seconds_bucket{op="x",le="10.0"} 4' in text
+    assert 't_seconds_bucket{op="x",le="+Inf"} 5' in text
+    assert 't_seconds_count{op="x"} 5' in text
+    assert 't_seconds_sum{op="x"} 56.05' in text
+
+
+def test_registration_is_idempotent():
+    reg = obs_metrics.Registry()
+    a = reg.counter("t_total", "x")
+    b = reg.counter("t_total", "x")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("t_total", "wrong kind")
+    with pytest.raises(ValueError):
+        reg.counter("t_total", "x", labelnames=("other",))
+
+
+def test_declared_series_render_before_any_activity():
+    # Pre-seeded label combinations must render even when never touched
+    # (values may be nonzero here: other tests share the global registry).
+    text = obs.render()
+    assert 'nice_engine_batch_kernel_seconds_bucket{path="strided",le="+Inf"}' in text
+    assert "nice_engine_dispatch_window_occupancy" in text
+    assert 'nice_engine_host_fallback_total{reason="host-route"}' in text
+    assert "nice_engine_audit_total" in text
+    # The zero-rendering guarantee itself, on a fresh registry:
+    reg = obs_metrics.Registry()
+    reg.counter("t_untouched_total", "x")
+    reg.gauge("t_untouched", "x")
+    fresh = reg.render()
+    assert "t_untouched_total 0" in fresh
+    assert "t_untouched 0" in fresh
+
+
+# --- trace spans -----------------------------------------------------------
+
+def test_span_nesting_and_timing(tmp_path, monkeypatch):
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("NICE_TPU_TRACE", str(sink))
+    with obs.span("outer", base=40):
+        with obs.span("inner"):
+            pass
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert [(e["name"], e["event"]) for e in events] == [
+        ("outer", "begin"),
+        ("inner", "begin"),
+        ("inner", "end"),
+        ("outer", "end"),
+    ]
+    assert events[0]["base"] == 40
+    assert events[1]["parent"] == "outer" and events[1]["depth"] == 1
+    inner_end = events[2]
+    assert inner_end["status"] == "ok"
+    assert inner_end["wall_secs"] >= 0.0
+    assert "process_secs" in inner_end
+
+
+def test_span_error_status_and_begin_before_body(tmp_path, monkeypatch):
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("NICE_TPU_TRACE", str(sink))
+    with pytest.raises(RuntimeError):
+        with obs.span("doomed"):
+            # The begin event must already be durable: a hang (or crash)
+            # inside the span still leaves evidence of what was running.
+            events = [
+                json.loads(line) for line in sink.read_text().splitlines()
+            ]
+            assert events and events[-1] == {
+                **events[-1], "name": "doomed", "event": "begin",
+            }
+            raise RuntimeError("boom")
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert events[-1]["event"] == "end"
+    assert events[-1]["status"] == "error"
+
+
+def test_trace_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("NICE_TPU_TRACE", raising=False)
+    assert not obs.trace_enabled()
+    with obs.span("silent"):
+        pass  # no sink: must not raise
+
+
+# --- engine counters during a real field run -------------------------------
+
+def test_engine_counters_increment_scalar_vs_jax(monkeypatch):
+    # Single-chip path: the conftest's 8-device virtual mesh would route
+    # through jax.shard_map, unavailable in this jax build.
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    # Base 10's valid range is [47, 100): wholly in range, no slivers.
+    base = 10
+    rng = FieldSize(47, 100)
+    numbers = series.ENGINE_NUMBERS.labels("detailed")
+    kernel_hist = series.ENGINE_BATCH_KERNEL_SECONDS
+    count_before = numbers.value()
+    sums_before = kernel_hist.label_sums()[("detailed",)][1]
+    got = engine.process_range_detailed(rng, base, backend="jax",
+                                        batch_size=1 << 10)
+    want = scalar.process_range_detailed(rng, base)
+    assert got == want  # instrumentation must not perturb results
+    assert numbers.value() == count_before + rng.range_size
+    assert kernel_hist.label_sums()[("detailed",)][1] > sums_before
+
+
+def test_engine_sliver_fallback_counter(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    fallback = series.ENGINE_HOST_FALLBACK.labels("sliver")
+    before = fallback.value()
+    # Range straddles the base-range start (47): [40, 47) is a pre sliver.
+    rng = FieldSize(40, 100)
+    engine.process_range_detailed(rng, 10, backend="jax", batch_size=1 << 10)
+    assert fallback.value() == before + 1
+
+
+# --- /metrics HTTP surfaces ------------------------------------------------
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as resp:
+        return resp.read().decode()
+
+
+def test_client_metrics_server_exposes_engine_series():
+    server = obs.serve_metrics(0)
+    try:
+        body = _scrape(server.server_address[1])
+    finally:
+        server.shutdown()
+    assert "# TYPE nice_engine_batch_kernel_seconds histogram" in body
+    assert "nice_engine_dispatch_window_occupancy" in body
+    assert "nice_engine_host_fallback_total" in body
+    assert "nice_engine_audit_total" in body
+    assert "nice_client_request_seconds" in body
+
+
+def test_server_metrics_exposes_engine_series():
+    from nice_tpu.server.app import Metrics
+
+    m = Metrics()
+    m.record("/submit", 200, 0.003)
+    text = m.render()
+    # API series (per-context registry)...
+    assert 'nice_api_requests_total{endpoint="/submit",status="200"} 1' in text
+    assert 'nice_api_request_seconds_bucket{endpoint="/submit",le="0.005"} 1' in text
+    # ...deprecated alias...
+    assert 'nice_api_request_seconds_total{endpoint="/submit"}' in text
+    # ...plus the engine pipeline series from the global registry.
+    assert "nice_engine_batch_kernel_seconds" in text
+    assert "nice_engine_stride_window_occupancy" in text
+    assert "nice_engine_host_fallback_total" in text
+
+
+# --- simulated backend-init hang -------------------------------------------
+
+def test_backend_init_hang_names_stalled_phase(tmp_path, monkeypatch):
+    import time
+
+    from nice_tpu.utils import platform as plat
+
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("NICE_TPU_TRACE", str(sink))
+
+    def wedged_devices():
+        time.sleep(30.0)
+        return 0
+
+    n, exc = plat.probe_backend(
+        timeout_s=0.3, platform="cpu", _devices_fn=wedged_devices
+    )
+    assert n is None
+    assert isinstance(exc, TimeoutError)
+    assert "devices" in str(exc)  # names the stalled phase
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    begun = [
+        e for e in events
+        if e["name"] == "backend-init.devices" and e["event"] == "begin"
+    ]
+    ended = [
+        e for e in events
+        if e["name"] == "backend-init.devices" and e["event"] == "end"
+    ]
+    assert begun and not ended  # begin-without-end: the hang left evidence
+    timeouts = [
+        e for e in events
+        if e["name"] == "backend-init" and e["event"] == "timeout"
+    ]
+    assert timeouts and timeouts[0]["phase"] == "devices"
+
+
+def test_probe_backend_success_records_phases():
+    from nice_tpu.obs.series import BACKEND_INIT_SECONDS
+    from nice_tpu.utils import platform as plat
+
+    before = BACKEND_INIT_SECONDS.label_sums()[("devices",)][1]
+    n, exc = plat.probe_backend(timeout_s=30.0, platform="cpu")
+    assert exc is None and n >= 1
+    assert BACKEND_INIT_SECONDS.label_sums()[("devices",)][1] == before + 1

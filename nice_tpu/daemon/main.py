@@ -1,9 +1,14 @@
 """Idle-compute daemon.
 
-Watches system CPU usage (via /proc/stat — no external deps) and spawns a
-search client when the machine has been idle long enough, killing it when the
-machine gets busy and restarting it forever otherwise. Mirrors the reference
-daemon's CpuMonitor / ProcessManager split (daemon/src/main.rs:39-215).
+Watches system CPU usage and spawns a search client when the machine has been
+idle long enough, killing it when the machine gets busy and restarting it
+forever otherwise. Mirrors the reference daemon's CpuMonitor / ProcessManager
+split (daemon/src/main.rs:39-215).
+
+CPU sampling is portable: /proc/stat jiffy deltas where available (Linux,
+no deps), then psutil.cpu_percent if psutil is importable (macOS/Windows),
+then a 1-minute loadavg estimate (any POSIX), then a constant-idle stub —
+the daemon must run on a dev laptop, not only on the TPU host image.
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ log = logging.getLogger("nice_tpu.daemon")
 
 
 def read_cpu_times() -> tuple[int, int]:
-    """(idle, total) jiffies from /proc/stat."""
+    """(idle, total) jiffies from /proc/stat (Linux backend)."""
     with open("/proc/stat") as f:
         parts = f.readline().split()
     values = [int(v) for v in parts[1:]]
@@ -32,23 +37,63 @@ def read_cpu_times() -> tuple[int, int]:
     return idle, sum(values)
 
 
-class CpuMonitor:
-    """Rolling CPU utilization sampler (reference daemon/src/main.rs:39-122)."""
+def pick_cpu_backend() -> str:
+    """Best available whole-machine CPU sampler for this platform.
 
-    def __init__(self, interval_secs: float = 5.0):
+    Deliberately does NOT call read_cpu_times() (only stats the path) so
+    tests can stub the reader with a finite sequence of readings.
+    """
+    if os.path.exists("/proc/stat"):
+        return "proc"
+    try:
+        import psutil  # noqa: F401
+
+        return "psutil"
+    except ImportError:
+        pass
+    return "loadavg" if hasattr(os, "getloadavg") else "none"
+
+
+class CpuMonitor:
+    """Rolling CPU utilization sampler (reference daemon/src/main.rs:39-122).
+
+    backend: "proc" (jiffy deltas), "psutil" (cpu_percent), "loadavg"
+    (1-min load / cores, clipped to 1.0), or "none" (always idle — the
+    daemon degrades to an unconditional supervisor rather than refusing to
+    run). Default: pick_cpu_backend().
+    """
+
+    def __init__(self, interval_secs: float = 5.0, backend: str | None = None):
         self.interval = interval_secs
-        self._last = read_cpu_times()
+        self.backend = backend or pick_cpu_backend()
+        if self.backend == "proc":
+            self._last = read_cpu_times()
+        elif self.backend == "psutil":
+            import psutil
+
+            self._psutil = psutil
+            psutil.cpu_percent(interval=None)  # prime the rolling window
 
     def sample(self) -> float:
         """Blocking sample: CPU usage fraction over the interval."""
         time.sleep(self.interval)
-        idle, total = read_cpu_times()
-        last_idle, last_total = self._last
-        self._last = (idle, total)
-        d_total = total - last_total
-        if d_total <= 0:
-            return 0.0
-        return 1.0 - (idle - last_idle) / d_total
+        if self.backend == "proc":
+            idle, total = read_cpu_times()
+            last_idle, last_total = self._last
+            self._last = (idle, total)
+            d_total = total - last_total
+            if d_total <= 0:
+                return 0.0
+            return 1.0 - (idle - last_idle) / d_total
+        if self.backend == "psutil":
+            return self._psutil.cpu_percent(interval=None) / 100.0
+        if self.backend == "loadavg":
+            try:
+                load1 = os.getloadavg()[0]
+            except OSError:
+                return 0.0
+            return min(1.0, load1 / (os.cpu_count() or 1))
+        return 0.0  # "none": report idle; spawning is the safe default
 
 
 class ProcessManager:
@@ -123,6 +168,7 @@ def main(argv=None) -> int:
     # counter make a silently-dead supervisor loop externally detectable.
     obs.maybe_serve_metrics()
     monitor = CpuMonitor(args.sample_interval)
+    log.info("cpu sampler backend: %s", monitor.backend)
     manager = ProcessManager(args.client_args or ["--repeat"])
     idle_since: Optional[float] = None
 

@@ -42,6 +42,24 @@ ENGINE_NUMBERS = metrics.counter(
     "Candidate numbers whose range processing completed, by mode.",
     labelnames=("mode",),
 )
+ENGINE_READBACK_BYTES = metrics.counter(
+    "nice_engine_readback_bytes_total",
+    "Device->host result bytes actually transferred, by payload kind "
+    "(nm/count scalars, compacted survivor lists, folded stats, dense "
+    "fallbacks, strided count tiles).",
+    labelnames=("kind",),
+)
+ENGINE_STATS_TRANSFERS = metrics.counter(
+    "nice_engine_stats_transfers_total",
+    "Device->host transfers of the detailed stats accumulator, by mode. "
+    "With device-resident accumulation this is ~1 per field, not 1 per batch.",
+    labelnames=("mode",),
+)
+ENGINE_SURVIVOR_OVERFLOW = metrics.counter(
+    "nice_engine_survivor_overflow_total",
+    "Compacted survivor readbacks that overflowed the on-device cap and "
+    "fell back to a dense per-lane transfer.",
+)
 
 # --- pallas + mesh dispatch ---------------------------------------------
 PALLAS_DISPATCH_SECONDS = metrics.histogram(
@@ -58,6 +76,15 @@ MESH_DISPATCH_SECONDS = metrics.histogram(
 MESH_DEVICES = metrics.gauge(
     "nice_mesh_devices",
     "Devices in the most recently constructed mesh.",
+)
+
+# --- compiled-executable cache (ops/compile_cache.py) --------------------
+COMPILE_CACHE_EVENTS = metrics.counter(
+    "nice_compile_cache_events_total",
+    "Compilation-cache traffic: the jax persistent cache (layer=persistent,"
+    " event=hit/request) and the in-process AOT executable cache"
+    " (layer=executable, event=hit/miss).",
+    labelnames=("layer", "event"),
 )
 
 # --- backend init (utils/platform.py) -----------------------------------
@@ -113,6 +140,14 @@ DAEMON_CPU = metrics.gauge(
 # process (or of the jax-free server) still shows each series at zero.
 for _path in ("detailed", "dense", "strided"):
     ENGINE_BATCH_KERNEL_SECONDS.labels(_path)
+for _kind in ("nm", "count", "survivors", "survivors-dense", "stats",
+              "strided-counts"):
+    ENGINE_READBACK_BYTES.labels(_kind)
+for _mode in ("detailed",):
+    ENGINE_STATS_TRANSFERS.labels(_mode)
+for _layer, _event in (("persistent", "hit"), ("persistent", "request"),
+                       ("executable", "hit"), ("executable", "miss")):
+    COMPILE_CACHE_EVENTS.labels(_layer, _event)
 for _reason in ("sliver", "host-route", "limbs"):
     ENGINE_HOST_FALLBACK.labels(_reason)
 for _mode in ("detailed", "niceonly"):
@@ -120,7 +155,8 @@ for _mode in ("detailed", "niceonly"):
     MESH_DISPATCH_SECONDS.labels(_mode)
     CLIENT_FIELDS.labels(_mode)
     CLIENT_FIELD_SECONDS.labels(_mode)
-for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques"):
+for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques",
+                "survivors"):
     PALLAS_DISPATCH_SECONDS.labels(_kernel)
 for _phase in ("import-jax", "configure", "devices"):
     BACKEND_INIT_SECONDS.labels(_phase)

@@ -1,0 +1,123 @@
+"""Persistent compilation cache + in-process AOT executable cache.
+
+Two layers attack the two distinct recompile costs the benchmark rounds
+measured (BENCH r0/r5: whole rounds blanked by init watchdogs; VERDICT
+task #1):
+
+1. The **jax persistent compilation cache** keeps XLA/Mosaic compilation
+   artifacts on disk across *processes*: point ``JAX_COMPILATION_CACHE_DIR``
+   at a stable directory and the second run of any mode deserializes its
+   executables instead of recompiling (the TPU analog of the reference
+   caching its NVRTC PTX per arch, client_process_gpu.rs:249-259). setup()
+   drops jax's minimum-compile-time/entry-size gates to zero because this
+   project's kernels are many small programs, each individually below the
+   default 1 s threshold.
+
+2. The **executable cache** memoizes AOT-compiled (``.lower().compile()``)
+   batch kernels *within* a process, keyed by (mode, backend, plan, shape):
+   the engine pre-lowers its per-(base, limb-plan, mode) kernels at field
+   start, so server fields and bench modes never pay jit dispatch-time
+   tracing mid-field, and a second field of the same shape is a pure cache
+   hit.
+
+Both layers report into ``nice_compile_cache_events_total`` so bench/CI can
+assert cache hits instead of inferring them from wall time alone.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from nice_tpu.obs.series import COMPILE_CACHE_EVENTS
+
+_lock = threading.Lock()
+_setup_done = [False]
+_executables: dict = {}
+
+# jax.monitoring event names -> our counter labels. Both exist in jax 0.4.x;
+# "request" counts every compilation that consulted the persistent cache,
+# "hit" the subset served from disk.
+_EVENTS = {
+    "/jax/compilation_cache/cache_hits": ("persistent", "hit"),
+    "/jax/compilation_cache/compile_requests_use_cache": (
+        "persistent",
+        "request",
+    ),
+}
+
+
+def _listener(event, **kwargs):
+    labels = _EVENTS.get(event)
+    if labels is not None:
+        COMPILE_CACHE_EVENTS.labels(*labels).inc()
+
+
+def setup() -> None:
+    """Idempotent: enable the persistent compilation cache (when a directory
+    is configured) and start counting its hits. Safe to call per field."""
+    with _lock:
+        if _setup_done[0]:
+            return
+        _setup_done[0] = True
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    if getattr(jax.config, "jax_compilation_cache_dir", None):
+        # The defaults (min 1 s compile, min 64 KiB entry) would exclude
+        # every kernel in this repo — they are many small programs.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except AttributeError:
+            pass  # option absent on older jax; the time gate suffices
+    try:
+        jax.monitoring.register_event_listener(_listener)
+    except Exception:  # pragma: no cover - monitoring API drift
+        pass
+
+
+def aot(jitted, *args):
+    """AOT-compile a jitted function at example args (ShapeDtypeStructs are
+    fine for the dynamic ones). The returned executable takes only the
+    dynamic args — static_argnums are burned in at lowering time."""
+    return jitted.lower(*args).compile()
+
+
+def executable(key, build):
+    """Get-or-build a compiled executable. ``build`` runs outside the lock
+    (compiles can take seconds); a racing duplicate build is discarded."""
+    with _lock:
+        ex = _executables.get(key)
+    if ex is not None:
+        COMPILE_CACHE_EVENTS.labels("executable", "hit").inc()
+        return ex
+    ex = build()
+    with _lock:
+        prior = _executables.get(key)
+        if prior is None:
+            _executables[key] = ex
+    if prior is None:
+        COMPILE_CACHE_EVENTS.labels("executable", "miss").inc()
+        return ex
+    COMPILE_CACHE_EVENTS.labels("executable", "hit").inc()
+    return prior
+
+
+def counts() -> dict:
+    """Current cache-event counters (for bench/CI assertions)."""
+    c = COMPILE_CACHE_EVENTS
+    return {
+        "persistent_hits": c.value(("persistent", "hit")),
+        "persistent_requests": c.value(("persistent", "request")),
+        "executable_hits": c.value(("executable", "hit")),
+        "executable_misses": c.value(("executable", "miss")),
+    }
+
+
+def reset_for_tests() -> None:
+    """Drop the in-process executable cache (counters are left alone)."""
+    with _lock:
+        _executables.clear()

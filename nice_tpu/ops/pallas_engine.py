@@ -414,3 +414,59 @@ def uniques_batch(plan: BasePlan, batch_size: int, start_limbs,
     block_rows = _effective_block_rows(batch_size, block_rows)
     with _timed("uniques"):
         return _uniques_callable(plan, batch_size, block_rows)(start_limbs)
+
+
+@functools.lru_cache(maxsize=None)
+def _survivors_callable(plan: BasePlan, batch_size: int, thresh: int,
+                        cap: int, block_rows: int):
+    """Pallas twin of ve.survivors_batch: the per-lane uniques kernel plus the
+    shared compaction tail fused under ONE jit, so the full uniques array
+    stays in device memory — only the (count, idx[cap], uniq[cap]) compacted
+    result ever crosses the bus."""
+    uniques_call = _uniques_callable(plan, batch_size, block_rows)
+
+    @jax.jit
+    def run(start_limbs, valid_count):
+        uniques = uniques_call(start_limbs)
+        lane = jnp.arange(batch_size, dtype=jnp.int32)
+        return ve.compact_survivors(
+            uniques, lane < valid_count, thresh, cap
+        )
+
+    return run
+
+
+def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
+                    start_limbs, valid_count, block_rows: int = BLOCK_ROWS):
+    """Compacted rare-path extraction (count, idx[cap], uniq[cap]) of lanes
+    with num_uniques > thresh; see ve.survivors_batch for semantics."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _survivors_callable(plan, batch_size, thresh, cap, block_rows)
+    with _timed("survivors"):
+        return run(start_limbs, valid_count)
+
+
+@functools.lru_cache(maxsize=None)
+def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int):
+    """Detailed stats kernel folding into a device-resident accumulator
+    (donated i32[base+2]); see ve.detailed_accum_batch."""
+    stats_call = _stats_callable(plan, "detailed", batch_size, block_rows)
+    width = plan.base + 2
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(hist_acc, start_limbs, valid_count):
+        hist, nm = stats_call(start_limbs, valid_count)
+        return hist_acc + hist[:width], nm
+
+    return run
+
+
+def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
+                         start_limbs, valid_count,
+                         block_rows: int = BLOCK_ROWS):
+    """detailed_batch folded into a device-resident histogram accumulator
+    (hist_acc i32[base+2], donated); returns (new_acc, near_miss_count)."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _detailed_accum_callable(plan, batch_size, block_rows)
+    with _timed("detailed"):
+        return run(hist_acc, start_limbs, valid_count)

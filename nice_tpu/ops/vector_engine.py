@@ -271,6 +271,58 @@ def uniques_batch(plan: BasePlan, batch_size: int, start_limbs):
     return num_uniques_lanes(plan, n)
 
 
+def compact_survivors(uniques, valid, thresh: int, cap: int):
+    """On-device survivor compaction: prefix-sum scatter of the lanes with
+    num_uniques > thresh into cap-sized output arrays.
+
+    Returns (count i32, idx i32[cap], uniq i32[cap]): surviving lane indices
+    (ascending) and their uniques counts, with entries >= count undefined
+    (zeros). Survivors past cap are dropped — callers compare count against
+    cap and re-run dense on overflow. The point: a readback transfers
+    2*cap + 1 words instead of the full per-lane array (the device-side
+    analog of the reference only shipping hit indices back from its GPU
+    prefilter, client_process_gpu.rs:407-413).
+    """
+    mask = valid & (uniques > thresh)
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    # Non-survivors (and overflow survivors) get an out-of-range target;
+    # mode="drop" discards them in-graph, no host round trip.
+    tgt = jnp.where(mask, pos, cap)
+    lane = jnp.arange(uniques.shape[0], dtype=jnp.int32)
+    idx = jnp.zeros(cap, jnp.int32).at[tgt].set(lane, mode="drop")
+    uniq = jnp.zeros(cap, jnp.int32).at[tgt].set(uniques, mode="drop")
+    return jnp.sum(mask.astype(jnp.int32)), idx, uniq
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
+                    start_limbs, valid_count):
+    """Compacted rare-path extraction: (count, idx[cap], uniq[cap]) of lanes
+    with num_uniques > thresh. thresh = near_miss_cutoff serves detailed;
+    thresh = base - 1 serves niceonly (uniques > base-1 <=> == base)."""
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    uniques = num_uniques_lanes(plan, n)
+    lane = jnp.arange(batch_size, dtype=jnp.int32)
+    return compact_survivors(uniques, lane < valid_count, thresh, cap)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,))
+def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
+                         start_limbs, valid_count):
+    """detailed_batch folded into a DEVICE-RESIDENT histogram accumulator.
+
+    hist_acc (i32[base+2], donated) is carried across batches on the device;
+    only the near-miss scalar crosses the bus per batch, and the accumulator
+    itself transfers once per field (engine.process_range_detailed flushes it
+    well before i32 bins could saturate). Padding lanes land in bin 0, which
+    no consumer reads (distributions report bins 1..base)."""
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    uniques = num_uniques_lanes(plan, n)
+    lane = jnp.arange(batch_size, dtype=jnp.int32)
+    hist, nm = detailed_from_uniques(plan, uniques, lane < valid_count)
+    return hist_acc + hist, nm
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
     """Count of fully nice lanes in a dense range batch."""

@@ -28,6 +28,23 @@ from nice_tpu.ops.limbs import BasePlan
 FIELD_AXIS = "field"
 
 
+def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map(..., check_vma=)` on
+    current jax, `jax.experimental.shard_map.shard_map(..., check_rep=)` on
+    0.4.x. Replication checking is off either way — every step here returns
+    explicitly psum'd (or deliberately sharded) outputs."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def _timed_step(fn, mode: str):
     """Wrap a jitted sharded step so each dispatch lands in
     nice_mesh_dispatch_seconds{mode=...} (async enqueue cost under jit)."""
@@ -85,12 +102,8 @@ def make_sharded_detailed_step(plan: BasePlan, per_device_batch: int, mesh: Mesh
         nm = jax.lax.psum(nm, FIELD_AXIS)
         return hist, nm
 
-    sharded = jax.shard_map(
-        device_step,
-        mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
+    sharded = _shard_map(
+        device_step, mesh, in_specs=(P(), P()), out_specs=(P(), P())
     )
     return jax.jit(sharded)
 
@@ -122,17 +135,7 @@ def make_sharded_stats_step(
     """
     from nice_tpu.ops import pallas_engine as pe
 
-    if kernel == "auto":
-        kernel = (
-            "pallas"
-            if (
-                jax.default_backend() == "tpu"
-                and pe.supports_base(plan)
-                and per_device_batch % 128 == 0
-            )
-            else "jnp"
-        )
-
+    kernel = _resolve_kernel(plan, per_device_batch, kernel)
     mod = pe if kernel == "pallas" else ve
     if mode == "detailed":
         run = lambda start, valid: mod.detailed_batch(  # noqa: E731
@@ -151,14 +154,86 @@ def make_sharded_stats_step(
             return jax.lax.psum(hist, FIELD_AXIS), count
         return count
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         device_step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS)),
         out_specs=(P(), P()) if mode == "detailed" else P(),
-        check_vma=False,
     )
     return _timed_step(jax.jit(sharded), mode)
+
+
+def _resolve_kernel(plan: BasePlan, per_device_batch: int, kernel: str):
+    """Shared "auto" resolution: pallas iff it would be picked single-chip."""
+    from nice_tpu.ops import pallas_engine as pe
+
+    if kernel != "auto":
+        return kernel
+    return (
+        "pallas"
+        if (
+            jax.default_backend() == "tpu"
+            and pe.supports_base(plan)
+            and per_device_batch % 128 == 0
+        )
+        else "jnp"
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_stats_accum_step(
+    plan: BasePlan,
+    per_device_batch: int,
+    mesh: Mesh,
+    kernel: str = "auto",
+):
+    """Detailed step with a DEVICE-RESIDENT per-device histogram accumulator.
+
+    Each device folds its batch histogram into its own row of a sharded
+    accumulator (donated, so the buffer is carried across batches in place);
+    only the psum'd near-miss scalar is replicated per batch. The accumulator
+    rows stay un-reduced until make_sharded_stats_fold performs the single
+    per-field psum — one collective per field for the histogram instead of
+    one per batch (ISSUE 2 tentpole part 2).
+
+    Returns fn(hist_acc i32[n_dev, base+2] sharded on FIELD_AXIS,
+               starts u32[n_dev, limbs_n], valids i32[n_dev])
+      -> (new_hist_acc, sharded; near_miss_count i32, replicated)
+    """
+    from nice_tpu.ops import pallas_engine as pe
+
+    kernel = _resolve_kernel(plan, per_device_batch, kernel)
+    mod = pe if kernel == "pallas" else ve
+    width = plan.base + 2
+
+    def device_step(hist_row, start_row, valid_row):
+        hist, nm = mod.detailed_batch(
+            plan, per_device_batch, start_row[0], valid_row[0]
+        )
+        return hist_row + hist[None, :width], jax.lax.psum(nm, FIELD_AXIS)
+
+    sharded = _shard_map(
+        device_step,
+        mesh,
+        in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS, None), P(FIELD_AXIS)),
+        out_specs=(P(FIELD_AXIS, None), P()),
+    )
+    return _timed_step(jax.jit(sharded, donate_argnums=(0,)), "detailed-accum")
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_stats_fold(mesh: Mesh):
+    """The field-end reduction paired with make_sharded_stats_accum_step:
+    ONE psum of the per-device accumulator rows over ICI, returning the
+    replicated full-field histogram."""
+
+    def device_fold(hist_row):
+        return jax.lax.psum(hist_row[0], FIELD_AXIS)
+
+    sharded = _shard_map(
+        device_fold, mesh, in_specs=(P(FIELD_AXIS, None),), out_specs=P()
+    )
+    return _timed_step(jax.jit(sharded), "stats-fold")
 
 
 @functools.lru_cache(maxsize=None)
@@ -182,12 +257,11 @@ def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
             desc, n_real[0]
         )
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         device_step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS)),
         out_specs=P(FIELD_AXIS, None),
-        check_vma=False,
     )
     return _timed_step(jax.jit(sharded), "strided")
 
@@ -210,11 +284,7 @@ def make_sharded_niceonly_step(plan: BasePlan, per_device_batch: int, mesh: Mesh
         count = jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
         return jax.lax.psum(count, FIELD_AXIS)
 
-    sharded = jax.shard_map(
-        device_step,
-        mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=P(),
-        check_vma=False,
+    sharded = _shard_map(
+        device_step, mesh, in_specs=(P(), P()), out_specs=P()
     )
     return jax.jit(sharded)

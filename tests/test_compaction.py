@@ -1,0 +1,182 @@
+"""Differential tests for the compacted-readback pipeline (PR 2).
+
+Three equivalences, each asserted non-vacuously (the accept path must fire):
+
+1. compacted survivor readback == dense per-lane scan, for both the detailed
+   threshold (near_miss_cutoff) and the niceonly threshold (base - 1), on
+   ranges that actually contain accepts — plus a lowered-threshold rich range
+   so compaction is exercised with many survivors, and the overflow path.
+2. device-resident histogram accumulation across a multi-batch field == the
+   old per-batch host fold, for the jnp graph and the Pallas twin.
+3. the sharded accumulate-then-fold step pair == the per-batch psum step on
+   the virtual 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nice_tpu.core import base_range
+from nice_tpu.obs.series import ENGINE_SURVIVOR_OVERFLOW
+from nice_tpu.ops import engine
+from nice_tpu.ops import pallas_engine as pe
+from nice_tpu.ops import vector_engine as ve
+from nice_tpu.ops.limbs import get_plan, int_to_limbs
+
+MODS = pytest.mark.parametrize("mod", [ve, pe], ids=["jnp", "pallas"])
+
+
+def _dense_survivors(plan, batch_size, start, valid, thresh):
+    """Oracle: full per-lane uniques readback, host-side filter."""
+    u = np.asarray(
+        ve.uniques_batch(plan, batch_size, int_to_limbs(start, plan.limbs_n))
+    )[:valid]
+    lanes = np.nonzero(u > thresh)[0]
+    return lanes, u[lanes]
+
+
+@MODS
+@pytest.mark.parametrize("thresh_kind", ["near_miss", "nice"])
+def test_survivors_match_dense_b10(mod, thresh_kind):
+    # b10's [47, 100) holds exactly one accept at either threshold: 69
+    # (num_uniques == 10 > cutoff 9 and > base-1 9) — sparse but non-vacuous.
+    plan = get_plan(10)
+    batch_size, start, valid = 128, 47, 53  # pallas blocks need %128 == 0
+    thresh = (
+        plan.near_miss_cutoff if thresh_kind == "near_miss" else plan.base - 1
+    )
+    count, idx, uniq = mod.survivors_batch(
+        plan, batch_size, thresh, 16, int_to_limbs(start, plan.limbs_n),
+        np.int32(valid),
+    )
+    count = int(np.asarray(count))
+    lanes, dense_u = _dense_survivors(plan, batch_size, start, valid, thresh)
+    assert count == len(lanes) > 0  # the accept path fired
+    np.testing.assert_array_equal(np.asarray(idx)[:count], lanes)
+    np.testing.assert_array_equal(np.asarray(uniq)[:count], dense_u)
+    assert start + int(np.asarray(idx)[0]) == 69
+
+
+@MODS
+def test_survivors_match_dense_rich_range(mod):
+    # Lowered threshold => many survivors per batch: compaction is exercised
+    # with a dense scatter, not just a single hit.
+    plan = get_plan(17)
+    start = base_range.get_base_range(17)[0]
+    batch_size, valid, thresh = 512, 500, plan.base - 6
+    lanes, dense_u = _dense_survivors(plan, batch_size, start, valid, thresh)
+    assert len(lanes) > 50, "range not accept-rich; test is vacuous"
+    count, idx, uniq = mod.survivors_batch(
+        plan, batch_size, thresh, batch_size,
+        int_to_limbs(start, plan.limbs_n), np.int32(valid),
+    )
+    count = int(np.asarray(count))
+    assert count == len(lanes)
+    np.testing.assert_array_equal(np.asarray(idx)[:count], lanes)
+    np.testing.assert_array_equal(np.asarray(uniq)[:count], dense_u)
+
+
+def test_survivors_overflow_keeps_ordered_prefix():
+    # Survivors past cap are dropped in-graph; the returned count still
+    # reports the true total so callers can detect the overflow.
+    plan = get_plan(17)
+    start = base_range.get_base_range(17)[0]
+    batch_size, valid, thresh, cap = 512, 500, plan.base - 6, 4
+    lanes, dense_u = _dense_survivors(plan, batch_size, start, valid, thresh)
+    assert len(lanes) > cap
+    count, idx, uniq = ve.survivors_batch(
+        plan, batch_size, thresh, cap, int_to_limbs(start, plan.limbs_n),
+        np.int32(valid),
+    )
+    assert int(np.asarray(count)) == len(lanes)
+    np.testing.assert_array_equal(np.asarray(idx), lanes[:cap])
+    np.testing.assert_array_equal(np.asarray(uniq), dense_u[:cap])
+
+
+def test_rare_scan_overflow_falls_back_dense(monkeypatch):
+    # When a sub-batch's survivor count overflows the cap, the engine re-runs
+    # that sub-batch dense — results identical, overflow counter ticked.
+    plan = get_plan(17)
+    start = base_range.get_base_range(17)[0]
+    batch_size, valid, thresh = 512, 500, plan.base - 6
+    monkeypatch.setattr(engine, "SURVIVOR_CAP", 2)
+    before = ENGINE_SURVIVOR_OVERFLOW.value()
+    got = list(
+        engine._rare_scan_survivors(plan, start, valid, batch_size, "jax",
+                                    thresh)
+    )
+    lanes, dense_u = _dense_survivors(plan, batch_size, start, valid, thresh)
+    assert got == [
+        (start + int(i), int(u)) for i, u in zip(lanes, dense_u)
+    ]
+    assert len(got) > 2  # overflowed the patched cap
+    assert ENGINE_SURVIVOR_OVERFLOW.value() > before
+
+
+@MODS
+def test_detailed_accum_matches_per_batch_fold(mod):
+    # Chain the donated device-resident accumulator across a multi-batch
+    # field (ragged tail included) and compare against per-batch
+    # detailed_batch readbacks folded on the host — the pre-PR shape.
+    plan = get_plan(17)
+    start0 = base_range.get_base_range(17)[0]
+    batch_size, n_batches, width = 256, 5, plan.base + 2
+    acc = jnp.zeros(width, jnp.int32)
+    host = np.zeros(width, np.int64)
+    nm_accum, nm_ref = [], []
+    total_valid = 0
+    for k in range(n_batches):
+        limbs = int_to_limbs(start0 + k * batch_size, plan.limbs_n)
+        valid = np.int32(batch_size - (37 if k == n_batches - 1 else 0))
+        total_valid += int(valid)
+        acc, nm = mod.detailed_accum_batch(plan, batch_size, acc, limbs, valid)
+        nm_accum.append(int(np.asarray(nm)))
+        hist, nm2 = ve.detailed_batch(plan, batch_size, limbs, valid)
+        host += np.asarray(hist)[:width].astype(np.int64)
+        nm_ref.append(int(np.asarray(nm2)))
+    assert nm_accum == nm_ref
+    got = np.asarray(acc, dtype=np.int64)
+    np.testing.assert_array_equal(got, host)
+    # Non-vacuous: every valid lane landed in a real bin (1..base).
+    assert int(got[1: plan.base + 1].sum()) == total_valid
+
+
+def test_sharded_accum_fold_matches_psum_step():
+    # Tentpole 2 on the mesh: N batches through the accumulate step + ONE
+    # fold == N batches through the old per-batch-psum step.
+    from nice_tpu.parallel import mesh as pmesh
+
+    n_dev = len(jax.devices())
+    assert n_dev >= 8, "conftest must force 8 virtual CPU devices"
+    mesh = pmesh.make_mesh()
+    plan = get_plan(17)
+    start0 = base_range.get_base_range(17)[0]
+    per_dev, n_batches, width = 64, 4, plan.base + 2
+    lanes = per_dev * n_dev
+    end = start0 + n_batches * lanes
+
+    accum = pmesh.make_sharded_stats_accum_step(plan, per_dev, mesh,
+                                                kernel="jnp")
+    fold = pmesh.make_sharded_stats_fold(mesh)
+    ref = pmesh.make_sharded_stats_step(plan, per_dev, mesh, "detailed",
+                                        kernel="jnp")
+
+    acc = np.zeros((n_dev, width), dtype=np.int32)
+    ref_hist = np.zeros(width, np.int64)
+    nm_accum, nm_ref = [], []
+    for k in range(n_batches):
+        batch_start = start0 + k * lanes
+        valid = lanes - (29 if k == n_batches - 1 else 0)  # ragged tail
+        starts, valids = engine._shard_inputs(
+            plan, end, batch_start, valid, per_dev, n_dev
+        )
+        acc, nm = accum(acc, starts, valids)
+        nm_accum.append(int(np.asarray(nm)))
+        hist, nm2 = ref(starts, valids)
+        ref_hist += np.asarray(hist)[:width].astype(np.int64)
+        nm_ref.append(int(np.asarray(nm2)))
+    assert nm_accum == nm_ref
+    folded = np.asarray(fold(acc), dtype=np.int64)
+    np.testing.assert_array_equal(folded, ref_hist)
+    assert int(folded[1: plan.base + 1].sum()) > 0

@@ -40,6 +40,39 @@ def test_cpu_monitor_zero_delta(monkeypatch):
     assert m.sample() == 0.0  # no jiffies elapsed: report idle, not NaN
 
 
+def test_pick_cpu_backend_never_none_when_proc_exists():
+    if os.path.exists("/proc/stat"):
+        assert daemon.pick_cpu_backend() == "proc"
+    else:
+        assert daemon.pick_cpu_backend() in ("psutil", "loadavg", "none")
+
+
+def test_cpu_monitor_psutil_backend(monkeypatch):
+    psutil = pytest.importorskip("psutil")
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    m = daemon.CpuMonitor(interval_secs=0, backend="psutil")
+    assert m.backend == "psutil"
+    monkeypatch.setattr(psutil, "cpu_percent", lambda interval=None: 42.0)
+    assert abs(m.sample() - 0.42) < 1e-9
+
+
+def test_cpu_monitor_loadavg_backend(monkeypatch):
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    m = daemon.CpuMonitor(interval_secs=0, backend="loadavg")
+    cores = os.cpu_count() or 1
+    monkeypatch.setattr(os, "getloadavg", lambda: (cores / 2, 0.0, 0.0))
+    assert abs(m.sample() - 0.5) < 1e-9
+    # loadavg can exceed core count under overload; usage clips at 1.0.
+    monkeypatch.setattr(os, "getloadavg", lambda: (cores * 3.0, 0.0, 0.0))
+    assert m.sample() == 1.0
+
+
+def test_cpu_monitor_none_backend_reports_idle(monkeypatch):
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    m = daemon.CpuMonitor(interval_secs=0, backend="none")
+    assert m.sample() == 0.0
+
+
 def test_process_manager_lifecycle(monkeypatch):
     # Substitute a trivial child so the test never launches a real client.
     calls = []

@@ -241,7 +241,7 @@ def test_detailed_collector_propagates_failure(monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("rare path exploded")
 
-    monkeypatch.setattr(engine, "_rare_scan_uniques", boom)
+    monkeypatch.setattr(engine, "_rare_scan_survivors", boom)
     br = base_range.get_base_range_field(10)  # contains 69 -> rare path fires
     with pytest.raises(RuntimeError, match="rare path exploded"):
         engine.process_range_detailed(br, 10, backend="pallas", batch_size=BL)

@@ -1,0 +1,205 @@
+"""Per-field checkpoint lifecycle: save / validate / resume / delete.
+
+The engine produces opaque resume states ({cursor, hist, nice_numbers} — see
+ops/engine.py's checkpoint_cb contract); this module binds one such stream to
+a claimed field and a checkpoint directory:
+
+  * FieldCheckpointer.save is the engine's checkpoint_cb — each call writes
+    one atomic snapshot (ckpt/snapshot.py) carrying the field identity, the
+    plan signature, and the scan state;
+  * load() re-validates everything before any resume happens: CRC/version at
+    the format layer, then the plan signature (mode, base, batch size,
+    backend, jax fingerprint) and the field identity. A stale or mismatched
+    snapshot is rejected (counted by reason, file removed) and the caller
+    restarts the scan cleanly — never a silent resume into wrong state;
+  * find_resumable() is the client's startup scan: the newest valid snapshot
+    in the directory wins, so a restarted client picks up the same claim it
+    died holding instead of claiming a fresh field.
+
+Numbers that can exceed u64 (candidates run past 2^64 at bases 60+) travel
+as decimal strings in the manifest; only the histogram rides in the binary
+payload.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from nice_tpu.ckpt.snapshot import SnapshotError, read_snapshot, write_snapshot
+from nice_tpu.core.types import DataToClient, SearchMode
+from nice_tpu.obs.series import CKPT_BYTES, CKPT_REJECTED, CKPT_WRITES
+
+log = logging.getLogger("nice_tpu.ckpt")
+
+
+def plan_signature(mode: SearchMode, base: int, backend: str, batch_size: int) -> dict:
+    """The compatibility fingerprint a snapshot must match to be resumed.
+
+    Everything that changes what a batch cursor MEANS (mode, base, backend,
+    batch size) plus the jax runtime fingerprint for device backends — a
+    snapshot from a different jax build or platform is rejected rather than
+    trusted across an upgrade boundary."""
+    if backend in ("jax", "jnp", "pallas"):
+        import jax
+
+        runtime = f"jax-{jax.__version__}-{jax.default_backend()}"
+    else:
+        runtime = "host"
+    return {
+        "mode": "detailed" if mode == SearchMode.DETAILED else "niceonly",
+        "base": base,
+        "backend": backend,
+        "batch_size": batch_size,
+        "runtime": runtime,
+    }
+
+
+def _state_to_snapshot(state: dict) -> tuple[dict, dict[str, np.ndarray]]:
+    manifest = {
+        "cursor": str(int(state["cursor"])),
+        "nice_numbers": [
+            [str(int(n)), int(u)] for n, u in state["nice_numbers"]
+        ],
+        "near_miss_count": len(state["nice_numbers"]),
+    }
+    arrays: dict[str, np.ndarray] = {}
+    if state.get("hist") is not None:
+        arrays["hist"] = np.asarray(state["hist"], dtype=np.int64)
+    return manifest, arrays
+
+
+def _snapshot_to_state(manifest: dict, arrays: dict[str, np.ndarray]) -> dict:
+    return {
+        "cursor": int(manifest["cursor"]),
+        "hist": arrays.get("hist"),
+        "nice_numbers": [
+            (int(n), int(u)) for n, u in manifest["nice_numbers"]
+        ],
+    }
+
+
+class FieldCheckpointer:
+    """Checkpoint stream for one claimed field.
+
+    save() is safe to hand to the engine as checkpoint_cb (it is invoked from
+    the collector thread); load()/delete() run on the client main thread
+    between fields, never concurrently with save().
+    """
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        data: DataToClient,
+        mode: SearchMode,
+        backend: str,
+        batch_size: int,
+    ):
+        self.dir = ckpt_dir
+        self.data = data
+        self.mode = mode
+        self.signature = plan_signature(mode, data.base, backend, batch_size)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self.path = os.path.join(ckpt_dir, f"claim-{data.claim_id}.ckpt")
+
+    # -- write side (engine checkpoint_cb) --------------------------------
+
+    def save(self, state: dict) -> None:
+        manifest, arrays = _state_to_snapshot(state)
+        manifest["signature"] = self.signature
+        manifest["field"] = self.data.to_json()
+        nbytes = write_snapshot(self.path, manifest, arrays)
+        CKPT_WRITES.inc()
+        CKPT_BYTES.inc(nbytes)
+        log.debug(
+            "checkpoint: claim %d cursor %s (%d bytes)",
+            self.data.claim_id, manifest["cursor"], nbytes,
+        )
+
+    # -- read side ---------------------------------------------------------
+
+    def load(self) -> Optional[dict]:
+        """Validated resume state, or None (no snapshot / rejected one).
+
+        A rejected snapshot is deleted so the scan restarts cleanly and the
+        next checkpoint overwrites nothing stale."""
+        try:
+            manifest, arrays = read_snapshot(self.path)
+        except FileNotFoundError:
+            return None
+        except SnapshotError as e:
+            log.warning("rejecting snapshot %s: %s", self.path, e)
+            CKPT_REJECTED.labels(e.reason).inc()
+            self.delete()
+            return None
+        if (
+            manifest.get("signature") != self.signature
+            or manifest.get("field") != self.data.to_json()
+        ):
+            log.warning(
+                "rejecting snapshot %s: plan signature/field mismatch "
+                "(snapshot %s/%s, current %s/%s)",
+                self.path, manifest.get("signature"), manifest.get("field"),
+                self.signature, self.data.to_json(),
+            )
+            CKPT_REJECTED.labels("signature").inc()
+            self.delete()
+            return None
+        return _snapshot_to_state(manifest, arrays)
+
+    def delete(self) -> None:
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def find_resumable(
+    ckpt_dir: str, mode: SearchMode, backend: str, batch_size: int
+) -> Optional[tuple[DataToClient, dict, "FieldCheckpointer"]]:
+    """Startup scan: newest snapshot in ckpt_dir whose plan signature matches
+    the current configuration. Returns (field, resume_state, checkpointer) or
+    None. Snapshots that fail structural validation are rejected and removed;
+    signature mismatches (e.g. a niceonly snapshot found by a detailed
+    client) are left alone — another configuration may still resume them."""
+    paths = sorted(
+        glob.glob(os.path.join(ckpt_dir, "claim-*.ckpt")),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    for path in paths:
+        try:
+            manifest, arrays = read_snapshot(path)
+        except FileNotFoundError:
+            continue
+        except SnapshotError as e:
+            log.warning("rejecting snapshot %s: %s", path, e)
+            CKPT_REJECTED.labels(e.reason).inc()
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            continue
+        try:
+            data = DataToClient.from_json(manifest["field"])
+        except (KeyError, TypeError, ValueError):
+            log.warning("rejecting snapshot %s: malformed field record", path)
+            CKPT_REJECTED.labels("corrupt").inc()
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            continue
+        ckptr = FieldCheckpointer(ckpt_dir, data, mode, backend, batch_size)
+        if manifest.get("signature") != ckptr.signature:
+            log.info(
+                "snapshot %s has a different plan signature; not resuming it "
+                "under this configuration", path,
+            )
+            continue
+        return data, _snapshot_to_state(manifest, arrays), ckptr
+    return None

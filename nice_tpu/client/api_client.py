@@ -57,7 +57,7 @@ def retry_request(
     """GET/POST with exponential backoff on 5xx and network errors.
 
     endpoint labels the per-attempt latency histogram and retry counter
-    (claim / submit / validate / other)."""
+    (claim / submit / validate / renew / other)."""
     attempt = 0
     while True:
         t0 = time.monotonic()
@@ -111,6 +111,21 @@ def submit_field_to_server(
     retry_request(
         f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries,
         endpoint="submit",
+    )
+
+
+def renew_claim(
+    api_base: str, claim_id: int, max_retries: int = 1
+) -> None:
+    """POST /renew_claim — lease heartbeat while a long field scans.
+
+    Low default retry budget on purpose: a missed heartbeat is harmless (the
+    next one, or the submit itself, lands well inside the expiry window), so
+    the renewer thread must never sit in a 10-deep backoff while the scan it
+    protects finishes."""
+    retry_request(
+        f"{api_base}/renew_claim", {"claim_id": claim_id},
+        max_retries=max_retries, endpoint="renew",
     )
 
 

@@ -23,9 +23,10 @@ import sys
 import time
 from typing import Optional
 
-from nice_tpu import CLIENT_VERSION, obs
+from nice_tpu import CLIENT_VERSION, ckpt, obs
 from nice_tpu.client import api_client
 from nice_tpu.obs.series import (
+    CKPT_RENEWALS,
     CLIENT_FIELD_SECONDS,
     CLIENT_FIELDS,
     CLIENT_NUMBERS,
@@ -112,6 +113,28 @@ def build_parser() -> argparse.ArgumentParser:
         "(env NICE_PROGRESS_SECS)",
     )
     p.add_argument(
+        "--checkpoint-dir",
+        default=_env("CHECKPOINT_DIR", None),
+        help="directory for crash-safe field-scan snapshots; enables "
+        "periodic checkpointing and auto-resume of an interrupted claim on "
+        "startup (env NICE_CHECKPOINT_DIR)",
+    )
+    p.add_argument(
+        "--checkpoint-secs",
+        type=float,
+        default=float(_env("CHECKPOINT_SECS", 30)),
+        help="seconds between snapshots while scanning (env "
+        "NICE_CHECKPOINT_SECS; a batch-count trigger also fires every "
+        "NICE_TPU_CKPT_BATCHES dispatches)",
+    )
+    p.add_argument(
+        "--renew-secs",
+        type=float,
+        default=float(_env("RENEW_SECS", 900)),
+        help="seconds between claim-lease renewal heartbeats to "
+        "/renew_claim; 0 disables (env NICE_RENEW_SECS)",
+    )
+    p.add_argument(
         "--benchmark",
         default=_env("BENCHMARK", None),
         choices=[m.value for m in BenchmarkMode],
@@ -169,10 +192,15 @@ def _progress_logger(every_secs: float):
 
 def process_field(
     data: DataToClient, mode: SearchMode, backend: str, batch_size: int,
-    progress_secs: float = 0.0,
+    progress_secs: float = 0.0, *,
+    checkpointer=None, resume=None, checkpoint_secs=None,
 ) -> tuple[FieldResults, float]:
     """Process one field, returning results and elapsed seconds, logging the
-    reference's throughput line (client/src/main.rs:361-371)."""
+    reference's throughput line (client/src/main.rs:361-371).
+
+    checkpointer: optional ckpt.FieldCheckpointer whose save() becomes the
+    engine's checkpoint_cb; resume: a validated state from its load() (or
+    find_resumable) to continue from instead of restarting the scan."""
     if mode == SearchMode.DETAILED:
         # Pre-build this base's batch executables OUTSIDE the measured
         # window; after the first field per (base, batch, backend) this is a
@@ -181,6 +209,7 @@ def process_field(
     t0 = time.monotonic()
     rng = data.to_field_size()
     progress = _progress_logger(progress_secs)
+    checkpoint_cb = checkpointer.save if checkpointer is not None else None
     mode_label = "detailed" if mode == SearchMode.DETAILED else "niceonly"
     with obs.span(
         "client.process_field", base=data.base, size=data.range_size,
@@ -189,13 +218,16 @@ def process_field(
         if mode == SearchMode.DETAILED:
             results = engine.process_range_detailed(
                 rng, data.base, backend=backend, batch_size=batch_size,
-                progress=progress,
+                progress=progress, checkpoint_cb=checkpoint_cb,
+                resume=resume, checkpoint_secs=checkpoint_secs,
             )
         else:
             stride = get_stride_table(data.base, DEFAULT_LSD_K_VALUE)
             results = engine.process_range_niceonly(
                 rng, data.base, stride_table=stride, backend=backend,
                 batch_size=batch_size, progress=progress,
+                checkpoint_cb=checkpoint_cb, resume=resume,
+                checkpoint_secs=checkpoint_secs,
             )
     elapsed = time.monotonic() - t0
     CLIENT_FIELD_SECONDS.labels(mode_label).observe(elapsed)
@@ -310,7 +342,78 @@ def run_validate(args) -> int:
     return 1
 
 
-def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+class _ClaimRenewer:
+    """Background lease heartbeat for one claim: POSTs /renew_claim
+    immediately on entry (a resumed claim may be near expiry) and then every
+    every_secs. Failures are logged and swallowed — a missed heartbeat is
+    recoverable, killing the scan over one is not."""
+
+    def __init__(self, api_base: str, claim_id: int, every_secs: float):
+        import threading
+
+        self.api_base = api_base
+        self.claim_id = claim_id
+        self.every_secs = every_secs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="claim-renew", daemon=True
+        )
+
+    def _renew_once(self) -> None:
+        try:
+            api_client.renew_claim(self.api_base, self.claim_id)
+            CKPT_RENEWALS.inc()
+            log.debug("renewed claim %d lease", self.claim_id)
+        except Exception as e:
+            log.warning("claim %d lease renewal failed: %s", self.claim_id, e)
+
+    def _run(self) -> None:
+        self._renew_once()
+        while not self._stop.wait(self.every_secs):
+            self._renew_once()
+
+    def __enter__(self) -> "_ClaimRenewer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def _maybe_renewer(args, claim_id: int):
+    from contextlib import nullcontext
+
+    if args.renew_secs and args.renew_secs > 0 and claim_id > 0:
+        return _ClaimRenewer(args.api_base, claim_id, args.renew_secs)
+    return nullcontext()
+
+
+def _new_checkpointer(args, data: DataToClient, mode: SearchMode):
+    if not args.checkpoint_dir:
+        return None
+    return ckpt.FieldCheckpointer(
+        args.checkpoint_dir, data, mode, args.backend, args.batch_size
+    )
+
+
+def _resume_or_claim(args, api: api_client.AsyncApi, mode: SearchMode):
+    """(data, resume_state, checkpointer): the newest matching snapshot in
+    --checkpoint-dir if one exists (same claim, no re-claim round-trip), else
+    a fresh server claim."""
+    if args.checkpoint_dir:
+        found = ckpt.find_resumable(
+            args.checkpoint_dir, mode, args.backend, args.batch_size
+        )
+        if found is not None:
+            data, state, ckptr = found
+            log.info(
+                "resuming claim %d from checkpoint: base %d, range [%d, %d), "
+                "cursor %d",
+                data.claim_id, data.base, data.range_start, data.range_end,
+                state["cursor"],
+            )
+            return data, state, ckptr
     data = api.claim_async(mode).result()
     log.info(
         "claimed field (claim %d): base %d, range [%d, %d)",
@@ -319,35 +422,52 @@ def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> No
         data.range_start,
         data.range_end,
     )
-    results, _ = process_field(data, mode, args.backend, args.batch_size, args.progress_secs)
+    return data, None, _new_checkpointer(args, data, mode)
+
+
+def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+    data, resume, ckptr = _resume_or_claim(args, api, mode)
+    with _maybe_renewer(args, data.claim_id):
+        results, _ = process_field(
+            data, mode, args.backend, args.batch_size, args.progress_secs,
+            checkpointer=ckptr, resume=resume,
+            checkpoint_secs=args.checkpoint_secs,
+        )
     submission = compile_results(data, results, mode, args.username)
     api.submit_async(submission).result()
+    # Only a confirmed submit retires the snapshot; any failure before this
+    # point leaves it on disk for the next startup to resume.
+    if ckptr is not None:
+        ckptr.delete()
     log.info("submitted claim %d", data.claim_id)
 
 
 def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
     """claim N+1 || process N || submit N-1 (reference client/src/main.rs:411-562)."""
-    pending_submit = None
-    next_claim = api.claim_async(mode)
+    pending_submit = None  # (future, checkpointer) awaiting confirmation
+    data, resume, ckptr = _resume_or_claim(args, api, mode)
     stats_every = float(_env("STATS_SECS", 60))
     t_start = time.monotonic()
     last_stats = t_start
     fields = 0
     numbers = 0
     while True:
-        data = next_claim.result()
         next_claim = api.claim_async(mode)  # overlap with processing
-        log.info(
-            "claimed field (claim %d): base %d, size %s",
-            data.claim_id,
-            data.base,
-            f"{data.range_size:,}",
-        )
-        results, _ = process_field(data, mode, args.backend, args.batch_size, args.progress_secs)
+        with _maybe_renewer(args, data.claim_id):
+            results, _ = process_field(
+                data, mode, args.backend, args.batch_size, args.progress_secs,
+                checkpointer=ckptr, resume=resume,
+                checkpoint_secs=args.checkpoint_secs,
+            )
         if pending_submit is not None:
-            pending_submit.result()  # surface any submit error before queueing next
+            # Surface any submit error before queueing the next one; only a
+            # confirmed submit retires that field's snapshot.
+            prev_future, prev_ckptr = pending_submit
+            prev_future.result()
+            if prev_ckptr is not None:
+                prev_ckptr.delete()
         submission = compile_results(data, results, mode, args.username)
-        pending_submit = api.submit_async(submission)
+        pending_submit = (api.submit_async(submission), ckptr)
         fields += 1
         numbers += data.range_size
         now = time.monotonic()
@@ -359,6 +479,15 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
                 "(%s numbers/sec average)",
                 fields, f"{numbers:,}", up, f"{numbers / up:,.0f}",
             )
+        data = next_claim.result()
+        resume = None
+        ckptr = _new_checkpointer(args, data, mode)
+        log.info(
+            "claimed field (claim %d): base %d, size %s",
+            data.claim_id,
+            data.base,
+            f"{data.range_size:,}",
+        )
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -384,6 +513,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    if args.checkpoint_dir and args.backend == "native":
+        # The native engine's thread fan-out has no consistent cursor to
+        # snapshot; disable rather than write unresumable state.
+        log.warning(
+            "--checkpoint-dir is not supported with backend='native'; "
+            "checkpointing disabled"
+        )
+        args.checkpoint_dir = None
     if args.benchmark:
         return run_benchmark(args)
     if args.validate:

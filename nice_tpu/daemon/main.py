@@ -153,6 +153,13 @@ def main(argv=None) -> int:
     )
     p.add_argument("--log-level", default="info")
     p.add_argument(
+        "--checkpoint-dir",
+        default=os.environ.get("NICE_CHECKPOINT_DIR"),
+        help="passed through to the client: snapshot directory so a client "
+        "the daemon kills (busy CPU) or that crashes resumes its field on "
+        "the next spawn instead of abandoning the claim",
+    )
+    p.add_argument(
         "client_args",
         nargs="*",
         default=["--repeat"],
@@ -169,7 +176,10 @@ def main(argv=None) -> int:
     obs.maybe_serve_metrics()
     monitor = CpuMonitor(args.sample_interval)
     log.info("cpu sampler backend: %s", monitor.backend)
-    manager = ProcessManager(args.client_args or ["--repeat"])
+    client_args = list(args.client_args or ["--repeat"])
+    if args.checkpoint_dir and "--checkpoint-dir" not in client_args:
+        client_args += ["--checkpoint-dir", args.checkpoint_dir]
+    manager = ProcessManager(client_args)
     idle_since: Optional[float] = None
 
     try:

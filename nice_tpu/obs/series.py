@@ -122,6 +122,49 @@ CLIENT_FIELD_SECONDS = metrics.histogram(
              600.0, 1800.0),
 )
 
+# --- checkpoint subsystem (ckpt/, ops/engine.py, client/main.py) ---------
+CKPT_WRITES = metrics.counter(
+    "nice_engine_checkpoint_writes_total",
+    "Field-scan snapshots written (atomic manifest+payload files).",
+)
+CKPT_BYTES = metrics.counter(
+    "nice_engine_checkpoint_bytes_total",
+    "Bytes of snapshot data written to the checkpoint directory.",
+)
+CKPT_RESTORES = metrics.counter(
+    "nice_engine_checkpoint_restores_total",
+    "Field scans resumed from a validated snapshot instead of restarting.",
+)
+CKPT_BATCHES_SKIPPED = metrics.counter(
+    "nice_engine_checkpoint_batches_skipped_total",
+    "Dispatch batches skipped (not recomputed) thanks to a resumed cursor.",
+)
+CKPT_RENEWALS = metrics.counter(
+    "nice_engine_checkpoint_renewals_total",
+    "Successful /renew_claim heartbeats sent while scanning.",
+)
+CKPT_REJECTED = metrics.counter(
+    "nice_engine_checkpoint_rejected_total",
+    "Snapshots rejected on restore, by reason (corrupt CRC/truncation, "
+    "plan-signature mismatch, unknown format version).",
+    labelnames=("reason",),
+)
+
+# --- server (server/app.py, server/db.py) --------------------------------
+SERVER_CLAIM_EXPIRY = metrics.gauge(
+    "nice_server_claim_expiry_window_seconds",
+    "Configured claim-lease window: claims older than this are re-claimable "
+    "(NICE_TPU_CLAIM_EXPIRY_SECS; default CLAIM_DURATION_HOURS).",
+)
+SERVER_CLAIM_RENEWALS = metrics.counter(
+    "nice_server_claim_renewals_total",
+    "Claim leases renewed via /renew_claim.",
+)
+SERVER_FIELDS_RELEASED = metrics.counter(
+    "nice_server_fields_released_total",
+    "Pre-claimed queue fields released back to the DB on queue close.",
+)
+
 # --- daemon (daemon/main.py) --------------------------------------------
 DAEMON_HEARTBEAT = metrics.gauge(
     "nice_daemon_heartbeat_timestamp_seconds",
@@ -160,6 +203,8 @@ for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques",
     PALLAS_DISPATCH_SECONDS.labels(_kernel)
 for _phase in ("import-jax", "configure", "devices"):
     BACKEND_INIT_SECONDS.labels(_phase)
-for _endpoint in ("claim", "submit", "validate"):
+for _endpoint in ("claim", "submit", "validate", "renew"):
     CLIENT_REQUEST_SECONDS.labels(_endpoint)
     CLIENT_RETRIES.labels(_endpoint)
+for _reason in ("corrupt", "signature", "version"):
+    CKPT_REJECTED.labels(_reason)

@@ -17,6 +17,8 @@ oracle per sub-range.
 from __future__ import annotations
 
 import logging
+import os
+import time
 from collections import deque
 from typing import NamedTuple
 
@@ -36,6 +38,8 @@ from nice_tpu.ops import scalar
 from nice_tpu.ops.limbs import get_plan, int_to_limbs, ints_to_limbs
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.obs.series import (
+    CKPT_BATCHES_SKIPPED,
+    CKPT_RESTORES,
     ENGINE_AUDITS,
     ENGINE_BATCH_KERNEL_SECONDS,
     ENGINE_DESCRIPTORS,
@@ -94,6 +98,9 @@ class _Collector:
     On worker failure the queue is drained so producers' put() calls never
     block forever; shutdown() joins without raising (safe in a finally) and
     raise_if_failed() re-raises the worker's exception on the caller.
+    Use as a context manager: __exit__ always shuts the worker down, so a
+    KeyboardInterrupt (or any exception between construction and the dispatch
+    loop's own cleanup) can never leak the collector thread.
 
     occupancy: optional obs gauge tracking the in-flight window depth (queue
     backlog + the item being processed) — the live measure of whether the
@@ -133,6 +140,12 @@ class _Collector:
             while self._q.get() is not None:
                 pass  # drain so producers' puts never block forever
 
+    def __enter__(self) -> "_Collector":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
     def failed(self) -> bool:
         return self._err[0] is not None
 
@@ -148,6 +161,41 @@ class _Collector:
     def raise_if_failed(self) -> None:
         if self._err[0] is not None:
             raise self._err[0]
+
+
+# Periodic-checkpoint cadence defaults (overridable per call or via env).
+CKPT_EVERY_BATCHES = 256
+CKPT_EVERY_SECS = 30.0
+
+
+class _CkptTicker:
+    """Decides when a periodic checkpoint is due: every N batches or every T
+    seconds, whichever fires first (either can be 0 to disable that trigger).
+    Single-threaded by construction — each dispatch path owns one ticker and
+    tick()s it from exactly one thread."""
+
+    def __init__(self, every_batches=None, every_secs=None):
+        self.every_batches = int(
+            every_batches if every_batches is not None
+            else os.environ.get("NICE_TPU_CKPT_BATCHES", CKPT_EVERY_BATCHES)
+        )
+        self.every_secs = float(
+            every_secs if every_secs is not None
+            else os.environ.get("NICE_TPU_CKPT_SECS", CKPT_EVERY_SECS)
+        )
+        self._batches = 0
+        self._last = time.monotonic()
+
+    def tick(self) -> bool:
+        self._batches += 1
+        now = time.monotonic()
+        if (self.every_batches > 0 and self._batches >= self.every_batches) or (
+            self.every_secs > 0 and now - self._last >= self.every_secs
+        ):
+            self._batches = 0
+            self._last = now
+            return True
+        return False
 
 
 def _pick_backend(plan, batch_size: int, backend: str) -> str:
@@ -290,20 +338,92 @@ def _clamp_to_base_range(range_: FieldSize, base: int):
     return (pre, core, post)
 
 
-def _split_for_jax(range_: FieldSize, base: int, scalar_fn):
+def _split_for_jax(range_: FieldSize, base: int, scalar_fn,
+                   skip_slivers: bool = False):
     """Clamp to the base range; run scalar_fn on out-of-range slivers.
 
     Returns (core, sliver_results) where core may be None (range entirely
-    outside the base range — caller should go fully scalar).
+    outside the base range — caller should go fully scalar). skip_slivers
+    suppresses the sliver recomputation: a resumed scan's checkpoint state
+    already folded them in (slivers run up-front, before the first
+    checkpoint can fire).
     """
     pre, core, post = _clamp_to_base_range(range_, base)
     slivers = []
-    for part in (pre, post):
-        if part is None:
-            continue
-        ENGINE_HOST_FALLBACK.labels("sliver").inc()
-        slivers.append(scalar_fn(part))
+    if not skip_slivers:
+        for part in (pre, post):
+            if part is None:
+                continue
+            ENGINE_HOST_FALLBACK.labels("sliver").inc()
+            slivers.append(scalar_fn(part))
     return core, slivers
+
+
+def _chunked_host_scan(
+    range_: FieldSize, base: int, mode: str, chunk: int, progress,
+    checkpoint_cb, resume, every_batches, every_secs, stride_table=None,
+) -> FieldResults:
+    """Scalar-oracle scan in resumable chunks: the checkpoint/resume analog of
+    the device dispatch loops for backend='scalar' (and for ranges entirely
+    outside the base range). Cursor semantics match the device paths — a
+    checkpoint state covers every candidate in [range.start, cursor)."""
+    detailed = mode == "detailed"
+    hist = np.zeros(base + 2, dtype=np.int64) if detailed else None
+    nice: list[NiceNumberSimple] = []
+    start, total = range_.start(), range_.size()
+    chunk = max(1, chunk)
+    done = 0
+    if resume is not None:
+        done = min(total, max(0, int(resume["cursor"]) - start))
+        if detailed:
+            if resume.get("hist") is None:
+                raise ValueError("detailed resume state is missing a histogram")
+            h = np.asarray(resume["hist"], dtype=np.int64)
+            if h.shape != hist.shape:
+                raise ValueError(
+                    f"resume histogram shape {h.shape} != {hist.shape}"
+                )
+            hist[:] = h
+        nice = [
+            NiceNumberSimple(number=int(n), num_uniques=int(u))
+            for n, u in resume["nice_numbers"]
+        ]
+        CKPT_RESTORES.inc()
+        CKPT_BATCHES_SKIPPED.inc(done // chunk)
+        log.info(
+            "%s scalar resume: cursor %d (%d of %d numbers already done)",
+            mode, start + done, done, total,
+        )
+    ticker = (
+        _CkptTicker(every_batches, every_secs) if checkpoint_cb else None
+    )
+    while done < total:
+        n = min(chunk, total - done)
+        sub_range = FieldSize(start + done, start + done + n)
+        if detailed:
+            sub = scalar.process_range_detailed(sub_range, base)
+            for d in sub.distribution:
+                hist[d.num_uniques] += d.count
+        else:
+            sub = scalar.process_range_niceonly(sub_range, base, stride_table)
+        nice.extend(sub.nice_numbers)
+        done += n
+        if progress is not None:
+            progress(done, total)
+        if ticker is not None and ticker.tick():
+            checkpoint_cb({
+                "cursor": start + done,
+                "hist": None if hist is None else hist.copy(),
+                "nice_numbers": [(x.number, x.num_uniques) for x in nice],
+            })
+    nice.sort(key=lambda x: x.number)
+    if not detailed:
+        return FieldResults(distribution=(), nice_numbers=tuple(nice))
+    distribution = tuple(
+        UniquesDistributionSimple(num_uniques=i, count=int(hist[i]))
+        for i in range(1, base + 1)
+    )
+    return FieldResults(distribution=distribution, nice_numbers=tuple(nice))
 
 
 def _native_detailed(
@@ -775,9 +895,16 @@ def warm_niceonly(base: int, field_size: int = 0, field_start: int | None = None
         )
 
 
-def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
+def _niceonly_pallas(core: FieldSize, base: int, progress=None,
+                     checkpoint=None, checkpoint_batches=None,
+                     checkpoint_secs=None) -> list[int]:
     """Device niceonly: host MSD filter (coarse floor) -> stride-compacted
     descriptor batches on the TPU -> host re-scan of hit descriptors.
+
+    checkpoint: optional callable(watermark, found) fired from the collector
+    thread on the periodic cadence. Groups are collected strictly in order
+    and the MSD/stride gaps hold no nice numbers, so at call time `found`
+    holds EVERY nice number in [core.start, watermark).
 
     The heterogeneous pipeline of the reference GPU path
     (client_process_gpu.rs:589-709): the host filter produces range
@@ -1021,6 +1148,10 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
 
     audit_every = int(os.environ.get("NICE_TPU_AUDIT_EVERY", STRIDE_AUDIT_EVERY))
     audit_seen = [0]  # zero-count descriptors seen so far (audit phase)
+    ticker = (
+        _CkptTicker(checkpoint_batches, checkpoint_secs)
+        if checkpoint else None
+    )
 
     def collect_item(cols, counts_dev):
         # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
@@ -1060,6 +1191,13 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
                     )
                 ENGINE_AUDITS.inc()
             audit_seen[0] += len(zeros)
+        if ticker is not None and ticker.tick():
+            # Watermark = coverage frontier of this (in-order) group: the end
+            # of its last descriptor. Everything below it is either collected
+            # or a filter gap that provably holds no nice numbers.
+            g = k - 1
+            watermark = min(_at(cols, 2, g), _at(cols, 0, g) + span)
+            checkpoint(watermark, list(nice))
 
     def timed_collect_item(cols, counts_dev):
         t0 = time.monotonic()
@@ -1071,43 +1209,46 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None) -> list[int]:
     producer = threading.Thread(target=produce, name="niceonly-msd", daemon=True)
     t_wall0 = time.monotonic()
     producer.start()
-    collector = _Collector(
-        timed_collect_item, STRIDE_WINDOW, "niceonly-collect",
-        on_fail=stop.set, occupancy=ENGINE_STRIDE_OCCUPANCY,
-    )
     n_desc = 0
     # Dispatcher stall accounting: gen (host desc-gen + waiting on the
     # producer), disp (jax dispatch call), put (backpressure from the
     # collector/device window) — the trace tells which stage bounds the wall.
     t_gen = t_disp = t_put = 0.0
     try:
-        t0 = time.monotonic()
-        for cols in grouped_columns():
-            t1 = time.monotonic()
-            t_gen += t1 - t0
-            if collector.failed():
-                break
-            k_real = len(cols[0])
-            n_desc += k_real
-            ENGINE_DESCRIPTORS.inc(k_real)
-            packed = pack(cols)
-            if sharded_step is not None:
-                per_dev_real = np.clip(
-                    k_real - np.arange(n_dev) * desc_max, 0, desc_max
-                ).astype(np.int32)
-                counts = sharded_step(packed, per_dev_real)
-            else:
-                counts = pe.niceonly_strided_batch(
-                    plan, spec, packed, periods=periods, n_real=k_real
-                )
-            t2 = time.monotonic()
-            t_disp += t2 - t1
-            collector.put((cols, counts))
-            t0 = time.monotonic()
-            t_put += t0 - t2
+        with _Collector(
+            timed_collect_item, STRIDE_WINDOW, "niceonly-collect",
+            on_fail=stop.set, occupancy=ENGINE_STRIDE_OCCUPANCY,
+        ) as collector:
+            try:
+                t0 = time.monotonic()
+                for cols in grouped_columns():
+                    t1 = time.monotonic()
+                    t_gen += t1 - t0
+                    if collector.failed():
+                        break
+                    k_real = len(cols[0])
+                    n_desc += k_real
+                    ENGINE_DESCRIPTORS.inc(k_real)
+                    packed = pack(cols)
+                    if sharded_step is not None:
+                        per_dev_real = np.clip(
+                            k_real - np.arange(n_dev) * desc_max, 0, desc_max
+                        ).astype(np.int32)
+                        counts = sharded_step(packed, per_dev_real)
+                    else:
+                        counts = pe.niceonly_strided_batch(
+                            plan, spec, packed, periods=periods, n_real=k_real
+                        )
+                    t2 = time.monotonic()
+                    t_disp += t2 - t1
+                    collector.put((cols, counts))
+                    t0 = time.monotonic()
+                    t_put += t0 - t2
+            finally:
+                # Stop the producer before the collector drains so a failing
+                # run does not keep filtering for a full producer chunk.
+                stop.set()
     finally:
-        stop.set()  # stops the producer early on dispatch/collector failure
-        collector.shutdown()
         producer.join()
     if prod_err[0] is not None:
         raise prod_err[0]
@@ -1146,24 +1287,56 @@ def process_range_detailed(
     backend: str = "jax",
     batch_size: int = DEFAULT_BATCH_SIZE,
     progress=None,
+    *,
+    checkpoint_cb=None,
+    resume=None,
+    checkpoint_batches=None,
+    checkpoint_secs=None,
 ) -> FieldResults:
     """Full histogram + near-miss list, exact, any backend.
 
     progress: optional callable(done_numbers, total_numbers) invoked from the
     dispatch loop (the reference client's tqdm per-field progress,
-    client/src/main.rs:183-196); may be called from a worker thread."""
+    client/src/main.rs:183-196); may be called from a worker thread.
+
+    checkpoint_cb: optional callable(state) fired every checkpoint_batches
+    dispatches / checkpoint_secs seconds (NICE_TPU_CKPT_BATCHES /
+    NICE_TPU_CKPT_SECS when unset) with a CONSISTENT resume state:
+    {"cursor": pos, "hist": int64[base+2], "nice_numbers": [(number,
+    uniques), ...]} where every candidate in [range.start, pos) plus any
+    out-of-range slivers is fully folded in. It runs on the collector thread
+    (the only thread that mutates hist/nice_numbers), so the state it sees
+    always matches its cursor. resume: a state previously handed to
+    checkpoint_cb; the scan restarts at its cursor with histogram/survivors
+    preloaded and slivers NOT recomputed. backend='native' supports neither
+    (checkpoint_cb is ignored; resume raises)."""
     if backend == "scalar":
-        return scalar.process_range_detailed(range_, base)
+        if checkpoint_cb is None and resume is None:
+            return scalar.process_range_detailed(range_, base)
+        return _chunked_host_scan(
+            range_, base, "detailed", batch_size, progress,
+            checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
+        )
     if backend == "native":
+        if resume is not None:
+            raise ValueError(
+                "backend 'native' does not support resuming from a checkpoint"
+            )
         return _native_detailed(range_, base, _native_threads(), progress)
     if backend not in ("jax", "jnp", "pallas"):
         raise ValueError(f"unknown backend {backend!r}")
 
     core, slivers = _split_for_jax(
-        range_, base, lambda part: scalar.process_range_detailed(part, base)
+        range_, base, lambda part: scalar.process_range_detailed(part, base),
+        skip_slivers=resume is not None,
     )
     if core is None:
-        return scalar.process_range_detailed(range_, base)
+        if checkpoint_cb is None and resume is None:
+            return scalar.process_range_detailed(range_, base)
+        return _chunked_host_scan(
+            range_, base, "detailed", batch_size, progress,
+            checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
+        )
 
     plan = get_plan(base)
     backend = _pick_backend(plan, batch_size, backend)
@@ -1230,6 +1403,29 @@ def process_range_detailed(
     start = core.start()
     total = core.size()
 
+    done0 = 0
+    if resume is not None:
+        pos = int(resume["cursor"])
+        if resume.get("hist") is None:
+            raise ValueError("detailed resume state is missing a histogram")
+        h = np.asarray(resume["hist"], dtype=np.int64)
+        if h.shape != hist.shape:
+            raise ValueError(
+                f"resume histogram shape {h.shape} != {hist.shape}"
+            )
+        hist[:] = h
+        nice_numbers[:] = [
+            NiceNumberSimple(number=int(n), num_uniques=int(u))
+            for n, u in resume["nice_numbers"]
+        ]
+        done0 = min(total, max(0, pos - start))
+        CKPT_RESTORES.inc()
+        CKPT_BATCHES_SKIPPED.inc(done0 // lanes)
+        log.info(
+            "detailed resume: cursor %d (%d of %d numbers already done)",
+            pos, done0, total,
+        )
+
     import time as _time
 
     def collect_item(kind, *payload):
@@ -1246,7 +1442,7 @@ def process_range_detailed(
                     nice_numbers.append(
                         NiceNumberSimple(number=number, num_uniques=uniq)
                     )
-        else:  # "stats": the device-resident accumulator, ~once per field
+        elif kind == "stats":  # device-resident accumulator, ~once per field
             (acc,) = payload
             h = np.asarray(fold_acc(acc), dtype=np.int64)[: plan.base + 2]
             ENGINE_READBACK_BYTES.labels("stats").inc(h.nbytes)
@@ -1254,6 +1450,16 @@ def process_range_detailed(
             # Bin 0 carries tail-padding lane counts; no consumer reads it
             # (distributions report bins 1..base), so no correction needed.
             np.add(hist, h, out=hist)
+        else:  # "ckpt": marker enqueued AFTER a stats flush — everything up
+            # to its cursor is already folded into hist/nice_numbers here.
+            (pos,) = payload
+            checkpoint_cb({
+                "cursor": pos,
+                "hist": hist.copy(),
+                "nice_numbers": [
+                    (n.number, n.num_uniques) for n in nice_numbers
+                ],
+            })
         ENGINE_BATCH_KERNEL_SECONDS.labels("detailed").observe(
             _time.monotonic() - t0
         )
@@ -1262,17 +1468,20 @@ def process_range_detailed(
     # own thread: each readback pays the device->host RTT (~68 ms through
     # the axon tunnel), which would otherwise serialize against dispatch.
     # Only the collector touches hist/nice_numbers.
-    collector = _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
-                           occupancy=ENGINE_DISPATCH_OCCUPANCY)
     # i32 histogram bins saturate after ~2^31 counts; every batch adds at
     # most `lanes` to a bin (padding also lands in bin 0), so flush the
     # accumulator to the collector with wide margin before that.
     flush_every = max(1, ((1 << 31) - 1) // (2 * lanes))
+    ticker = (
+        _CkptTicker(checkpoint_batches, checkpoint_secs)
+        if checkpoint_cb else None
+    )
     acc = new_acc()
     since_flush = 0
-    try:
+    with _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
+                    occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.detailed", base=base, size=total):
-            done = 0
+            done = done0
             while done < total:
                 if collector.failed():
                     break
@@ -1281,17 +1490,23 @@ def process_range_detailed(
                 acc, nm = dispatch(acc, batch_start, valid)
                 collector.put(("nm", batch_start, valid, nm))
                 since_flush += 1
-                if since_flush >= flush_every:
+                done += valid
+                if ticker is not None and ticker.tick():
+                    # Export the donated device accumulator ahead of the
+                    # marker: by the time "ckpt" reaches the collector, every
+                    # batch before the cursor has been folded host-side.
                     collector.put(("stats", acc))
                     acc = new_acc()
                     since_flush = 0
-                done += valid
+                    collector.put(("ckpt", start + done))
+                elif since_flush >= flush_every:
+                    collector.put(("stats", acc))
+                    acc = new_acc()
+                    since_flush = 0
                 if progress is not None:
                     progress(done, total)
             if since_flush:
                 collector.put(("stats", acc))
-    finally:
-        collector.shutdown()
     collector.raise_if_failed()
     ENGINE_NUMBERS.labels("detailed").inc(range_.size())
 
@@ -1310,16 +1525,39 @@ def process_range_niceonly(
     backend: str = "jax",
     batch_size: int = DEFAULT_BATCH_SIZE,
     progress=None,
+    *,
+    checkpoint_cb=None,
+    resume=None,
+    checkpoint_batches=None,
+    checkpoint_secs=None,
 ) -> FieldResults:
     """Nice-number search via the stride-compacted device pipeline (TPU) or
     the dense masked scan (jnp fallback).
 
     progress: optional callable(done_numbers, total_numbers); on the strided
     path it reports the filter front (see _niceonly_pallas), on the dense
-    path dispatched lanes. May be called from a worker thread."""
+    path dispatched lanes. May be called from a worker thread.
+
+    checkpoint_cb/resume/checkpoint_batches/checkpoint_secs: as in
+    process_range_detailed, with state["hist"] always None. The cursor is a
+    watermark: every nice number below it is in state["nice_numbers"]. The
+    gaps the MSD/stride filters skipped contain no nice numbers by
+    construction, so a resume that re-derives the filters (even at a
+    different adaptive floor) under any plan with a matching signature finds
+    exactly the remaining set."""
     if backend == "scalar":
-        return scalar.process_range_niceonly(range_, base, stride_table)
+        if checkpoint_cb is None and resume is None:
+            return scalar.process_range_niceonly(range_, base, stride_table)
+        return _chunked_host_scan(
+            range_, base, "niceonly", batch_size, progress,
+            checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
+            stride_table=stride_table,
+        )
     if backend == "native":
+        if resume is not None:
+            raise ValueError(
+                "backend 'native' does not support resuming from a checkpoint"
+            )
         return _native_niceonly(
             range_, base, stride_table, _native_threads(), progress
         )
@@ -1332,13 +1570,43 @@ def process_range_niceonly(
         range_,
         base,
         lambda part: scalar.process_range_niceonly(part, base, stride_table),
+        skip_slivers=resume is not None,
     )
     if core is None:
-        return scalar.process_range_niceonly(range_, base, stride_table)
+        if checkpoint_cb is None and resume is None:
+            return scalar.process_range_niceonly(range_, base, stride_table)
+        return _chunked_host_scan(
+            range_, base, "niceonly", batch_size, progress,
+            checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
+            stride_table=stride_table,
+        )
 
     nice_numbers: list[NiceNumberSimple] = []
     for sub in slivers:
         nice_numbers.extend(sub.nice_numbers)
+
+    if resume is not None:
+        resume_pos = int(resume["cursor"])
+        nice_numbers[:] = [
+            NiceNumberSimple(number=int(n), num_uniques=int(u))
+            for n, u in resume["nice_numbers"]
+        ]
+        covered = max(0, min(resume_pos, core.end()) - core.start())
+        CKPT_RESTORES.inc()
+        CKPT_BATCHES_SKIPPED.inc(covered // max(1, batch_size))
+        log.info(
+            "niceonly resume: watermark %d (%d of %d core numbers already "
+            "covered)", resume_pos, covered, core.size(),
+        )
+        if resume_pos >= core.end():
+            # The snapshot already covers the whole core; assembly only.
+            nice_numbers.sort(key=lambda n: n.number)
+            ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
+            return FieldResults(
+                distribution=(), nice_numbers=tuple(nice_numbers)
+            )
+        if resume_pos > core.start():
+            core = FieldSize(resume_pos, core.end())
 
     plan = get_plan(base)
     requested = backend
@@ -1383,10 +1651,28 @@ def process_range_niceonly(
         # Stride-compacted device path (picks its own table depth via
         # _pick_stride_depth and expands offsets host-side; any passed
         # stride_table only parameterizes the scalar/host paths).
+        ckpt_closure = None
+        if checkpoint_cb is not None:
+            # Freeze the pre-core survivors (slivers / restored prefix): the
+            # strided collector only sees numbers from the clipped core.
+            prior = [(n.number, n.num_uniques) for n in nice_numbers]
+
+            def ckpt_closure(watermark, found):
+                checkpoint_cb({
+                    "cursor": watermark,
+                    "hist": None,
+                    "nice_numbers": prior + [(n, base) for n in found],
+                })
+
         with obs.span("engine.niceonly-strided", base=base, size=core.size()):
             nice_numbers.extend(
                 NiceNumberSimple(number=n, num_uniques=base)
-                for n in _niceonly_pallas(core, base, progress=progress)
+                for n in _niceonly_pallas(
+                    core, base, progress=progress,
+                    checkpoint=ckpt_closure,
+                    checkpoint_batches=checkpoint_batches,
+                    checkpoint_secs=checkpoint_secs,
+                )
             )
         nice_numbers.sort(key=lambda n: n.number)
         ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
@@ -1419,6 +1705,11 @@ def process_range_niceonly(
 
     import time
 
+    ticker = (
+        _CkptTicker(checkpoint_batches, checkpoint_secs)
+        if checkpoint_cb else None
+    )
+
     def collect_item(batch_start, valid, count):
         t0 = time.monotonic()
         ENGINE_READBACK_BYTES.labels("count").inc(4)
@@ -1430,6 +1721,17 @@ def process_range_niceonly(
                 nice_numbers.append(
                     NiceNumberSimple(number=number, num_uniques=base)
                 )
+        if ticker is not None and ticker.tick():
+            # Batches collect in dispatch order over ascending sub_ranges;
+            # the MSD gaps between them hold no nice numbers, so everything
+            # below this batch's end is accounted for.
+            checkpoint_cb({
+                "cursor": batch_start + valid,
+                "hist": None,
+                "nice_numbers": [
+                    (n.number, n.num_uniques) for n in nice_numbers
+                ],
+            })
         ENGINE_BATCH_KERNEL_SECONDS.labels("dense").observe(
             time.monotonic() - t0
         )
@@ -1456,9 +1758,8 @@ def process_range_niceonly(
     # the device->host RTT synchronously on the dispatch thread once its
     # deque filled (verdict task #6). Only the collector touches
     # nice_numbers.
-    collector = _Collector(collect_item, DISPATCH_WINDOW, "dense-collect",
-                           occupancy=ENGINE_DISPATCH_OCCUPANCY)
-    try:
+    with _Collector(collect_item, DISPATCH_WINDOW, "dense-collect",
+                    occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.niceonly-dense", base=base, size=core.size()):
             for sub_range in sub_ranges:
                 if collector.failed():
@@ -1479,8 +1780,6 @@ def process_range_niceonly(
                     grand_done += valid
                     if progress is not None:
                         progress(grand_done, grand_total)
-    finally:
-        collector.shutdown()
     collector.raise_if_failed()
     device_secs = time.monotonic() - t_dev0
     ctrl.observe(host_secs, device_secs, core.size())

@@ -267,6 +267,23 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
     return {"status": "OK"}
 
 
+def handle_renew_claim(ctx: ApiContext, payload: dict) -> dict:
+    """Claim-lease heartbeat: a client mid-scan re-arms its field's lease so
+    the expiry predicate never hands the field to another client while this
+    one is (provably) still alive. Submission elapsed time still measures
+    from the original claim (renewal touches only fields.last_claim_time)."""
+    claim_id = payload.get("claim_id")
+    if not isinstance(claim_id, int):
+        raise ApiError(400, "claim_id must be an integer")
+    try:
+        renewed_at = ctx.db.renew_claim(claim_id)
+    except KeyError as e:
+        raise ApiError(404, f"Invalid claim_id {claim_id}: {e}")
+    from nice_tpu.server.db import ts
+
+    return {"status": "OK", "renewed_at": ts(renewed_at)}
+
+
 def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
     """Admin disqualification: removes a user's (or one submission's) results
     from consensus and the caches without deleting the audit trail (the
@@ -430,6 +447,13 @@ def make_handler(ctx: ApiContext):
                     except json.JSONDecodeError as e:
                         raise ApiError(400, f"Invalid JSON body: {e}")
                     self._send(200, handle_submit(ctx, payload, user_ip))
+                elif method == "POST" and path == "/renew_claim":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError as e:
+                        raise ApiError(400, f"Invalid JSON body: {e}")
+                    self._send(200, handle_renew_claim(ctx, payload))
                 elif method == "POST" and path == "/admin/disqualify":
                     length = int(self.headers.get("Content-Length", 0))
                     try:

@@ -28,6 +28,11 @@ from typing import Optional
 
 from nice_tpu.core import base_range, generate_chunks, generate_fields
 from nice_tpu.core.constants import CLAIM_DURATION_HOURS, DOWNSAMPLE_CUTOFF_PERCENT
+from nice_tpu.obs.series import (
+    SERVER_CLAIM_EXPIRY,
+    SERVER_CLAIM_RENEWALS,
+    SERVER_FIELDS_RELEASED,
+)
 from nice_tpu.core.types import (
     ClaimRecord,
     FieldClaimStrategy,
@@ -493,9 +498,53 @@ class Db:
         )
 
     def claim_expiry_cutoff(self) -> datetime:
-        return now_utc() - timedelta(hours=CLAIM_DURATION_HOURS)
+        """Leases older than this are re-claimable. NICE_TPU_CLAIM_EXPIRY_SECS
+        overrides the CLAIM_DURATION_HOURS default so deployments with long
+        fields (or aggressive clients) can widen/narrow the window without a
+        code change; the active window is surfaced in /metrics."""
+        secs = float(
+            os.environ.get(
+                "NICE_TPU_CLAIM_EXPIRY_SECS", CLAIM_DURATION_HOURS * 3600
+            )
+        )
+        SERVER_CLAIM_EXPIRY.set(secs)
+        return now_utc() - timedelta(seconds=secs)
+
+    def release_field_claims(self, field_ids: list[int]) -> int:
+        """Clear the claim lease on fields so they are immediately
+        re-claimable (queue shutdown returns its pre-claimed inventory).
+        Returns how many rows actually held a lease."""
+        if not field_ids:
+            return 0
+        released = 0
+        with self._lock, self._txn():
+            for fid in field_ids:
+                cur = self._conn.execute(
+                    "UPDATE fields SET last_claim_time = NULL"
+                    " WHERE id = ? AND last_claim_time IS NOT NULL",
+                    (fid,),
+                )
+                released += cur.rowcount
+        SERVER_FIELDS_RELEASED.inc(released)
+        return released
 
     # -- claims ------------------------------------------------------------
+
+    def renew_claim(self, claim_id: int) -> datetime:
+        """Re-arm the lease on the field behind an active claim (client
+        heartbeat): bumps fields.last_claim_time to now so a long-running
+        scan is not re-claimed out from under the client. claims.claim_time
+        is untouched — submission elapsed accounting still measures from the
+        original claim. Raises KeyError on an unknown claim."""
+        when = now_utc()
+        claim = self.get_claim_by_id(claim_id)
+        with self._lock, self._txn():
+            self._conn.execute(
+                "UPDATE fields SET last_claim_time = ? WHERE id = ?",
+                (ts(when), claim.field_id),
+            )
+        SERVER_CLAIM_RENEWALS.inc()
+        return when
 
     def insert_claim(
         self, field_id: int, search_mode: SearchMode, user_ip: str

@@ -56,6 +56,26 @@ class FieldQueue:
         self._refill_wanted.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # Return the pre-claimed inventory: bulk-claiming stamped a lease on
+        # every queued field, so without this a shutdown strands up to
+        # REFILL_AMOUNT fields per queue until the lease expires (an hour of
+        # un-claimable work after every restart).
+        with self._lock:
+            stranded = [f.field_id for f in self._niceonly]
+            stranded += [f.field_id for f in self._detailed_thin]
+            self._niceonly.clear()
+            self._detailed_thin.clear()
+        if not stranded:
+            return
+        try:
+            released = self.db.release_field_claims(stranded)
+            log.info(
+                "released %d pre-claimed queue fields back to the DB", released
+            )
+        except Exception:
+            # The DB may already be closed during teardown; stranded leases
+            # simply expire on schedule.
+            log.exception("failed to release queued field claims on close")
 
     def _refill_loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,198 @@
+"""Kill-resume smoke check for the checkpoint subsystem.
+
+End-to-end crash drill against a real (in-process) API server and a real
+client subprocess:
+
+  1. seed a one-field base into a scratch DB and serve it on a loopback port;
+  2. run the client with --checkpoint-dir and an aggressive snapshot cadence,
+     wait for the first claim-*.ckpt to land, then SIGKILL it mid-scan;
+  3. restart the same client command and let it run to completion.
+
+Asserts that the second run resumed the SAME claim from the snapshot (no
+re-claim), that the server accepted exactly one submission for it — the
+submit path recomputes every nice number and checks the distribution total
+against the field size, so acceptance proves the resumed scan is numerically
+whole — that the submission matches a local scalar recomputation of the full
+field, that the snapshot was retired after the confirmed submit, and that at
+least one /renew_claim heartbeat landed. Prints ONE JSON line. Usage:
+
+    python scripts/crash_resume_smoke.py [workdir]
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 22  # full valid range [234256, 656395): ~1.5s of scalar work
+FIELD_SIZE = 1_000_000  # one field spans the whole base range
+POLL_SECS = 0.01
+FIRST_SNAPSHOT_TIMEOUT = 60
+RUN2_TIMEOUT = 180
+
+
+def _client_cmd(api_base: str, ckpt_dir: str) -> list:
+    return [
+        sys.executable, "-m", "nice_tpu.client", "detailed",
+        "--api-base", api_base,
+        "--checkpoint-dir", ckpt_dir,
+        "--backend", "scalar",
+        "--batch-size", "2048",
+        "--checkpoint-secs", "0.05",
+        "--renew-secs", "2",
+        "--username", "crash-smoke",
+    ]
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    if len(sys.argv) > 1:
+        workdir = sys.argv[1]
+        os.makedirs(workdir, exist_ok=True)
+        cleanup = False
+    else:
+        workdir = tempfile.mkdtemp(prefix="crash-resume-smoke-")
+        cleanup = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    db_path = os.path.join(workdir, "smoke.db")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    from nice_tpu.ckpt import read_snapshot
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import scalar
+    from nice_tpu.server import app as server_app
+    from nice_tpu.server.db import Db
+
+    db = Db(db_path)
+    db.seed_base(BASE, field_size=FIELD_SIZE)
+    db.close()
+
+    httpd = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=False)
+    port = httpd.server_address[1]
+    api_base = f"http://127.0.0.1:{port}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    failures = []
+    line = {"workdir": workdir}
+    env = dict(os.environ)
+    cmd = _client_cmd(api_base, ckpt_dir)
+
+    # -- run 1: scan until the first snapshot lands, then SIGKILL ----------
+    log1_path = os.path.join(workdir, "run1.log")
+    with open(log1_path, "wb") as log1:
+        proc = subprocess.Popen(cmd, stdout=log1, stderr=subprocess.STDOUT, env=env)
+        deadline = time.monotonic() + FIRST_SNAPSHOT_TIMEOUT
+        snap_path = None
+        while time.monotonic() < deadline:
+            found = glob.glob(os.path.join(ckpt_dir, "claim-*.ckpt"))
+            if found:
+                snap_path = found[0]
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(POLL_SECS)
+        if snap_path is None:
+            failures.append(
+                "no snapshot appeared before the client "
+                f"{'exited' if proc.poll() is not None else 'timed out'}"
+            )
+        elif proc.poll() is not None:
+            failures.append("client finished before it could be killed")
+        else:
+            time.sleep(0.2)  # let a few more snapshots land mid-scan
+            proc.kill()  # SIGKILL: no atexit, no cleanup, a genuine crash
+        proc.wait()
+
+    if failures:
+        line.update({"ok": False, "failures": failures})
+        print(json.dumps(line), flush=True)
+        return 1
+
+    manifest, _ = read_snapshot(snap_path)
+    claim_id = int(json.loads(json.dumps(manifest["field"]))["claim_id"])
+    line["claim_id"] = claim_id
+    line["kill_cursor"] = int(manifest["cursor"])
+    field_rec = manifest["field"]
+
+    db = Db(db_path)
+    pre = db.get_detailed_submissions_by_field(
+        db.get_claim_by_id(claim_id).field_id
+    )
+    if pre:
+        failures.append(f"killed run somehow submitted ({len(pre)} submissions)")
+
+    # -- run 2: same command; must resume, finish, and submit --------------
+    log2_path = os.path.join(workdir, "run2.log")
+    with open(log2_path, "wb") as log2:
+        rc = subprocess.run(
+            cmd, stdout=log2, stderr=subprocess.STDOUT, env=env,
+            timeout=RUN2_TIMEOUT,
+        ).returncode
+    log2_text = open(log2_path, errors="replace").read()
+    if rc != 0:
+        failures.append(f"resumed run exited {rc}; tail: {log2_text[-2000:]}")
+    if f"resuming claim {claim_id} from checkpoint" not in log2_text:
+        failures.append("resumed run did not log a checkpoint resume")
+    if glob.glob(os.path.join(ckpt_dir, "claim-*.ckpt")):
+        failures.append("snapshot not retired after the confirmed submit")
+
+    # -- verify the submission against a local recomputation ---------------
+    claim = db.get_claim_by_id(claim_id)
+    subs = db.get_detailed_submissions_by_field(claim.field_id)
+    line["submissions"] = len(subs)
+    if len(subs) != 1:
+        failures.append(f"expected exactly 1 submission, found {len(subs)}")
+    else:
+        sub = subs[0]
+        if sub.claim_id != claim_id:
+            failures.append(
+                f"submission belongs to claim {sub.claim_id}, expected "
+                f"{claim_id} (client re-claimed instead of resuming)"
+            )
+        field = db.get_field_by_id(claim.field_id)
+        ref = scalar.process_range_detailed(
+            FieldSize(field.range_start, field.range_end), field.base
+        )
+        got_dist = {d.num_uniques: d.count for d in sub.distribution}
+        ref_dist = {d.num_uniques: d.count for d in ref.distribution}
+        if got_dist != ref_dist:
+            failures.append("submitted distribution != scalar recomputation")
+        got_nums = {(n.number, n.num_uniques) for n in sub.numbers}
+        ref_nums = {(n.number, n.num_uniques) for n in ref.nice_numbers}
+        if got_nums != ref_nums:
+            failures.append("submitted nice numbers != scalar recomputation")
+    db.close()
+
+    # -- renewal heartbeat visible server-side -----------------------------
+    with urllib.request.urlopen(f"{api_base}/metrics", timeout=10) as resp:
+        metrics = resp.read().decode()
+    renewals = 0.0
+    for ln in metrics.splitlines():
+        if ln.startswith("nice_server_claim_renewals_total"):
+            renewals = float(ln.split()[-1])
+    line["renewals"] = renewals
+    if renewals < 1:
+        failures.append("no /renew_claim heartbeat reached the server")
+
+    httpd.shutdown()
+    line["ok"] = not failures
+    if failures:
+        line["failures"] = failures
+    line["elapsed_secs"] = round(time.monotonic() - t_start, 2)
+    print(json.dumps(line), flush=True)
+    if cleanup and not failures:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,429 @@
+"""Checkpoint/resume subsystem tests.
+
+Covers the on-disk snapshot format (CRC/version/atomicity), the per-field
+manager (plan-signature validation, startup resume scan), engine kill-resume
+equivalence (a scan resumed from a mid-field snapshot must produce a
+byte-identical submission to an uninterrupted one), and the server-side claim
+lifecycle additions (/renew_claim, lease release on queue close, configurable
+expiry window).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nice_tpu import ckpt
+from nice_tpu.ckpt import snapshot as snap
+from nice_tpu.client.main import compile_results
+from nice_tpu.core.types import DataToClient, FieldSize, SearchMode
+from nice_tpu.obs.series import (
+    CKPT_BATCHES_SKIPPED,
+    CKPT_REJECTED,
+    CKPT_RESTORES,
+    CKPT_WRITES,
+    SERVER_FIELDS_RELEASED,
+)
+from nice_tpu.ops import engine, scalar
+from nice_tpu.server.db import Db
+from nice_tpu.server.field_queue import FieldQueue
+
+BASE = 17
+RANGE = FieldSize(5541, 30941)  # full base-17 valid range: 25,400 candidates
+
+
+def _field(claim_id=1):
+    return DataToClient(
+        claim_id=claim_id,
+        base=BASE,
+        range_start=RANGE.start(),
+        range_end=RANGE.end(),
+        range_size=RANGE.size(),
+    )
+
+
+# -- snapshot format ---------------------------------------------------------
+
+
+def test_snapshot_roundtrip(tmp_path):
+    path = str(tmp_path / "s.ckpt")
+    manifest = {"cursor": "123", "nested": {"a": [1, 2]}}
+    arrays = {"hist": np.arange(19, dtype=np.int64)}
+    nbytes = snap.write_snapshot(path, manifest, arrays)
+    assert nbytes == os.path.getsize(path)
+    got_m, got_a = snap.read_snapshot(path)
+    assert got_m["cursor"] == "123"
+    assert got_m["nested"] == {"a": [1, 2]}
+    assert got_m["format_version"] == snap.FORMAT_VERSION
+    assert np.array_equal(got_a["hist"], arrays["hist"])
+
+
+def test_snapshot_rejects_corruption(tmp_path):
+    path = str(tmp_path / "s.ckpt")
+    snap.write_snapshot(path, {"cursor": "1"}, {})
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(snap.SnapshotError) as ei:
+        snap.read_snapshot(path)
+    assert ei.value.reason == "corrupt"
+    # Truncation (a crash mid-write would be caught by the rename, but a
+    # truncated copy must still fail closed).
+    snap.write_snapshot(path, {"cursor": "1"}, {})
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) - 7])
+    with pytest.raises(snap.SnapshotError):
+        snap.read_snapshot(path)
+    # Garbage file.
+    open(path, "wb").write(b"not a snapshot at all")
+    with pytest.raises(snap.SnapshotError):
+        snap.read_snapshot(path)
+
+
+def test_snapshot_rejects_unknown_version(tmp_path):
+    path = str(tmp_path / "s.ckpt")
+    snap.write_snapshot(path, {"cursor": "1"}, {})
+    blob = bytearray(open(path, "rb").read())
+    # Patch the header version and re-stamp the CRC so ONLY the version is
+    # wrong (a bad CRC would mask the version check).
+    import struct
+    import zlib
+
+    off = len(snap.MAGIC)
+    blob[off:off + 4] = struct.pack("<I", snap.FORMAT_VERSION + 1)
+    body = bytes(blob[off:-4])
+    blob[-4:] = struct.pack("<I", zlib.crc32(body))
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(snap.SnapshotError) as ei:
+        snap.read_snapshot(path)
+    assert ei.value.reason == "version"
+
+
+# -- manager -----------------------------------------------------------------
+
+
+def _state(cursor=11685):
+    return {
+        "cursor": cursor,
+        "hist": np.arange(BASE + 2, dtype=np.int64),
+        "nice_numbers": [(6864, 12), (6865, 13)],
+    }
+
+
+def test_manager_save_load_roundtrip(tmp_path):
+    writes0 = CKPT_WRITES.value()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(), SearchMode.DETAILED, "jnp", 1024
+    )
+    ck.save(_state())
+    assert CKPT_WRITES.value() == writes0 + 1
+    got = ck.load()
+    assert got["cursor"] == 11685
+    assert got["nice_numbers"] == [(6864, 12), (6865, 13)]
+    assert np.array_equal(got["hist"], np.arange(BASE + 2, dtype=np.int64))
+    ck.delete()
+    assert ck.load() is None
+    ck.delete()  # idempotent
+
+
+def test_manager_rejects_signature_mismatch(tmp_path):
+    rejected0 = CKPT_REJECTED.value(("signature",))
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(), SearchMode.DETAILED, "jnp", 1024
+    )
+    ck.save(_state())
+    # Same field, different batch size: the cursor means something else now.
+    other = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(), SearchMode.DETAILED, "jnp", 2048
+    )
+    assert other.load() is None
+    assert CKPT_REJECTED.value(("signature",)) == rejected0 + 1
+    assert not os.path.exists(ck.path)  # rejected snapshots are removed
+
+
+def test_manager_rejects_corrupt_snapshot(tmp_path):
+    rejected0 = CKPT_REJECTED.value(("corrupt",))
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(), SearchMode.DETAILED, "jnp", 1024
+    )
+    ck.save(_state())
+    blob = bytearray(open(ck.path, "rb").read())
+    blob[-10] ^= 0xFF
+    open(ck.path, "wb").write(bytes(blob))
+    assert ck.load() is None
+    assert CKPT_REJECTED.value(("corrupt",)) == rejected0 + 1
+    assert not os.path.exists(ck.path)
+    # A clean restart after rejection checkpoints normally again.
+    ck.save(_state())
+    assert ck.load() is not None
+
+
+def test_find_resumable(tmp_path):
+    assert (
+        ckpt.find_resumable(str(tmp_path), SearchMode.DETAILED, "jnp", 1024)
+        is None
+    )
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(claim_id=42), SearchMode.DETAILED, "jnp", 1024
+    )
+    ck.save(_state())
+    found = ckpt.find_resumable(str(tmp_path), SearchMode.DETAILED, "jnp", 1024)
+    assert found is not None
+    data, state, ckptr = found
+    assert data.claim_id == 42
+    assert state["cursor"] == 11685
+    assert ckptr.path == ck.path
+    # A different configuration must NOT resume it (and must leave the file
+    # for the configuration that can).
+    assert (
+        ckpt.find_resumable(str(tmp_path), SearchMode.NICEONLY, "jnp", 1024)
+        is None
+    )
+    assert (
+        ckpt.find_resumable(str(tmp_path), SearchMode.DETAILED, "jnp", 512)
+        is None
+    )
+    assert os.path.exists(ck.path)
+
+
+# -- engine kill-resume equivalence -----------------------------------------
+
+
+def test_detailed_kill_resume_byte_identical(tmp_path):
+    """The acceptance scenario: run a detailed scan checkpointing to disk,
+    'kill' it by discarding the in-memory run at a mid-field snapshot, restart
+    from the snapshot on disk, and require the submission payload to be
+    byte-identical to an uninterrupted run's."""
+    data = _field()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.DETAILED, "jnp", 256
+    )
+    states = []
+
+    def save_and_capture(state):
+        ck.save(state)
+        states.append(state)
+
+    uninterrupted = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        checkpoint_cb=save_and_capture, checkpoint_batches=2,
+        checkpoint_secs=0,
+    )
+    assert len(states) >= 2, "range too small to exercise checkpointing"
+    # The snapshot on disk is the LAST one; rewrite a mid-field one to model
+    # a crash partway through.
+    mid = states[len(states) // 2]
+    ck.save(mid)
+
+    restores0 = CKPT_RESTORES.value()
+    skipped0 = CKPT_BATCHES_SKIPPED.value()
+    resume = ck.load()
+    assert resume is not None and resume["cursor"] == mid["cursor"]
+    resumed = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256, resume=resume,
+    )
+    assert CKPT_RESTORES.value() == restores0 + 1
+    assert CKPT_BATCHES_SKIPPED.value() > skipped0
+
+    a = compile_results(data, uninterrupted, SearchMode.DETAILED, "t")
+    b = compile_results(data, resumed, SearchMode.DETAILED, "t")
+    assert json.dumps(a.to_json(), sort_keys=True) == json.dumps(
+        b.to_json(), sort_keys=True
+    )
+    # And both match the scalar oracle.
+    ref = scalar.process_range_detailed(RANGE, BASE)
+    assert resumed.distribution == ref.distribution
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_niceonly_dense_resume_equivalence():
+    states = []
+    full = engine.process_range_niceonly(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        checkpoint_cb=states.append, checkpoint_batches=2, checkpoint_secs=0,
+    )
+    assert states, "no checkpoints fired"
+    mid = states[len(states) // 2]
+    resumed = engine.process_range_niceonly(
+        RANGE, BASE, backend="jnp", batch_size=256, resume=mid,
+    )
+    assert resumed.nice_numbers == full.nice_numbers
+    ref = scalar.process_range_niceonly(RANGE, BASE, None)
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_scalar_chunked_resume_equivalence():
+    ref = scalar.process_range_detailed(RANGE, BASE)
+    states = []
+    full = engine.process_range_detailed(
+        RANGE, BASE, backend="scalar", batch_size=1024,
+        checkpoint_cb=states.append, checkpoint_batches=3, checkpoint_secs=0,
+    )
+    assert full.distribution == ref.distribution
+    assert full.nice_numbers == ref.nice_numbers
+    for state in states:
+        resumed = engine.process_range_detailed(
+            RANGE, BASE, backend="scalar", batch_size=1024, resume=state,
+        )
+        assert resumed.distribution == ref.distribution
+        assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_resume_past_end_returns_complete_state():
+    ref = scalar.process_range_niceonly(RANGE, BASE, None)
+    done = {
+        "cursor": RANGE.end(),
+        "hist": None,
+        "nice_numbers": [(n.number, n.num_uniques) for n in ref.nice_numbers],
+    }
+    resumed = engine.process_range_niceonly(
+        RANGE, BASE, backend="jnp", batch_size=256, resume=done,
+    )
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_native_backend_rejects_resume():
+    with pytest.raises(ValueError, match="native"):
+        engine.process_range_detailed(
+            RANGE, BASE, backend="native", resume=_state(),
+        )
+    with pytest.raises(ValueError, match="native"):
+        engine.process_range_niceonly(
+            RANGE, BASE, backend="native", resume=_state(),
+        )
+
+
+# -- server: renewal, lease release, expiry window ---------------------------
+
+
+def test_renew_claim_bumps_lease_not_claim_time(tmp_path):
+    from nice_tpu.core.types import FieldClaimStrategy
+
+    db = Db(str(tmp_path / "t.db"))
+    try:
+        db.seed_base(10, field_size=20)
+        # Claim through the same path the API uses.
+        field = db.try_claim_field(
+            FieldClaimStrategy.NEXT, db.claim_expiry_cutoff(), 0, (1 << 128) - 1
+        )
+        assert field is not None
+        claim = db.insert_claim(field.field_id, SearchMode.NICEONLY, "127.0.0.1")
+        before = db.get_field_by_id(field.field_id).last_claim_time
+        renewed_at = db.renew_claim(claim.claim_id)
+        after = db.get_field_by_id(field.field_id).last_claim_time
+        assert after >= before
+        assert after == renewed_at
+        # claims.claim_time is untouched (submission elapsed accounting).
+        assert db.get_claim_by_id(claim.claim_id).claim_time == claim.claim_time
+        with pytest.raises(KeyError):
+            db.renew_claim(999999)
+    finally:
+        db.close()
+
+
+def test_field_queue_close_releases_leases(tmp_path):
+    db = Db(str(tmp_path / "t.db"))
+    try:
+        db.seed_base(10, field_size=20)  # 3 fields
+        q = FieldQueue(db, start_thread=False)
+        q.refill_niceonly()
+        assert q.niceonly_queue_size() == 3
+        leased = [
+            f for f in db.get_fields_in_base(10)
+            if f.last_claim_time is not None
+        ]
+        assert len(leased) == 3
+        released0 = SERVER_FIELDS_RELEASED.value()
+        q.close()
+        assert q.niceonly_queue_size() == 0
+        assert SERVER_FIELDS_RELEASED.value() == released0 + 3
+        leased = [
+            f for f in db.get_fields_in_base(10)
+            if f.last_claim_time is not None
+        ]
+        assert leased == []  # immediately re-claimable
+    finally:
+        db.close()
+
+
+def test_claim_expiry_env_override(tmp_path, monkeypatch):
+    from nice_tpu.obs.series import SERVER_CLAIM_EXPIRY
+    from nice_tpu.server.db import now_utc
+
+    db = Db(str(tmp_path / "t.db"))
+    try:
+        monkeypatch.delenv("NICE_TPU_CLAIM_EXPIRY_SECS", raising=False)
+        default_cutoff = db.claim_expiry_cutoff()
+        assert SERVER_CLAIM_EXPIRY.value() == 3600.0
+        monkeypatch.setenv("NICE_TPU_CLAIM_EXPIRY_SECS", "120")
+        cutoff = db.claim_expiry_cutoff()
+        assert SERVER_CLAIM_EXPIRY.value() == 120.0
+        delta = (now_utc() - cutoff).total_seconds()
+        assert 119 < delta < 125
+        assert cutoff > default_cutoff
+    finally:
+        db.close()
+
+
+# -- client resume integration ----------------------------------------------
+
+
+def test_client_resume_single_iteration(tmp_path):
+    """A restarted client finds the snapshot, resumes the SAME claim without
+    re-claiming, and deletes the snapshot only after the submit succeeds."""
+    from types import SimpleNamespace
+
+    from nice_tpu.client import main as client_main
+
+    data = _field(claim_id=42)
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.DETAILED, "scalar", 4096
+    )
+    # Build a genuine mid-scan state with the scalar oracle so the resumed
+    # half plus the prefix must reproduce the full-field results.
+    cut = RANGE.start() + 9000
+    prefix = scalar.process_range_detailed(FieldSize(RANGE.start(), cut), BASE)
+    hist = np.zeros(BASE + 2, dtype=np.int64)
+    for d in prefix.distribution:
+        hist[d.num_uniques] += d.count
+    ck.save({
+        "cursor": cut,
+        "hist": hist,
+        "nice_numbers": [
+            (n.number, n.num_uniques) for n in prefix.nice_numbers
+        ],
+    })
+
+    submitted = []
+
+    class FakeFuture:
+        def __init__(self, value=None):
+            self.value = value
+
+        def result(self):
+            return self.value
+
+    class FakeApi:
+        def claim_async(self, mode):
+            raise AssertionError("client re-claimed despite a resumable snapshot")
+
+        def submit_async(self, submission):
+            submitted.append(submission)
+            return FakeFuture()
+
+    args = SimpleNamespace(
+        checkpoint_dir=str(tmp_path), backend="scalar", batch_size=4096,
+        progress_secs=0.0, checkpoint_secs=0.0, renew_secs=0.0, username="t",
+        api_base="http://unused",
+    )
+    restores0 = CKPT_RESTORES.value()
+    client_main.run_single_iteration(args, FakeApi(), SearchMode.DETAILED)
+    assert CKPT_RESTORES.value() == restores0 + 1
+    assert len(submitted) == 1
+    ref = scalar.process_range_detailed(RANGE, BASE)
+    expect = compile_results(data, ref, SearchMode.DETAILED, "t")
+    assert json.dumps(submitted[0].to_json(), sort_keys=True) == json.dumps(
+        expect.to_json(), sort_keys=True
+    )
+    assert not os.path.exists(ck.path)  # retired after the confirmed submit

@@ -27,6 +27,8 @@ import zlib
 
 import numpy as np
 
+from nice_tpu import faults
+
 MAGIC = b"NICECKPT"
 FORMAT_VERSION = 1
 
@@ -68,6 +70,12 @@ def write_snapshot(path: str, manifest: dict, arrays: dict[str, np.ndarray]) -> 
         + payload
     )
     blob = MAGIC + body + _LEN.pack(zlib.crc32(body))
+
+    # Chaos hook (ckpt.write): "truncate" persists only half the blob — a
+    # power-loss-mid-write stand-in that read_snapshot must reject via the
+    # CRC, proving the corrupt-snapshot detection path end to end.
+    if faults.fire("ckpt.write", path=path) == "truncate":
+        blob = blob[: len(blob) // 2]
 
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:

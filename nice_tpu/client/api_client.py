@@ -1,22 +1,36 @@
 """Client HTTP transport: claim / submit / validate with retry + backoff.
 
 Stdlib-only (urllib) equivalent of the reference's reqwest wrappers
-(client_api_sync.rs:37-206): exponential backoff 2^attempt seconds, retrying
-network errors and 5xx responses; 4xx errors surface immediately with the
-server's message. A thread-pool async facade gives the overlap the reference
-gets from tokio (client_api_async.rs) without extra dependencies.
+(client_api_sync.rs:37-206): full-jitter exponential backoff (AWS
+architecture-blog style: uniform(0, min(2^attempt, cap)) so a fleet of
+clients knocked over by one server restart doesn't reconverge in lockstep),
+retrying network errors and 5xx responses; 4xx errors surface immediately
+with the server's message; a server-sent Retry-After (the 503 overload
+shed) overrides the computed backoff. A thread-pool async facade gives the
+overlap the reference gets from tokio (client_api_async.rs) without extra
+dependencies.
+
+Fault injection: every attempt passes through the http.<endpoint> site
+(nice_tpu.faults), so NICE_TPU_FAULTS can synthesize 5xx responses,
+connection errors, or — the nasty one — drop_response: the request REACHES
+the server and is processed, but the client sees a network error and
+retries, exercising the exactly-once submit path.
 """
 
 from __future__ import annotations
 
+import io
 import json
 import logging
+import random
 import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from email.message import Message
 from typing import Any, Optional
 
+from nice_tpu import faults
 from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
 from nice_tpu.core.types import DataToClient, DataToServer, SearchMode, ValidationData
 from nice_tpu.obs.series import CLIENT_REQUEST_SECONDS, CLIENT_RETRIES
@@ -26,9 +40,58 @@ log = logging.getLogger(__name__)
 DEFAULT_MAX_RETRIES = 10
 MAX_BACKOFF_SECS = 512
 
+# Backoff jitter source; module-level so tests can reseed for determinism.
+_backoff_rng = random.Random()
+
 
 class ApiError(Exception):
-    """Non-retryable API failure (4xx or exhausted retries)."""
+    """Non-retryable API failure.
+
+    status: the HTTP status code when the server definitively answered
+    (4xx — the request is rejected, retrying cannot help), or None when
+    retries were exhausted on transient errors (the request MAY still
+    succeed later; the submission spool uses the distinction)."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
+
+
+def _inject_http_fault(
+    action: str, url: str, body: Optional[dict], timeout: float
+) -> Any:
+    """Apply an http.<endpoint> fault action. Raises for every action except
+    an unknown one (which degrades to the real request)."""
+    if action == "drop_response":
+        # The server processes the request; the client never learns.
+        _request_json(url, body, timeout)
+        raise urllib.error.URLError(f"injected fault: response dropped for {url}")
+    if action in ("conn_error", "raise"):
+        raise urllib.error.URLError(f"injected fault: connection error for {url}")
+    try:
+        code = int(action)
+    except ValueError:
+        log.warning("unknown http fault action %r; passing through", action)
+        return _request_json(url, body, timeout)
+    raise urllib.error.HTTPError(
+        url, code, f"injected fault: HTTP {code}", Message(),
+        io.BytesIO(b"injected fault"),
+    )
+
+
+def _retry_after_secs(err: Exception) -> Optional[float]:
+    """Delay-seconds from a server-sent Retry-After header, if any (the
+    HTTP-date form is ignored — this server only emits delta-seconds)."""
+    headers = getattr(err, "headers", None)
+    if headers is None:
+        return None
+    value = headers.get("Retry-After")
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
 
 
 def _request_json(
@@ -54,7 +117,9 @@ def retry_request(
     timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
     endpoint: str = "other",
 ) -> Any:
-    """GET/POST with exponential backoff on 5xx and network errors.
+    """GET/POST with full-jitter exponential backoff on 5xx and network
+    errors: each retry sleeps uniform(0, min(2^attempt, cap)) seconds, unless
+    the response carried Retry-After (server overload shed), which wins.
 
     endpoint labels the per-attempt latency histogram and retry counter
     (claim / submit / validate / renew / other)."""
@@ -62,7 +127,11 @@ def retry_request(
     while True:
         t0 = time.monotonic()
         try:
-            result = _request_json(url, body, timeout)
+            act = faults.fire(f"http.{endpoint}", url=url, attempt=attempt)
+            if act is not None:
+                result = _inject_http_fault(act, url, body, timeout)
+            else:
+                result = _request_json(url, body, timeout)
             CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
                 time.monotonic() - t0
             )
@@ -77,7 +146,9 @@ def retry_request(
                     detail = e.read().decode(errors="replace")
                 except Exception:
                     pass
-                raise ApiError(f"HTTP {e.code} from {url}: {detail}") from e
+                raise ApiError(
+                    f"HTTP {e.code} from {url}: {detail}", status=e.code
+                ) from e
             err: Exception = e
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
@@ -87,8 +158,16 @@ def retry_request(
         if attempt >= max_retries:
             raise ApiError(f"request to {url} failed after {attempt} retries: {err}")
         CLIENT_RETRIES.labels(endpoint).inc()
-        delay = min(2**attempt, MAX_BACKOFF_SECS)
-        log.warning("request failed (%s); retry %d in %ds", err, attempt + 1, delay)
+        hinted = _retry_after_secs(err)
+        if hinted is not None:
+            delay = min(hinted, MAX_BACKOFF_SECS)
+        else:
+            delay = _backoff_rng.uniform(0, min(2**attempt, MAX_BACKOFF_SECS))
+        log.warning(
+            "request failed (%s); retry %d in %.2fs%s",
+            err, attempt + 1, delay,
+            " (server Retry-After)" if hinted is not None else "",
+        )
         time.sleep(delay)
         attempt += 1
 
@@ -106,12 +185,20 @@ def get_field_from_server(
 
 def submit_field_to_server(
     api_base: str, submit_data: DataToServer, max_retries: int = DEFAULT_MAX_RETRIES
-) -> None:
-    """POST /submit (reference client_api_sync.rs:144-172)."""
-    retry_request(
+) -> dict:
+    """POST /submit (reference client_api_sync.rs:144-172). Returns the
+    server's response dict; {"duplicate": true} means a retried submit was
+    already accepted (exactly-once via submit_id) — success, not an error."""
+    resp = retry_request(
         f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries,
         endpoint="submit",
     )
+    if isinstance(resp, dict) and resp.get("duplicate"):
+        log.info(
+            "submit for claim %d was a duplicate: a retried request had "
+            "already been accepted", submit_data.claim_id,
+        )
+    return resp if isinstance(resp, dict) else {"status": "OK"}
 
 
 def renew_claim(

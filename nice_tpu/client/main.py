@@ -16,6 +16,7 @@ Run modes (reference client/src/main.rs:295-562):
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import logging
 import os
@@ -25,6 +26,7 @@ from typing import Optional
 
 from nice_tpu import CLIENT_VERSION, ckpt, obs
 from nice_tpu.client import api_client
+from nice_tpu.faults import spool as spool_mod
 from nice_tpu.obs.series import (
     CKPT_RENEWALS,
     CLIENT_FIELD_SECONDS,
@@ -118,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="directory for crash-safe field-scan snapshots; enables "
         "periodic checkpointing and auto-resume of an interrupted claim on "
         "startup (env NICE_CHECKPOINT_DIR)",
+    )
+    p.add_argument(
+        "--spool-dir",
+        default=_env("SPOOL_DIR", None),
+        help="directory journaling submissions whose HTTP retries were "
+        "exhausted, for replay at the next loop iteration / startup; "
+        "defaults to <checkpoint-dir>/spool when checkpointing is on "
+        "(env NICE_SPOOL_DIR)",
     )
     p.add_argument(
         "--checkpoint-secs",
@@ -246,8 +256,12 @@ def process_field(
 def compile_results(
     data: DataToClient, results: FieldResults, mode: SearchMode, username: str
 ) -> DataToServer:
-    """Build the submission payload (reference client/src/main.rs:212-254)."""
-    return DataToServer(
+    """Build the submission payload (reference client/src/main.rs:212-254),
+    stamped with the exactly-once submit_id: claim id + a content hash, so a
+    retried request the server already accepted is recognized as the SAME
+    submission (idempotent replay), while a different result set for the
+    same claim (recomputation after a lost checkpoint) is not."""
+    payload = DataToServer(
         claim_id=data.claim_id,
         username=username,
         client_version=CLIENT_VERSION,
@@ -255,7 +269,13 @@ def compile_results(
             list(results.distribution) if mode == SearchMode.DETAILED else None
         ),
         nice_numbers=list(results.nice_numbers),
+        backend_downgrades=list(results.backend_downgrades) or None,
     )
+    content = json.dumps(payload.to_json(), sort_keys=True).encode()
+    payload.submit_id = (
+        f"{data.claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return payload
 
 
 def run_benchmark(args) -> int:
@@ -425,7 +445,24 @@ def _resume_or_claim(args, api: api_client.AsyncApi, mode: SearchMode):
     return data, None, _new_checkpointer(args, data, mode)
 
 
-def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+def _await_submit(future, submission: DataToServer, spool) -> None:
+    """Confirm a submit, journaling to the spool when the server stayed
+    unreachable past the retry budget. A 4xx rejection always raises — a
+    replay of a rejected payload can never succeed. Once this returns,
+    delivery is OWNED (accepted, already-accepted duplicate, or spooled), so
+    the field's snapshot may be retired."""
+    try:
+        future.result()
+        log.info("submitted claim %d", submission.claim_id)
+    except api_client.ApiError as e:
+        if spool is None or (e.status is not None and 400 <= e.status < 500):
+            raise
+        spool.add(submission)
+
+
+def run_single_iteration(
+    args, api: api_client.AsyncApi, mode: SearchMode, spool=None
+) -> None:
     data, resume, ckptr = _resume_or_claim(args, api, mode)
     with _maybe_renewer(args, data.claim_id):
         results, _ = process_field(
@@ -434,17 +471,19 @@ def run_single_iteration(args, api: api_client.AsyncApi, mode: SearchMode) -> No
             checkpoint_secs=args.checkpoint_secs,
         )
     submission = compile_results(data, results, mode, args.username)
-    api.submit_async(submission).result()
-    # Only a confirmed submit retires the snapshot; any failure before this
-    # point leaves it on disk for the next startup to resume.
+    _await_submit(api.submit_async(submission), submission, spool)
+    # Only an owned submit (confirmed or spooled) retires the snapshot; any
+    # failure before this point leaves it on disk for the next startup.
     if ckptr is not None:
         ckptr.delete()
-    log.info("submitted claim %d", data.claim_id)
 
 
-def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None:
+def run_pipelined_loop(
+    args, api: api_client.AsyncApi, mode: SearchMode, spool=None
+) -> None:
     """claim N+1 || process N || submit N-1 (reference client/src/main.rs:411-562)."""
-    pending_submit = None  # (future, checkpointer) awaiting confirmation
+    # (future, checkpointer, submission) awaiting confirmation
+    pending_submit = None
     data, resume, ckptr = _resume_or_claim(args, api, mode)
     stats_every = float(_env("STATS_SECS", 60))
     t_start = time.monotonic()
@@ -452,6 +491,10 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
     fields = 0
     numbers = 0
     while True:
+        if spool is not None:
+            # Loop-boundary replay: a no-op when empty, and the natural
+            # moment to drain journaled submissions once the server is back.
+            spool.replay(args.api_base)
         next_claim = api.claim_async(mode)  # overlap with processing
         with _maybe_renewer(args, data.claim_id):
             results, _ = process_field(
@@ -460,14 +503,14 @@ def run_pipelined_loop(args, api: api_client.AsyncApi, mode: SearchMode) -> None
                 checkpoint_secs=args.checkpoint_secs,
             )
         if pending_submit is not None:
-            # Surface any submit error before queueing the next one; only a
-            # confirmed submit retires that field's snapshot.
-            prev_future, prev_ckptr = pending_submit
-            prev_future.result()
+            # Settle the previous submit before queueing the next one; only
+            # an owned submit (confirmed or spooled) retires its snapshot.
+            prev_future, prev_ckptr, prev_sub = pending_submit
+            _await_submit(prev_future, prev_sub, spool)
             if prev_ckptr is not None:
                 prev_ckptr.delete()
         submission = compile_results(data, results, mode, args.username)
-        pending_submit = (api.submit_async(submission), ckptr)
+        pending_submit = (api.submit_async(submission), ckptr, submission)
         fields += 1
         numbers += data.range_size
         now = time.monotonic()
@@ -527,11 +570,16 @@ def main(argv: Optional[list[str]] = None) -> int:
         return run_validate(args)
     mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
     api = api_client.AsyncApi(args.api_base, args.username, args.max_retries)
+    spool = spool_mod.maybe_spool(args.spool_dir, args.checkpoint_dir)
+    if spool is not None:
+        # Startup replay: deliver anything journaled by a previous run (the
+        # kill-during-outage case) before claiming new work.
+        spool.replay(args.api_base)
     try:
         if args.repeat:
-            run_pipelined_loop(args, api, mode)
+            run_pipelined_loop(args, api, mode, spool=spool)
         else:
-            run_single_iteration(args, api, mode)
+            run_single_iteration(args, api, mode, spool=spool)
     except KeyboardInterrupt:
         log.info("interrupted; shutting down")
     finally:

@@ -151,16 +151,25 @@ class DataToClient:
 
 @dataclass
 class DataToServer:
-    """Compiled results sent to the server (reference lib.rs:262-268)."""
+    """Compiled results sent to the server (reference lib.rs:262-268).
+
+    submit_id / backend_downgrades are optional extensions beyond the
+    reference wire format: both are omitted from the JSON when unset, so
+    payloads stay byte-interchangeable with reference clients/servers that
+    never heard of them. submit_id (claim id + content hash) is the
+    exactly-once idempotency key; backend_downgrades records any mid-field
+    engine fallbacks (e.g. "pallas->jnp") that produced these results."""
 
     claim_id: int
     username: str
     client_version: str
     unique_distribution: Optional[list[UniquesDistributionSimple]]
     nice_numbers: list[NiceNumberSimple]
+    submit_id: Optional[str] = None
+    backend_downgrades: Optional[list[str]] = None
 
     def to_json(self) -> dict[str, Any]:
-        return {
+        out = {
             "claim_id": self.claim_id,
             "username": self.username,
             "client_version": self.client_version,
@@ -175,10 +184,17 @@ class DataToServer:
                 for n in self.nice_numbers
             ],
         }
+        if self.submit_id is not None:
+            out["submit_id"] = self.submit_id
+        if self.backend_downgrades:
+            out["backend_downgrades"] = list(self.backend_downgrades)
+        return out
 
     @staticmethod
     def from_json(d: dict[str, Any]) -> "DataToServer":
         dist = d.get("unique_distribution")
+        submit_id = d.get("submit_id")
+        downgrades = d.get("backend_downgrades")
         return DataToServer(
             claim_id=int(d["claim_id"]),
             username=str(d["username"]),
@@ -193,6 +209,10 @@ class DataToServer:
                 NiceNumberSimple(int(x["number"]), int(x["num_uniques"]))
                 for x in d.get("nice_numbers", [])
             ],
+            submit_id=None if submit_id is None else str(submit_id),
+            backend_downgrades=None
+            if downgrades is None
+            else [str(x) for x in downgrades],
         )
 
 
@@ -247,10 +267,15 @@ class ValidationData:
 
 @dataclass(frozen=True)
 class FieldResults:
-    """Results of processing a field or chunk (reference lib.rs:319-323)."""
+    """Results of processing a field or chunk (reference lib.rs:319-323).
+
+    backend_downgrades: "from->to" entries, one per mid-field engine
+    fallback that contributed to these results (empty when the scan ran
+    clean on the requested backend)."""
 
     distribution: tuple[UniquesDistributionSimple, ...]
     nice_numbers: tuple[NiceNumberSimple, ...]
+    backend_downgrades: tuple[str, ...] = ()
 
 
 @dataclass

@@ -23,7 +23,12 @@ import time
 from typing import Optional
 
 from nice_tpu import obs
-from nice_tpu.obs.series import DAEMON_CPU, DAEMON_HEARTBEAT, DAEMON_RESTARTS
+from nice_tpu.obs.series import (
+    DAEMON_CPU,
+    DAEMON_HEARTBEAT,
+    DAEMON_RESTART_BACKOFF,
+    DAEMON_RESTARTS,
+)
 
 log = logging.getLogger("nice_tpu.daemon")
 
@@ -96,15 +101,42 @@ class CpuMonitor:
         return 0.0  # "none": report idle; spawning is the safe default
 
 
-class ProcessManager:
-    """Spawns/stops/restarts the client (reference daemon/src/main.rs:124-215)."""
+# Crash-loop protection defaults (ProcessManager): a client that keeps dying
+# within HEALTHY_SECS of spawn (broken config, dead server, bad install)
+# would otherwise be respawned every sample interval forever, hammering the
+# server's claim endpoint and burning the daemon's own CPU budget.
+RESTART_BACKOFF_BASE_SECS = 5.0
+RESTART_BACKOFF_CAP_SECS = 600.0
+HEALTHY_RUN_SECS = 60.0  # env NICE_DAEMON_HEALTHY_SECS
 
-    def __init__(self, client_args: list[str]):
+
+class ProcessManager:
+    """Spawns/stops/restarts the client (reference daemon/src/main.rs:124-215).
+
+    Crash-loop protection: a nonzero exit within healthy_secs of spawn
+    escalates an exponential restart backoff (base 5s, doubling, capped at
+    10 min, published on nice_daemon_restart_backoff_secs); a run that lasts
+    healthy_secs — or any clean exit — resets it."""
+
+    def __init__(
+        self, client_args: list[str], healthy_secs: Optional[float] = None
+    ):
         self.client_args = client_args
         self.proc: Optional[subprocess.Popen] = None
+        self.healthy_secs = (
+            float(os.environ.get("NICE_DAEMON_HEALTHY_SECS", HEALTHY_RUN_SECS))
+            if healthy_secs is None else healthy_secs
+        )
+        self.consecutive_crashes = 0
+        self._started_at: Optional[float] = None
+        self._backoff_until = 0.0
 
     def running(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
+
+    def restart_delay(self) -> float:
+        """Seconds until crash-loop backoff allows another start (0 = now)."""
+        return max(0.0, self._backoff_until - time.monotonic())
 
     def start(self) -> None:
         if self.running():
@@ -112,6 +144,7 @@ class ProcessManager:
         cmd = [sys.executable, "-m", "nice_tpu.client", *self.client_args]
         log.info("starting client: %s", " ".join(cmd))
         self.proc = subprocess.Popen(cmd)
+        self._started_at = time.monotonic()
         DAEMON_RESTARTS.inc()
 
     def stop(self) -> None:
@@ -128,8 +161,32 @@ class ProcessManager:
     def reap(self) -> bool:
         """True if the client exited since last check."""
         if self.proc is not None and self.proc.poll() is not None:
-            log.info("client exited with code %s", self.proc.returncode)
+            code = self.proc.returncode
+            ran = (
+                time.monotonic() - self._started_at
+                if self._started_at is not None else float("inf")
+            )
+            log.info("client exited with code %s", code)
             self.proc = None
+            if code != 0 and ran < self.healthy_secs:
+                self.consecutive_crashes += 1
+                delay = min(
+                    RESTART_BACKOFF_BASE_SECS
+                    * 2 ** (self.consecutive_crashes - 1),
+                    RESTART_BACKOFF_CAP_SECS,
+                )
+                self._backoff_until = time.monotonic() + delay
+                DAEMON_RESTART_BACKOFF.set(delay)
+                log.warning(
+                    "client crashed %.1fs after spawn (crash %d in a row); "
+                    "holding next spawn for %.0fs",
+                    ran, self.consecutive_crashes, delay,
+                )
+            elif self.consecutive_crashes:
+                self.consecutive_crashes = 0
+                self._backoff_until = 0.0
+                DAEMON_RESTART_BACKOFF.set(0)
+                log.info("client ran healthily; restart backoff reset")
             return True
         return False
 
@@ -196,8 +253,11 @@ def main(argv=None) -> int:
                 if idle_since is None:
                     idle_since = time.monotonic()
                 if time.monotonic() - idle_since >= args.wait_time:
-                    manager.start()
-                    idle_since = None
+                    # Crash-loop protection: idle_since stays set, so the
+                    # spawn happens on the first tick after backoff expiry.
+                    if manager.restart_delay() <= 0:
+                        manager.start()
+                        idle_since = None
             else:
                 idle_since = None
                 log.debug("cpu busy (%.0f%%), holding off", usage * 100)

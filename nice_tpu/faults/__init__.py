@@ -1,0 +1,18 @@
+"""Deterministic fault injection + graceful-degradation helpers.
+
+`fire(site, **ctx)` is the single hook production code threads through; the
+NICE_TPU_FAULTS env var (see injector.py for the grammar) decides what, if
+anything, happens there. The submission spool lives in
+nice_tpu.faults.spool (imported lazily — it pulls in the client transport).
+"""
+
+from nice_tpu.faults.injector import (  # noqa: F401
+    ENV_SEED,
+    ENV_SPEC,
+    FaultSpecError,
+    active_sites,
+    configure,
+    fire,
+    parse_spec,
+    reset,
+)

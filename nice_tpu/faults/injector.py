@@ -1,0 +1,229 @@
+"""Deterministic, seeded fault injection.
+
+One env var drives every chaos hook in the system:
+
+    NICE_TPU_FAULTS="http.submit:drop_response@0.3,engine.dispatch:raise@batch=7"
+    NICE_TPU_FAULTS_SEED=42
+
+Grammar: comma-separated rules, each `site:action[@selector]`.
+
+  site      dotted injection-point name (http.submit, server.claim,
+            engine.dispatch, ckpt.write, ...). A site only exists where a
+            fire() call is threaded through the production code; unknown
+            sites parse fine and simply never match.
+  action    opaque string the call site interprets (500, conn_error,
+            drop_response, raise, truncate, ...).
+  selector  when the rule fires:
+              @0.3       float -> independent per-call probability, drawn
+                         from a per-site RNG seeded by NICE_TPU_FAULTS_SEED
+                         (same seed + same call sequence = same faults, and
+                         one site's draws never perturb another's)
+              @2         bare int -> the Nth eligible call at the site,
+                         exactly once
+              @key=val   fires once, on the first call whose ctx has
+                         str(ctx[key]) == val (e.g. engine.dispatch with
+                         batch=7)
+              (omitted)  every eligible call
+
+The module costs one dict lookup per fire() when no spec is configured, so
+production code can leave the hooks permanently threaded through.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from nice_tpu.obs.series import FAULTS_INJECTED
+
+log = logging.getLogger("nice_tpu.faults")
+
+ENV_SPEC = "NICE_TPU_FAULTS"
+ENV_SEED = "NICE_TPU_FAULTS_SEED"
+DEFAULT_SEED = 0
+
+
+class FaultSpecError(ValueError):
+    """Malformed NICE_TPU_FAULTS spec string."""
+
+
+@dataclass
+class _Rule:
+    site: str
+    action: str
+    # Exactly one selector kind is set:
+    probability: Optional[float] = None
+    nth: Optional[int] = None
+    match: Optional[tuple[str, str]] = None  # (ctx key, value as str)
+    always: bool = False
+    # Mutable firing state:
+    calls: int = 0
+    fired: bool = False
+    rng: random.Random = field(default_factory=random.Random)
+
+    def should_fire(self, ctx: dict) -> bool:
+        self.calls += 1
+        if self.probability is not None:
+            return self.rng.random() < self.probability
+        if self.nth is not None:
+            if self.fired or self.calls != self.nth:
+                return False
+            self.fired = True
+            return True
+        if self.match is not None:
+            if self.fired:
+                return False
+            key, want = self.match
+            if key not in ctx or str(ctx[key]) != want:
+                return False
+            self.fired = True
+            return True
+        return self.always
+
+
+def parse_spec(spec: str, seed: int = DEFAULT_SEED) -> list[_Rule]:
+    """Parse a NICE_TPU_FAULTS string into rules (see module docstring)."""
+    rules: list[_Rule] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise FaultSpecError(
+                f"fault rule {part!r} has no action (want site:action[@selector])"
+            )
+        site, rest = part.split(":", 1)
+        site = site.strip()
+        selector = None
+        if "@" in rest:
+            action, selector = rest.split("@", 1)
+        else:
+            action = rest
+        action = action.strip()
+        if not site or not action:
+            raise FaultSpecError(f"fault rule {part!r} has an empty site or action")
+        rule = _Rule(site=site, action=action)
+        # Per-(site, rule-ordinal) RNG stream: probability draws are
+        # reproducible per site regardless of interleaving with other sites.
+        rule.rng = random.Random(f"{seed}:{site}:{len(rules)}")
+        if selector is not None:
+            selector = selector.strip()
+            if "=" in selector:
+                key, val = selector.split("=", 1)
+                rule.match = (key.strip(), val.strip())
+            elif "." in selector or "e" in selector.lower():
+                try:
+                    rule.probability = float(selector)
+                except ValueError:
+                    raise FaultSpecError(
+                        f"fault rule {part!r}: bad probability {selector!r}"
+                    )
+                if not 0.0 <= rule.probability <= 1.0:
+                    raise FaultSpecError(
+                        f"fault rule {part!r}: probability must be in [0, 1]"
+                    )
+            else:
+                try:
+                    rule.nth = int(selector)
+                except ValueError:
+                    raise FaultSpecError(
+                        f"fault rule {part!r}: bad selector {selector!r}"
+                    )
+                if rule.nth < 1:
+                    raise FaultSpecError(
+                        f"fault rule {part!r}: Nth-call selector must be >= 1"
+                    )
+        else:
+            rule.always = True
+        rules.append(rule)
+    return rules
+
+
+class FaultPlan:
+    """Active rule set, indexed by site. Thread-safe: fire() may be called
+    concurrently from dispatch, collector, renewer, and server threads."""
+
+    def __init__(self, rules: list[_Rule]):
+        self._lock = threading.Lock()
+        self.by_site: dict[str, list[_Rule]] = {}
+        for r in rules:
+            self.by_site.setdefault(r.site, []).append(r)
+
+    def fire(self, site: str, ctx: dict) -> Optional[str]:
+        rules = self.by_site.get(site)
+        if not rules:
+            return None
+        with self._lock:
+            for rule in rules:
+                if rule.should_fire(ctx):
+                    FAULTS_INJECTED.labels(site, rule.action).inc()
+                    log.warning(
+                        "injected fault at %s: action=%s ctx=%s (call %d)",
+                        site, rule.action, ctx, rule.calls,
+                    )
+                    return rule.action
+        return None
+
+
+_EMPTY = FaultPlan([])
+_plan: Optional[FaultPlan] = None
+_plan_lock = threading.Lock()
+
+
+def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
+    """Install a fault plan explicitly (tests / tools). spec=None or ""
+    clears every rule."""
+    global _plan
+    with _plan_lock:
+        if not spec:
+            _plan = _EMPTY
+        else:
+            _plan = FaultPlan(
+                parse_spec(spec, DEFAULT_SEED if seed is None else int(seed))
+            )
+
+
+def reset() -> None:
+    """Drop the active plan; the next fire() re-reads the environment."""
+    global _plan
+    with _plan_lock:
+        _plan = None
+
+
+def _active() -> FaultPlan:
+    global _plan
+    plan = _plan
+    if plan is None:
+        with _plan_lock:
+            if _plan is None:
+                spec = os.environ.get(ENV_SPEC, "")
+                seed = int(os.environ.get(ENV_SEED, DEFAULT_SEED))
+                _plan = (
+                    FaultPlan(parse_spec(spec, seed)) if spec.strip() else _EMPTY
+                )
+                if _plan.by_site:
+                    log.warning(
+                        "fault injection ACTIVE (%s=%r seed=%d)",
+                        ENV_SPEC, spec, seed,
+                    )
+            plan = _plan
+    return plan
+
+
+def fire(site: str, **ctx) -> Optional[str]:
+    """The injection hook: returns the action string when a rule fires at
+    this site for this call, else None. Near-free when no faults are
+    configured."""
+    plan = _active()
+    if not plan.by_site:
+        return None
+    return plan.fire(site, ctx)
+
+
+def active_sites() -> tuple[str, ...]:
+    """Sites with at least one configured rule (diagnostics)."""
+    return tuple(sorted(_active().by_site))

@@ -1,0 +1,160 @@
+"""On-disk submission spool: graceful degradation for the submit path.
+
+When a submit exhausts its HTTP retries (server down for longer than the
+backoff budget), the client journals the full DataToServer payload here —
+one JSON file per submission, written atomically — and moves on. At the
+next loop iteration or startup, replay() re-sends every spooled entry:
+
+  * accepted (or {"duplicate": true} — the original request had landed
+    after all): the entry is deleted; exactly-once is the server's job via
+    submit_id, the spool just has to keep trying;
+  * definitively rejected (4xx, e.g. the claim lease expired and the field
+    was re-issued): the entry is renamed to <name>.rejected and kept for
+    post-mortem — replaying it again can never succeed;
+  * still unreachable: the entry stays for the next replay.
+
+Entries are keyed by submit_id, so re-journaling the same submission (crash
+between journal and replay) overwrites rather than duplicates.
+
+This module imports the client transport, so it is NOT re-exported from
+nice_tpu.faults (which the transport itself imports).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Optional
+
+from nice_tpu.client import api_client
+from nice_tpu.core.types import DataToServer
+from nice_tpu.obs.series import SPOOL_JOURNALED, SPOOL_REPLAYS
+
+log = logging.getLogger(__name__)
+
+_SUFFIX = ".json"
+_UNSAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+class SubmissionSpool:
+    """A directory of journaled submissions awaiting delivery."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+
+    def _path_for(self, data: DataToServer) -> str:
+        key = data.submit_id or f"claim-{data.claim_id}"
+        return os.path.join(self.dir, _UNSAFE.sub("_", key) + _SUFFIX)
+
+    def add(self, data: DataToServer) -> str:
+        """Atomically journal a submission; returns the entry path."""
+        path = self._path_for(data)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data.to_json(), f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        SPOOL_JOURNALED.inc()
+        log.warning(
+            "journaled undeliverable submission for claim %d to %s "
+            "(will replay)", data.claim_id, path,
+        )
+        return path
+
+    def pending(self) -> list[str]:
+        """Journaled entry paths, oldest first (stable mtime-then-name)."""
+        try:
+            names = [
+                n for n in os.listdir(self.dir) if n.endswith(_SUFFIX)
+            ]
+        except FileNotFoundError:
+            return []
+        paths = [os.path.join(self.dir, n) for n in names]
+        return sorted(paths, key=lambda p: (os.path.getmtime(p), p))
+
+    def replay(
+        self, api_base: str, max_retries: int = 2
+    ) -> dict[str, int]:
+        """Attempt delivery of every pending entry; returns outcome counts
+        {"delivered": n, "rejected": n, "deferred": n}.
+
+        max_retries is deliberately small: the spool is itself the retry
+        mechanism, so each replay pass should fail fast and yield to the
+        caller's main loop rather than sit in a deep backoff."""
+        counts = {"delivered": 0, "rejected": 0, "deferred": 0}
+        for path in self.pending():
+            outcome = self._replay_one(path, api_base, max_retries)
+            counts[outcome] += 1
+            SPOOL_REPLAYS.labels(outcome).inc()
+        if sum(counts.values()):
+            log.info(
+                "spool replay: %d delivered, %d rejected, %d deferred",
+                counts["delivered"], counts["rejected"], counts["deferred"],
+            )
+        return counts
+
+    def _replay_one(
+        self, path: str, api_base: str, max_retries: int
+    ) -> str:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = DataToServer.from_json(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            log.error("unreadable spool entry %s: %s", path, e)
+            self._quarantine(path)
+            return "rejected"
+        try:
+            resp = api_client.submit_field_to_server(
+                api_base, data, max_retries=max_retries
+            )
+        except api_client.ApiError as e:
+            if e.status is not None and 400 <= e.status < 500:
+                log.error(
+                    "spooled submission for claim %d rejected by the server "
+                    "(%s); keeping %s.rejected for post-mortem",
+                    data.claim_id, e, path,
+                )
+                self._quarantine(path)
+                return "rejected"
+            log.warning(
+                "spooled submission for claim %d still undeliverable (%s); "
+                "will retry next replay", data.claim_id, e,
+            )
+            return "deferred"
+        log.info(
+            "delivered spooled submission for claim %d%s", data.claim_id,
+            " (duplicate: the original had landed)"
+            if resp.get("duplicate") else "",
+        )
+        self._remove(path)
+        return "delivered"
+
+    @staticmethod
+    def _remove(path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+
+    @staticmethod
+    def _quarantine(path: str) -> None:
+        try:
+            os.replace(path, path + ".rejected")
+        except OSError:
+            pass
+
+
+def maybe_spool(
+    spool_dir: Optional[str], checkpoint_dir: Optional[str] = None
+) -> Optional[SubmissionSpool]:
+    """Spool for the client: an explicit dir wins; otherwise co-locate with
+    the checkpoint dir (both are 'survive a crash' state); no dir, no spool."""
+    if spool_dir:
+        return SubmissionSpool(spool_dir)
+    if checkpoint_dir:
+        return SubmissionSpool(os.path.join(checkpoint_dir, "spool"))
+    return None

@@ -150,6 +150,31 @@ CKPT_REJECTED = metrics.counter(
     labelnames=("reason",),
 )
 
+# --- fault injection + degradation (faults/, ops/engine.py) --------------
+FAULTS_INJECTED = metrics.counter(
+    "nice_faults_injected_total",
+    "Chaos faults actually fired, by injection site and action "
+    "(NICE_TPU_FAULTS; zero in production unless someone armed the spec).",
+    labelnames=("site", "action"),
+)
+ENGINE_BACKEND_DOWNGRADES = metrics.counter(
+    "nice_engine_backend_downgrades_total",
+    "Mid-field backend fallbacks after a dispatch failure "
+    "(pallas -> jnp -> scalar chain).",
+    labelnames=("from_backend", "to_backend"),
+)
+SPOOL_JOURNALED = metrics.counter(
+    "nice_client_spool_journaled_total",
+    "Finished submissions journaled to the on-disk spool after retry "
+    "exhaustion instead of being dropped.",
+)
+SPOOL_REPLAYS = metrics.counter(
+    "nice_client_spool_replays_total",
+    "Spooled submissions replayed, by outcome (accepted / duplicate / "
+    "rejected 4xx / failed-will-retry).",
+    labelnames=("outcome",),
+)
+
 # --- server (server/app.py, server/db.py) --------------------------------
 SERVER_CLAIM_EXPIRY = metrics.gauge(
     "nice_server_claim_expiry_window_seconds",
@@ -164,6 +189,20 @@ SERVER_FIELDS_RELEASED = metrics.counter(
     "nice_server_fields_released_total",
     "Pre-claimed queue fields released back to the DB on queue close.",
 )
+SERVER_DUPLICATE_SUBMITS = metrics.counter(
+    "nice_server_duplicate_submits_total",
+    "Submissions replayed with an already-persisted submit_id and answered "
+    "idempotently instead of double-inserting.",
+)
+SERVER_OVERLOAD_RESPONSES = metrics.counter(
+    "nice_server_overload_responses_total",
+    "Requests answered 503 + Retry-After because the in-flight request "
+    "count exceeded NICE_TPU_MAX_INFLIGHT.",
+)
+SERVER_SQLITE_BUSY_RETRIES = metrics.counter(
+    "nice_server_sqlite_busy_retries_total",
+    "Write transactions retried after SQLITE_BUSY before succeeding.",
+)
 
 # --- daemon (daemon/main.py) --------------------------------------------
 DAEMON_HEARTBEAT = metrics.gauge(
@@ -177,6 +216,12 @@ DAEMON_RESTARTS = metrics.counter(
 DAEMON_CPU = metrics.gauge(
     "nice_daemon_cpu_usage_ratio",
     "Most recent whole-machine CPU usage sample (0..1).",
+)
+DAEMON_RESTART_BACKOFF = metrics.gauge(
+    "nice_daemon_restart_backoff_secs",
+    "Crash-loop protection: the restart delay imposed after the client's "
+    "latest short-lived nonzero exit (0 = no backoff; resets after a "
+    "healthy run).",
 )
 
 # Pre-seed the label combinations every layer emits, so a scrape of a fresh
@@ -208,3 +253,7 @@ for _endpoint in ("claim", "submit", "validate", "renew"):
     CLIENT_RETRIES.labels(_endpoint)
 for _reason in ("corrupt", "signature", "version"):
     CKPT_REJECTED.labels(_reason)
+for _outcome in ("delivered", "rejected", "deferred"):
+    SPOOL_REPLAYS.labels(_outcome)
+for _from, _to in (("pallas", "jnp"), ("jnp", "scalar")):
+    ENGINE_BACKEND_DOWNGRADES.labels(_from, _to)

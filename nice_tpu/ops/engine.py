@@ -16,6 +16,7 @@ oracle per sub-range.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import time
@@ -24,6 +25,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from nice_tpu import faults
 from nice_tpu import obs
 from nice_tpu.core import base_range
 from nice_tpu.core.types import (
@@ -41,6 +43,7 @@ from nice_tpu.obs.series import (
     CKPT_BATCHES_SKIPPED,
     CKPT_RESTORES,
     ENGINE_AUDITS,
+    ENGINE_BACKEND_DOWNGRADES,
     ENGINE_BATCH_KERNEL_SECONDS,
     ENGINE_DESCRIPTORS,
     ENGINE_DISPATCH_OCCUPANCY,
@@ -225,6 +228,93 @@ def _pick_backend(plan, batch_size: int, backend: str) -> str:
     return "jnp"
 
 
+class BackendDispatchError(RuntimeError):
+    """A backend failed mid-field while dispatching batches.
+
+    Raised by the _process_range_* impls at the degradation boundary so the
+    public wrappers can re-dispatch the remainder of the field on the next
+    backend in the fallback chain.
+
+    backend: the RESOLVED backend that failed ("pallas" / "jnp" / ...), not
+    the caller's "jax" alias — the chain steps from what actually ran.
+    state:   a checkpoint-contract resume dict ({"cursor", "hist",
+             "nice_numbers"}) covering everything folded before the failure,
+             or None when nothing is salvageable (the field restarts from the
+             caller's own resume point, if any).
+    cause:   the original exception."""
+
+    def __init__(self, backend: str, state, cause: BaseException):
+        super().__init__(f"backend {backend!r} failed mid-dispatch: {cause!r}")
+        self.backend = backend
+        self.state = state
+        self.cause = cause
+
+
+# Degradation chain: each resolved backend's replacement when it fails
+# mid-field. Ends at the scalar oracle (pure Python ints — no device, no
+# compiled kernels), whose failures propagate to the caller.
+_FALLBACK_NEXT = {"pallas": "jnp", "jnp": "scalar"}
+
+
+def _fallback_enabled() -> bool:
+    return os.environ.get("NICE_TPU_NO_FALLBACK", "") != "1"
+
+
+def _fire_dispatch_fault(n_batch: int, backend: str, batch_start: int) -> None:
+    """Chaos hook (engine.dispatch): any configured action raises, exercising
+    the same degradation boundary a real device failure would hit."""
+    act = faults.fire(
+        "engine.dispatch", batch=n_batch, backend=backend, start=batch_start
+    )
+    if act is not None:
+        raise RuntimeError(f"injected engine.dispatch fault: {act}")
+
+
+def _run_with_fallback(impl, range_, base, backend, kwargs) -> FieldResults:
+    """Run a _process_range_* impl under the pallas -> jnp -> scalar chain.
+
+    On BackendDispatchError the failed batch (and everything after it) is
+    re-dispatched on the next backend via the checkpoint/resume contract —
+    work folded before the failure is kept, not recomputed. Each downgrade
+    increments nice_engine_backend_downgrades_total and is stamped into
+    FieldResults.backend_downgrades ("from->to") so it travels with the
+    submission. NICE_TPU_NO_FALLBACK=1 disables the chain (the error
+    propagates, for tests and debugging)."""
+    downgrades: list[str] = []
+    kw = kwargs
+    while True:
+        try:
+            results = impl(range_, base, backend=backend, **kw)
+        except BackendDispatchError as e:
+            nxt = _FALLBACK_NEXT.get(e.backend)
+            if nxt is None or not _fallback_enabled():
+                raise
+            ENGINE_BACKEND_DOWNGRADES.labels(e.backend, nxt).inc()
+            downgrades.append(f"{e.backend}->{nxt}")
+            log.warning(
+                "backend %s failed mid-field [%d, %d): %r — %s on %s "
+                "(downgrade %d)",
+                e.backend, range_.start(), range_.end(), e.cause,
+                "resuming at cursor %d" % e.state["cursor"]
+                if e.state is not None else "restarting",
+                nxt, len(downgrades),
+            )
+            backend = nxt
+            kw = dict(kwargs)
+            # A consistent mid-field state resumes the scan where it broke;
+            # a lost state falls back to the caller's own resume point (its
+            # snapshot still covers that prefix) or a clean restart.
+            kw["resume"] = e.state if e.state is not None else kwargs.get("resume")
+            continue
+        if downgrades:
+            results = dataclasses.replace(
+                results,
+                backend_downgrades=results.backend_downgrades
+                + tuple(downgrades),
+            )
+        return results
+
+
 import functools
 
 
@@ -397,8 +487,13 @@ def _chunked_host_scan(
     ticker = (
         _CkptTicker(every_batches, every_secs) if checkpoint_cb else None
     )
+    n_batch = 0
     while done < total:
         n = min(chunk, total - done)
+        # End of the degradation chain: an injected (or real) scalar failure
+        # propagates to the caller — there is nothing left to fall back to.
+        _fire_dispatch_fault(n_batch, "scalar", start + done)
+        n_batch += 1
         sub_range = FieldSize(start + done, start + done + n)
         if detailed:
             sub = scalar.process_range_detailed(sub_range, base)
@@ -1210,6 +1305,7 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None,
     t_wall0 = time.monotonic()
     producer.start()
     n_desc = 0
+    n_groups = 0
     # Dispatcher stall accounting: gen (host desc-gen + waiting on the
     # producer), disp (jax dispatch call), put (backpressure from the
     # collector/device window) — the trace tells which stage bounds the wall.
@@ -1229,6 +1325,8 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None,
                     k_real = len(cols[0])
                     n_desc += k_real
                     ENGINE_DESCRIPTORS.inc(k_real)
+                    _fire_dispatch_fault(n_groups, "pallas", _at(cols, 0, 0))
+                    n_groups += 1
                     packed = pack(cols)
                     if sharded_step is not None:
                         per_dev_real = np.clip(
@@ -1282,6 +1380,37 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None,
 
 
 def process_range_detailed(
+    range_: FieldSize,
+    base: int,
+    backend: str = "jax",
+    batch_size: int = DEFAULT_BATCH_SIZE,
+    progress=None,
+    *,
+    checkpoint_cb=None,
+    resume=None,
+    checkpoint_batches=None,
+    checkpoint_secs=None,
+) -> FieldResults:
+    """Full histogram + near-miss list, exact, any backend — with graceful
+    backend degradation: a mid-field dispatch failure on pallas re-dispatches
+    the failed batch (and the rest of the field) on jnp, and a jnp failure on
+    the scalar oracle, resuming from the failure cursor so completed work is
+    kept. Downgrades land in FieldResults.backend_downgrades and the
+    nice_engine_backend_downgrades_total counter; NICE_TPU_NO_FALLBACK=1
+    disables the chain. See _process_range_detailed for the full
+    checkpoint/resume contract."""
+    return _run_with_fallback(
+        _process_range_detailed, range_, base, backend,
+        dict(
+            batch_size=batch_size, progress=progress,
+            checkpoint_cb=checkpoint_cb, resume=resume,
+            checkpoint_batches=checkpoint_batches,
+            checkpoint_secs=checkpoint_secs,
+        ),
+    )
+
+
+def _process_range_detailed(
     range_: FieldSize,
     base: int,
     backend: str = "jax",
@@ -1478,16 +1607,27 @@ def process_range_detailed(
     )
     acc = new_acc()
     since_flush = 0
+    dispatch_failure = None  # (exception, cursor of the failed batch)
     with _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.detailed", base=base, size=total):
             done = done0
+            n_batch = 0
             while done < total:
                 if collector.failed():
                     break
                 valid = min(lanes, total - done)
                 batch_start = start + done
-                acc, nm = dispatch(acc, batch_start, valid)
+                try:
+                    # The chaos hook precedes the real dispatch so an
+                    # injected failure leaves the donated accumulator alive
+                    # and the flush below folds a consistent prefix.
+                    _fire_dispatch_fault(n_batch, backend, batch_start)
+                    acc, nm = dispatch(acc, batch_start, valid)
+                except Exception as e:  # noqa: BLE001 — degradation boundary
+                    dispatch_failure = (e, batch_start)
+                    break
+                n_batch += 1
                 collector.put(("nm", batch_start, valid, nm))
                 since_flush += 1
                 done += valid
@@ -1506,7 +1646,26 @@ def process_range_detailed(
                 if progress is not None:
                     progress(done, total)
             if since_flush:
+                # Best-effort on the failure path: a real device error may
+                # have invalidated the donated accumulator, in which case the
+                # collector's fold fails too and the state below degrades to
+                # a full restart.
                 collector.put(("stats", acc))
+    if dispatch_failure is not None:
+        err, fail_cursor = dispatch_failure
+        # The collector has drained: hist/nice_numbers now cover every batch
+        # dispatched before the failure — exactly the checkpoint contract
+        # with cursor = the failed batch's start.
+        state = None
+        if not collector.failed():
+            state = {
+                "cursor": fail_cursor,
+                "hist": hist.copy(),
+                "nice_numbers": [
+                    (n.number, n.num_uniques) for n in nice_numbers
+                ],
+            }
+        raise BackendDispatchError(backend, state, err)
     collector.raise_if_failed()
     ENGINE_NUMBERS.labels("detailed").inc(range_.size())
 
@@ -1519,6 +1678,41 @@ def process_range_detailed(
 
 
 def process_range_niceonly(
+    range_: FieldSize,
+    base: int,
+    stride_table=None,
+    backend: str = "jax",
+    batch_size: int = DEFAULT_BATCH_SIZE,
+    progress=None,
+    *,
+    checkpoint_cb=None,
+    resume=None,
+    checkpoint_batches=None,
+    checkpoint_secs=None,
+) -> FieldResults:
+    """Nice-number search with graceful backend degradation: a mid-field
+    dispatch failure re-dispatches the remainder of the field on the next
+    backend in the pallas -> jnp -> scalar chain via the checkpoint/resume
+    watermark contract (strided-pipeline failures restart the clipped core —
+    its internal state is not resumable from outside). Downgrades land in
+    FieldResults.backend_downgrades and the
+    nice_engine_backend_downgrades_total counter; NICE_TPU_NO_FALLBACK=1
+    disables the chain. See _process_range_niceonly for the full contract."""
+    return _run_with_fallback(
+        lambda r, b, backend, **kw: _process_range_niceonly(
+            r, b, stride_table, backend=backend, **kw
+        ),
+        range_, base, backend,
+        dict(
+            batch_size=batch_size, progress=progress,
+            checkpoint_cb=checkpoint_cb, resume=resume,
+            checkpoint_batches=checkpoint_batches,
+            checkpoint_secs=checkpoint_secs,
+        ),
+    )
+
+
+def _process_range_niceonly(
     range_: FieldSize,
     base: int,
     stride_table=None,
@@ -1652,10 +1846,10 @@ def process_range_niceonly(
         # _pick_stride_depth and expands offsets host-side; any passed
         # stride_table only parameterizes the scalar/host paths).
         ckpt_closure = None
+        # Freeze the pre-core survivors (slivers / restored prefix): the
+        # strided collector only sees numbers from the clipped core.
+        prior = [(n.number, n.num_uniques) for n in nice_numbers]
         if checkpoint_cb is not None:
-            # Freeze the pre-core survivors (slivers / restored prefix): the
-            # strided collector only sees numbers from the clipped core.
-            prior = [(n.number, n.num_uniques) for n in nice_numbers]
 
             def ckpt_closure(watermark, found):
                 checkpoint_cb({
@@ -1664,16 +1858,28 @@ def process_range_niceonly(
                     "nice_numbers": prior + [(n, base) for n in found],
                 })
 
-        with obs.span("engine.niceonly-strided", base=base, size=core.size()):
-            nice_numbers.extend(
-                NiceNumberSimple(number=n, num_uniques=base)
-                for n in _niceonly_pallas(
+        try:
+            with obs.span(
+                "engine.niceonly-strided", base=base, size=core.size()
+            ):
+                found = _niceonly_pallas(
                     core, base, progress=progress,
                     checkpoint=ckpt_closure,
                     checkpoint_batches=checkpoint_batches,
                     checkpoint_secs=checkpoint_secs,
                 )
-            )
+        except Exception as e:  # noqa: BLE001 — degradation boundary
+            # The strided pipeline's progress lives in its own threads;
+            # restart the (clipped) core on the next backend, keeping the
+            # slivers / restored prefix.
+            raise BackendDispatchError(
+                "pallas",
+                {"cursor": core.start(), "hist": None, "nice_numbers": prior},
+                e,
+            ) from e
+        nice_numbers.extend(
+            NiceNumberSimple(number=n, num_uniques=base) for n in found
+        )
         nice_numbers.sort(key=lambda n: n.number)
         ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
         return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
@@ -1758,11 +1964,13 @@ def process_range_niceonly(
     # the device->host RTT synchronously on the dispatch thread once its
     # deque filled (verdict task #6). Only the collector touches
     # nice_numbers.
+    dispatch_failure = None  # (exception, cursor of the failed batch)
     with _Collector(collect_item, DISPATCH_WINDOW, "dense-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.niceonly-dense", base=base, size=core.size()):
+            n_batch = 0
             for sub_range in sub_ranges:
-                if collector.failed():
+                if collector.failed() or dispatch_failure is not None:
                     break
                 start = sub_range.start()
                 total = sub_range.size()
@@ -1772,14 +1980,34 @@ def process_range_niceonly(
                         break
                     valid = min(lanes, total - done)
                     batch_start = start + done
-                    collector.put(
-                        (batch_start, valid,
-                         dispatch(batch_start, valid, sub_range.end()))
-                    )
+                    try:
+                        _fire_dispatch_fault(n_batch, backend, batch_start)
+                        counts = dispatch(batch_start, valid, sub_range.end())
+                    except Exception as e:  # noqa: BLE001 — degradation boundary
+                        dispatch_failure = (e, batch_start)
+                        break
+                    n_batch += 1
+                    collector.put((batch_start, valid, counts))
                     done += valid
                     grand_done += valid
                     if progress is not None:
                         progress(grand_done, grand_total)
+    if dispatch_failure is not None:
+        err, fail_cursor = dispatch_failure
+        # Batches dispatch in ascending order over ascending sub_ranges, and
+        # the MSD gaps between them hold no nice numbers — so after the
+        # collector drains, nice_numbers holds everything below the failed
+        # batch's start: a valid watermark cursor.
+        state = None
+        if not collector.failed():
+            state = {
+                "cursor": fail_cursor,
+                "hist": None,
+                "nice_numbers": [
+                    (n.number, n.num_uniques) for n in nice_numbers
+                ],
+            }
+        raise BackendDispatchError(backend, state, err)
     collector.raise_if_failed()
     device_secs = time.monotonic() - t_dev0
     ctrl.observe(host_secs, device_secs, core.size())

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import random
 import sqlite3
 import threading
@@ -19,7 +20,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from nice_tpu import obs
+from nice_tpu import faults, obs
 from nice_tpu.core import distribution_stats, number_stats
 from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
 from nice_tpu.core.types import (
@@ -27,6 +28,10 @@ from nice_tpu.core.types import (
     DataToServer,
     FieldClaimStrategy,
     SearchMode,
+)
+from nice_tpu.obs.series import (
+    SERVER_DUPLICATE_SUBMITS,
+    SERVER_OVERLOAD_RESPONSES,
 )
 from nice_tpu.ops import scalar
 from nice_tpu.server.db import Db
@@ -97,6 +102,24 @@ class ApiContext:
         self.db = db
         self.queue = FieldQueue(db)
         self.metrics = Metrics()
+        # Overload shed: when more than max_inflight requests are being
+        # handled at once, new ones (except /metrics) get 503 + Retry-After
+        # instead of queueing unboundedly behind the thread-per-connection
+        # server. Clients honor the hint in retry_request.
+        self.max_inflight = int(os.environ.get("NICE_TPU_MAX_INFLIGHT", 128))
+        self.retry_after_secs = int(os.environ.get("NICE_TPU_RETRY_AFTER_SECS", 2))
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def enter_request(self) -> bool:
+        """Register an in-flight request; False means shed it (503)."""
+        with self._inflight_lock:
+            self._inflight += 1
+            return self._inflight <= self.max_inflight
+
+    def exit_request(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
 
 
 class ApiError(Exception):
@@ -171,9 +194,28 @@ def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> Data
     )
 
 
+def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
+    SERVER_DUPLICATE_SUBMITS.inc()
+    log.info(
+        "Duplicate Submission replay: claim=%d submit_id=%s answered "
+        "idempotently", data.claim_id, data.submit_id,
+    )
+    return {"status": "OK", "duplicate": True}
+
+
 def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
-    """Verify + persist a submission (reference api/src/main.rs:241-404)."""
+    """Verify + persist a submission (reference api/src/main.rs:241-404).
+
+    Exactly-once: when the payload carries a submit_id (claim + content
+    hash) that is already persisted, the reply is {"duplicate": true} and no
+    second row is inserted — a client that lost the first 200 (dropped
+    response, crash between submit and ack) can replay safely. The fast
+    path is a read; the partial unique index on submissions.submit_id closes
+    the check-then-insert race between two concurrent replays."""
     data = DataToServer.from_json(payload)
+    if data.submit_id:
+        if ctx.db.get_submission_by_submit_id(data.submit_id) is not None:
+            return _submit_duplicate_reply(ctx, data)
     try:
         claim = ctx.db.get_claim_by_id(data.claim_id)
     except KeyError as e:
@@ -189,10 +231,14 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
 
     if claim.search_mode == SearchMode.NICEONLY:
         # Honor system: no verification (reference api/src/main.rs:278-300).
-        ctx.db.insert_submission(
-            claim, data.username, data.client_version, user_ip, None,
-            numbers_expanded, elapsed_secs=elapsed_secs,
-        )
+        try:
+            ctx.db.insert_submission(
+                claim, data.username, data.client_version, user_ip, None,
+                numbers_expanded, elapsed_secs=elapsed_secs,
+                submit_id=data.submit_id,
+            )
+        except sqlite3.IntegrityError:
+            return _submit_duplicate_reply(ctx, data)
         if field.check_level == 0:
             ctx.db.update_field_canon_and_cl(
                 field.field_id, field.canon_submission_id, 1
@@ -243,26 +289,32 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
                     f"Unique count for {n.number} is incorrect (submitted as"
                     f" {n.num_uniques}, server calculated {calculated}).",
                 )
-        ctx.db.insert_submission(
-            claim,
-            data.username,
-            data.client_version,
-            user_ip,
-            distribution_expanded,
-            numbers_expanded,
-            elapsed_secs=elapsed_secs,
-        )
+        try:
+            ctx.db.insert_submission(
+                claim,
+                data.username,
+                data.client_version,
+                user_ip,
+                distribution_expanded,
+                numbers_expanded,
+                elapsed_secs=elapsed_secs,
+                submit_id=data.submit_id,
+            )
+        except sqlite3.IntegrityError:
+            return _submit_duplicate_reply(ctx, data)
         if field.check_level < 2:
             ctx.db.update_field_canon_and_cl(
                 field.field_id, field.canon_submission_id, 2
             )
 
     log.info(
-        "New Submission: mode=%s field=%d claim=%d username=%s",
+        "New Submission: mode=%s field=%d claim=%d username=%s%s",
         claim.search_mode,
         claim.field_id,
         claim.claim_id,
         data.username,
+        f" backend_downgrades={data.backend_downgrades}"
+        if data.backend_downgrades else "",
     )
     return {"status": "OK"}
 
@@ -326,7 +378,8 @@ def make_handler(ctx: ApiContext):
         def log_message(self, fmt, *args):  # route through logging
             log.debug("%s " + fmt, self.address_string(), *args)
 
-        def _send(self, status: int, body: dict | str, content_type="application/json"):
+        def _send(self, status: int, body: dict | str,
+                  content_type="application/json", extra_headers=None):
             raw = (
                 json.dumps(body).encode()
                 if not isinstance(body, str)
@@ -339,18 +392,57 @@ def make_handler(ctx: ApiContext):
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
             self.send_header("Access-Control-Allow-Headers", "Content-Type")
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(raw)
 
-        def _error(self, status: int, message: str):
-            self._send(status, {"error": {"code": status, "message": message}})
+        def _error(self, status: int, message: str, extra_headers=None):
+            self._send(
+                status, {"error": {"code": status, "message": message}},
+                extra_headers=extra_headers,
+            )
 
         def _route(self, method: str):
             t0 = time.monotonic()
             path = urlparse(self.path).path.rstrip("/")
             endpoint = path or "/"
             status = 200
+            within_cap = ctx.enter_request()
             try:
+                # Overload shed: past the in-flight cap, answer 503 with a
+                # Retry-After hint instead of queueing unboundedly. /metrics
+                # stays exempt — overload is exactly when scrapes matter.
+                if (
+                    not within_cap
+                    and path != "/metrics"
+                    and method != "OPTIONS"
+                ):
+                    SERVER_OVERLOAD_RESPONSES.inc()
+                    status = 503
+                    self._error(
+                        503,
+                        f"server overloaded (> {ctx.max_inflight} requests"
+                        " in flight); retry later",
+                        extra_headers={"Retry-After": str(ctx.retry_after_secs)},
+                    )
+                    return
+                # Chaos hook: server.<first path segment> (server.submit,
+                # server.claim, ...). Numeric actions inject that status
+                # before the real handler runs; "drop" closes the connection
+                # without a response (the client sees a mid-request crash).
+                seg = (path.lstrip("/").split("/", 1)[0]) or "root"
+                act = faults.fire(f"server.{seg}", path=path, method=method)
+                if act is not None:
+                    if act == "drop":
+                        status = 0  # no response ever written
+                        self.close_connection = True
+                        return
+                    try:
+                        code = int(act)
+                    except ValueError:
+                        code = 500
+                    raise ApiError(code, f"injected fault: {act}")
                 user_ip = self.client_address[0]
                 if method == "OPTIONS":
                     self.send_response(204)
@@ -472,6 +564,7 @@ def make_handler(ctx: ApiContext):
                 log.exception("internal error handling %s %s", method, path)
                 self._error(500, f"Internal server error: {e}")
             finally:
+                ctx.exit_request()
                 ctx.metrics.record(endpoint, status, time.monotonic() - t0)
 
         def _try_static(self, path: str) -> bool:

@@ -32,6 +32,7 @@ from nice_tpu.obs.series import (
     SERVER_CLAIM_EXPIRY,
     SERVER_CLAIM_RENEWALS,
     SERVER_FIELDS_RELEASED,
+    SERVER_SQLITE_BUSY_RETRIES,
 )
 from nice_tpu.core.types import (
     ClaimRecord,
@@ -201,6 +202,25 @@ class Db:
         with open(schema_path) as f:
             with self._lock:
                 self._conn.executescript(f.read())
+                # Legacy-DB migration: CREATE TABLE IF NOT EXISTS leaves a
+                # pre-submit_id submissions table untouched, so add the
+                # column before the partial unique index that enforces
+                # exactly-once submits (NULL submit_ids — legacy clients —
+                # stay outside the index and never collide).
+                cols = {
+                    r["name"]
+                    for r in self._conn.execute(
+                        "PRAGMA table_info(submissions)"
+                    ).fetchall()
+                }
+                if "submit_id" not in cols:
+                    self._conn.execute(
+                        "ALTER TABLE submissions ADD COLUMN submit_id TEXT"
+                    )
+                self._conn.execute(
+                    "CREATE UNIQUE INDEX IF NOT EXISTS idx_submissions_submit_id"
+                    " ON submissions(submit_id) WHERE submit_id IS NOT NULL"
+                )
 
     def close(self) -> None:
         with self._lock, self._pool_lock:
@@ -254,13 +274,36 @@ class Db:
 
     # -- transactions -----------------------------------------------------
 
+    # BEGIN IMMEDIATE takes the write lock up front; when ANOTHER process
+    # holds it (multi-worker deployments, the jobs runner) past busy_timeout,
+    # sqlite surfaces SQLITE_BUSY as OperationalError. A short bounded retry
+    # absorbs claim/renew/submit write bursts instead of bubbling them up as
+    # 500s; in-process writers never hit this (the RLock serializes them).
+    TXN_BUSY_RETRIES = 5
+    TXN_BUSY_SLEEP_SECS = 0.05
+
     class _Txn:
         def __init__(self, conn):
             self.conn = conn
 
+        @staticmethod
+        def _is_busy(e: sqlite3.OperationalError) -> bool:
+            msg = str(e).lower()
+            return "locked" in msg or "busy" in msg
+
         def __enter__(self):
-            self.conn.execute("BEGIN IMMEDIATE")
-            return self
+            import time as _time
+
+            for attempt in range(Db.TXN_BUSY_RETRIES + 1):
+                try:
+                    self.conn.execute("BEGIN IMMEDIATE")
+                    return self
+                except sqlite3.OperationalError as e:
+                    if not self._is_busy(e) or attempt >= Db.TXN_BUSY_RETRIES:
+                        raise
+                    SERVER_SQLITE_BUSY_RETRIES.inc()
+                    _time.sleep(Db.TXN_BUSY_SLEEP_SECS * (attempt + 1))
+            raise AssertionError("unreachable")
 
         def __exit__(self, exc_type, *a):
             if exc_type is None:
@@ -594,15 +637,19 @@ class Db:
         distribution: Optional[list[UniquesDistribution]],
         numbers: list[NiceNumber],
         elapsed_secs: float = 0.0,
+        submit_id: Optional[str] = None,
     ) -> int:
+        """Insert one submission row. A duplicate submit_id raises
+        sqlite3.IntegrityError (the partial unique index) — callers treat
+        that as "already accepted", not as data loss."""
         when = now_utc()
         mode = "detailed" if claim.search_mode == SearchMode.DETAILED else "niceonly"
         with self._lock, self._txn():
             cur = self._conn.execute(
                 "INSERT INTO submissions (claim_id, field_id, search_mode,"
                 " submit_time, elapsed_secs, username, user_ip, client_version,"
-                " disqualified, distribution, numbers)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 0, ?, ?)",
+                " disqualified, distribution, numbers, submit_id)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 0, ?, ?, ?)",
                 (
                     claim.claim_id,
                     claim.field_id,
@@ -614,9 +661,21 @@ class Db:
                     client_version,
                     _dist_to_json(distribution),
                     _numbers_to_json(numbers),
+                    submit_id,
                 ),
             )
             return cur.lastrowid
+
+    def get_submission_by_submit_id(
+        self, submit_id: str
+    ) -> Optional[SubmissionRecord]:
+        """The already-accepted submission carrying this idempotency key, if
+        any (the exactly-once replay check)."""
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT * FROM submissions WHERE submit_id = ?", (submit_id,)
+            ).fetchone()
+        return None if row is None else self._row_to_submission(row)
 
     def _row_to_submission(self, row: sqlite3.Row) -> SubmissionRecord:
         return SubmissionRecord(

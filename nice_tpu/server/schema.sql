@@ -67,8 +67,15 @@ CREATE TABLE IF NOT EXISTS submissions (
     client_version  TEXT NOT NULL,
     disqualified    INTEGER NOT NULL DEFAULT 0,
     distribution    TEXT,                          -- JSON or NULL (niceonly)
-    numbers         TEXT NOT NULL DEFAULT '[]'     -- JSON
+    numbers         TEXT NOT NULL DEFAULT '[]',    -- JSON
+    submit_id       TEXT                           -- exactly-once idempotency
+                                                   -- key (claim + content
+                                                   -- hash); NULL from legacy
+                                                   -- clients
 );
+-- The partial unique index behind the submit_id dedup lives in
+-- Db.init_schema (Python), after the legacy-DB ALTER TABLE migration —
+-- executescript on a pre-submit_id database would fail here otherwise.
 
 -- Claim-path indexes (reference schema.sql:99-101): a partial index for the
 -- hot niceonly predicate and a composite for the detailed path.

@@ -1,0 +1,269 @@
+"""Chaos smoke check for the hardened submission path.
+
+Runs a real server subprocess and real client subprocesses under a
+deterministic fault schedule (NICE_TPU_FAULTS) and a genuine mid-run server
+SIGKILL + restart, then asserts the ledger came out exactly right anyway:
+
+  fault schedule (seed pinned so every run injects the same faults):
+    * http.submit:drop_response@0.4 — the server processes the submit but
+      the client sees a network error and retries (seed 2 makes the FIRST
+      submit response of every client run drop), forcing the exactly-once
+      submit_id replay path;
+    * engine.dispatch:raise@batch=2 — one injected dispatch failure per
+      client run, forcing the jnp -> scalar mid-field backend fallback;
+  plus: the server is SIGKILLed while client run 2 is processing its field
+  and restarted seconds later, so that run's submit retries ride through a
+  real outage.
+
+  asserts:
+    * every client run exits 0;
+    * every claimed field was accepted EXACTLY once (no double inserts from
+      the dropped-response replays, no losses from the outage);
+    * every submission is byte-identical to a fault-free scalar
+      recomputation of its field (the fallback chain resumed, not restarted
+      or skipped);
+    * the duplicate-submit replay, the injected drop, and the backend
+      downgrade are all visible in the logs (the faults actually fired).
+
+Prints ONE JSON line. Usage:
+
+    python scripts/chaos_smoke.py [workdir]
+"""
+
+import glob
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 22  # full valid range [234256, 656395)
+FIELD_SIZE = 150_000  # -> 3 fields over the base range
+FAULT_SPEC = "http.submit:drop_response@0.4,engine.dispatch:raise@batch=2"
+FAULT_SEED = "2"  # first submit response drops, a later attempt delivers
+RUN_TIMEOUT = 300
+OUTAGE_SECS = 2.5
+POLL_SECS = 0.05
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_server(db_path: str, port: int, log_path: str):
+    logf = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "nice_tpu.server",
+            "--db", db_path, "--host", "127.0.0.1", "--port", str(port),
+        ],
+        stdout=logf, stderr=subprocess.STDOUT,
+    )
+    return proc, logf
+
+
+def _wait_listening(port: int, proc, timeout: float = 30) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(POLL_SECS)
+    return False
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    if len(sys.argv) > 1:
+        workdir = sys.argv[1]
+        os.makedirs(workdir, exist_ok=True)
+        cleanup = False
+    else:
+        workdir = tempfile.mkdtemp(prefix="chaos-smoke-")
+        cleanup = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import scalar
+    from nice_tpu.server.db import Db
+
+    db_path = os.path.join(workdir, "chaos.db")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    db = Db(db_path)
+    db.seed_base(BASE, field_size=FIELD_SIZE)
+    fields = db.get_fields_in_base(BASE)
+    db.close()
+
+    # Fault-free canonical results, computed before any chaos runs.
+    canon = {
+        f.field_id: scalar.process_range_detailed(
+            FieldSize(f.range_start, f.range_end), BASE
+        )
+        for f in fields
+    }
+
+    port = _pick_port()
+    api_base = f"http://127.0.0.1:{port}"
+    server_log = os.path.join(workdir, "server.log")
+    server, server_logf = _start_server(db_path, port, server_log)
+
+    failures = []
+    line = {"workdir": workdir, "fields": len(fields)}
+    if not _wait_listening(port, server):
+        print(json.dumps({"ok": False, "failures": ["server never listened"],
+                          "workdir": workdir}), flush=True)
+        return 1
+
+    client_env = dict(
+        os.environ,
+        NICE_TPU_FAULTS=FAULT_SPEC,
+        NICE_TPU_FAULTS_SEED=FAULT_SEED,
+    )
+    client_cmd = [
+        sys.executable, "-m", "nice_tpu.client", "detailed",
+        "--api-base", api_base,
+        "--backend", "jnp",
+        "--batch-size", "8192",
+        "--checkpoint-dir", ckpt_dir,
+        "--checkpoint-secs", "5",
+        "--max-retries", "12",
+        "--renew-secs", "5",
+        "--username", "chaos-smoke",
+    ]
+
+    def claims_count() -> int:
+        d = Db(db_path)
+        try:
+            with d._read_conn() as conn:
+                return conn.execute("SELECT COUNT(*) FROM claims").fetchone()[0]
+        finally:
+            d.close()
+
+    run_logs = []
+    for run in range(len(fields)):
+        log_path = os.path.join(workdir, f"client-run{run + 1}.log")
+        run_logs.append(log_path)
+        with open(log_path, "wb") as logf:
+            proc = subprocess.Popen(
+                client_cmd, stdout=logf, stderr=subprocess.STDOUT,
+                env=client_env,
+            )
+            if run == 1:
+                # Mid-run chaos: once run 2's claim has landed (it is now
+                # processing), SIGKILL the server, hold a short outage, and
+                # restart on the same port + DB. The WAL ledger must survive
+                # the kill and the client's submit must ride the retries.
+                before = run  # one claim per completed run so far
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if claims_count() > before or proc.poll() is not None:
+                        break
+                    time.sleep(POLL_SECS)
+                if claims_count() > before:
+                    server.send_signal(signal.SIGKILL)
+                    server.wait()
+                    server_logf.close()
+                    line["server_killed"] = True
+                    time.sleep(OUTAGE_SECS)
+                    server, server_logf = _start_server(
+                        db_path, port, server_log
+                    )
+                    if not _wait_listening(port, server):
+                        failures.append("server did not come back after kill")
+                else:
+                    failures.append(
+                        "run 2 never claimed a field; kill drill skipped"
+                    )
+            try:
+                rc = proc.wait(timeout=RUN_TIMEOUT)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                rc = -9
+        if rc != 0:
+            tail = open(log_path, errors="replace").read()[-2000:]
+            failures.append(f"client run {run + 1} exited {rc}; tail: {tail}")
+
+    logs_text = "".join(
+        open(p, errors="replace").read() for p in run_logs
+    )
+
+    # Any submission that had to be spooled (outage outlasting the retry
+    # budget) is delivered by a replay pass; faults stay off here — this is
+    # the recovery path, not another chaos run.
+    spool_glob = os.path.join(ckpt_dir, "spool", "*.json")
+    if glob.glob(spool_glob):
+        from nice_tpu.faults.spool import SubmissionSpool
+
+        SubmissionSpool(os.path.join(ckpt_dir, "spool")).replay(api_base)
+    if glob.glob(spool_glob):
+        failures.append("spooled submissions remained undeliverable")
+
+    # -- exactly once, byte-identical --------------------------------------
+    db = Db(db_path)
+    total_subs = 0
+    for f in fields:
+        subs = db.get_detailed_submissions_by_field(f.field_id)
+        total_subs += len(subs)
+        if len(subs) != 1:
+            failures.append(
+                f"field {f.field_id} has {len(subs)} accepted submissions, "
+                "expected exactly 1"
+            )
+            continue
+        sub, ref = subs[0], canon[f.field_id]
+        got_dist = {d.num_uniques: d.count for d in sub.distribution}
+        ref_dist = {d.num_uniques: d.count for d in ref.distribution}
+        if got_dist != ref_dist:
+            failures.append(
+                f"field {f.field_id}: distribution != fault-free scalar run"
+            )
+        got_nums = {(n.number, n.num_uniques) for n in sub.numbers}
+        ref_nums = {(n.number, n.num_uniques) for n in ref.nice_numbers}
+        if got_nums != ref_nums:
+            failures.append(
+                f"field {f.field_id}: nice numbers != fault-free scalar run"
+            )
+    db.close()
+    line["submissions"] = total_subs
+
+    # -- the faults demonstrably fired -------------------------------------
+    line["dropped_responses"] = logs_text.count("response dropped")
+    if line["dropped_responses"] < 1:
+        failures.append("no submit response was dropped (fault never fired)")
+    if "was a duplicate" not in logs_text:
+        failures.append(
+            "no duplicate-submit replay observed (exactly-once path unused)"
+        )
+    line["dispatch_faults"] = logs_text.count("injected engine.dispatch fault")
+    if line["dispatch_faults"] < 1:
+        failures.append("no engine dispatch fault fired")
+    if "failed mid-field" not in logs_text:
+        failures.append("no backend downgrade observed after dispatch fault")
+
+    server.terminate()
+    server.wait()
+    server_logf.close()
+    line["ok"] = not failures
+    if failures:
+        line["failures"] = failures
+    line["elapsed_secs"] = round(time.monotonic() - t_start, 2)
+    print(json.dumps(line), flush=True)
+    if cleanup and not failures:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

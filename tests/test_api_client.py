@@ -2,6 +2,7 @@
 (reference client_api_sync.rs:37-89: 2^attempt backoff, 5xx/network
 retryable, 4xx fail-fast)."""
 
+import email.message
 import io
 import urllib.error
 
@@ -29,7 +30,7 @@ def test_4xx_fails_fast_with_server_detail(monkeypatch):
     assert len(calls) == 1  # no retries on client error
 
 
-def test_5xx_retries_with_exponential_backoff(monkeypatch):
+def test_5xx_retries_with_full_jitter_backoff(monkeypatch):
     delays = []
     monkeypatch.setattr(api_client.time, "sleep", delays.append)
     attempts = [0]
@@ -41,8 +42,18 @@ def test_5xx_retries_with_exponential_backoff(monkeypatch):
         return {"ok": True}
 
     monkeypatch.setattr(api_client, "_request_json", fake)
+    api_client._backoff_rng.seed(1234)
     assert api_client.retry_request("http://x/claim", max_retries=5) == {"ok": True}
-    assert delays == [1, 2, 4]  # 2^attempt seconds
+    # Full jitter: each delay uniform in [0, min(2^attempt, cap)).
+    assert len(delays) == 3
+    for attempt, delay in enumerate(delays):
+        assert 0 <= delay <= min(2**attempt, api_client.MAX_BACKOFF_SECS)
+    # Same seed, same sequence: the jitter source is deterministic on demand.
+    api_client._backoff_rng.seed(1234)
+    expected = [
+        api_client._backoff_rng.uniform(0, 2**a) for a in range(3)
+    ]
+    assert delays == expected
 
 
 def test_network_error_exhausts_retries(monkeypatch):
@@ -66,4 +77,26 @@ def test_backoff_is_capped(monkeypatch):
     monkeypatch.setattr(api_client, "_request_json", fake)
     with pytest.raises(api_client.ApiError):
         api_client.retry_request("http://x/", max_retries=12)
-    assert max(delays) == api_client.MAX_BACKOFF_SECS  # 2^11 > 512 cap
+    # 2^11 > 512: every jittered draw stays inside the cap window.
+    assert len(delays) == 12
+    assert max(delays) <= api_client.MAX_BACKOFF_SECS
+
+
+def test_retry_after_header_overrides_backoff(monkeypatch):
+    delays = []
+    monkeypatch.setattr(api_client.time, "sleep", delays.append)
+    attempts = [0]
+
+    def fake(url, body=None, timeout=None):
+        attempts[0] += 1
+        if attempts[0] == 1:
+            hdrs = email.message.Message()
+            hdrs["Retry-After"] = "7"
+            raise urllib.error.HTTPError(
+                "http://x/", 503, "overloaded", hdrs, io.BytesIO(b"")
+            )
+        return {"ok": True}
+
+    monkeypatch.setattr(api_client, "_request_json", fake)
+    assert api_client.retry_request("http://x/claim", max_retries=3) == {"ok": True}
+    assert delays == [7.0]  # the server's hint, not the jittered window

@@ -1,0 +1,366 @@
+"""Chaos-hardening tests: deterministic fault injection, exactly-once
+submits, the on-disk submission spool, and the backend degradation chain."""
+
+import glob
+import os
+import threading
+
+import pytest
+
+from nice_tpu import faults
+from nice_tpu.ckpt.snapshot import SnapshotError, read_snapshot, write_snapshot
+from nice_tpu.client import api_client
+from nice_tpu.client.main import compile_results
+from nice_tpu.core import base_range
+from nice_tpu.core.types import (
+    DataToClient,
+    FieldSize,
+    SearchMode,
+)
+from nice_tpu.faults.spool import SubmissionSpool
+from nice_tpu.obs.series import CLIENT_RETRIES, SERVER_DUPLICATE_SUBMITS
+from nice_tpu.ops import engine, scalar
+from nice_tpu.server import app as server_app
+from nice_tpu.server.db import Db
+
+import numpy as np
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """Every test starts and ends with no armed faults, whatever the env."""
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+# --- spec grammar + determinism ------------------------------------------
+
+
+def test_parse_spec_selector_kinds():
+    rules = faults.parse_spec(
+        "http.submit:drop_response@0.3, server.claim:500@2,"
+        "engine.dispatch:raise@batch=7, ckpt.write:truncate"
+    )
+    assert [r.site for r in rules] == [
+        "http.submit", "server.claim", "engine.dispatch", "ckpt.write"
+    ]
+    assert rules[0].probability == 0.3
+    assert rules[1].nth == 2
+    assert rules[2].match == ("batch", "7")
+    assert rules[3].always
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["justasite", "site:", ":action", "s:a@1.5", "s:a@0", "s:a@nan"],
+)
+def test_parse_spec_rejects_malformed(spec):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(spec)
+
+
+def test_probability_rules_are_seed_deterministic():
+    def sequence(seed):
+        faults.configure("x.y:boom@0.5", seed=seed)
+        return [faults.fire("x.y") for _ in range(64)]
+
+    a, b, c = sequence(7), sequence(7), sequence(8)
+    assert a == b  # same seed + same call sequence -> same faults
+    assert a != c  # a different seed perturbs the schedule
+    assert "boom" in a and None in a  # p=0.5 over 64 calls fires both ways
+
+
+def test_site_streams_are_independent():
+    """Interleaving calls at another site must not perturb a site's draws."""
+    faults.configure("x.y:boom@0.5", seed=3)
+    alone = [faults.fire("x.y") for _ in range(32)]
+    faults.configure("x.y:boom@0.5,other:zap@0.5", seed=3)
+    interleaved = []
+    for _ in range(32):
+        faults.fire("other")
+        interleaved.append(faults.fire("x.y"))
+    assert alone == interleaved
+
+
+def test_nth_and_match_selectors_fire_exactly_once():
+    faults.configure("s:a@2,t:b@k=v", seed=0)
+    assert [faults.fire("s") for _ in range(4)] == [None, "a", None, None]
+    assert faults.fire("t", k="x") is None
+    assert faults.fire("t", k="v") == "b"
+    assert faults.fire("t", k="v") is None  # fired once, stays quiet
+
+
+def test_unconfigured_fire_is_inert():
+    assert faults.fire("no.such.site", anything=1) is None
+    assert faults.active_sites() == ()
+
+
+# --- client transport under injected faults ------------------------------
+
+
+def test_injected_4xx_surfaces_detail_and_status():
+    faults.configure("http.claim:404@1")
+    with pytest.raises(api_client.ApiError) as ei:
+        api_client.retry_request(
+            "http://127.0.0.1:9/claim/detailed", max_retries=3,
+            endpoint="claim",
+        )
+    assert ei.value.status == 404
+    assert "injected fault" in str(ei.value)
+
+
+def test_injected_500s_bump_retry_counter(monkeypatch):
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    faults.configure("http.other:500")  # every call
+    before = CLIENT_RETRIES.value(("other",))
+    with pytest.raises(api_client.ApiError) as ei:
+        api_client.retry_request("http://127.0.0.1:9/x", max_retries=3)
+    assert ei.value.status is None  # exhausted retries, not a 4xx verdict
+    assert CLIENT_RETRIES.value(("other",)) == before + 3
+
+
+# --- exactly-once submits + spool against a live server ------------------
+
+
+@pytest.fixture()
+def server(tmp_path):
+    db_path = str(tmp_path / "faults-test.db")
+    db = Db(db_path)
+    db.seed_base(10, field_size=20)  # [47, 100) -> 3 tiny fields
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", db_path
+    srv.shutdown()
+
+
+def _claim_and_compile(base_url):
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "chaos", max_retries=0
+    )
+    results = scalar.process_range_detailed(data.to_field_size(), data.base)
+    return data, compile_results(data, results, SearchMode.DETAILED, "chaos")
+
+
+def test_submit_replay_is_idempotent(server):
+    base_url, db_path = server
+    data, submission = _claim_and_compile(base_url)
+    assert submission.submit_id  # stamped by compile_results
+
+    first = api_client.submit_field_to_server(base_url, submission, max_retries=0)
+    assert not first.get("duplicate")
+    before = SERVER_DUPLICATE_SUBMITS.value()
+    replay = api_client.submit_field_to_server(base_url, submission, max_retries=0)
+    assert replay.get("duplicate") is True
+    assert SERVER_DUPLICATE_SUBMITS.value() == before + 1
+
+    db = Db(db_path)
+    claim = db.get_claim_by_id(data.claim_id)
+    subs = db.get_detailed_submissions_by_field(claim.field_id)
+    db.close()
+    assert len(subs) == 1  # replay answered OK without a second row
+
+
+def test_dropped_response_then_retry_is_exactly_once(server):
+    """The drop_response fault: the server accepts the submit, the client
+    sees a network error and retries — the retry must dedup, not double."""
+    base_url, db_path = server
+    data, submission = _claim_and_compile(base_url)
+    faults.configure("http.submit:drop_response@1")
+    try:
+        resp = api_client.submit_field_to_server(
+            base_url, submission, max_retries=3
+        )
+    finally:
+        faults.configure(None)
+    assert resp.get("duplicate") is True  # attempt 1 landed; attempt 2 deduped
+
+    db = Db(db_path)
+    claim = db.get_claim_by_id(data.claim_id)
+    subs = db.get_detailed_submissions_by_field(claim.field_id)
+    db.close()
+    assert len(subs) == 1
+
+
+def test_submit_id_is_content_addressed(server):
+    base_url, _ = server
+    data, submission = _claim_and_compile(base_url)
+    results = scalar.process_range_detailed(data.to_field_size(), data.base)
+    again = compile_results(data, results, SearchMode.DETAILED, "chaos")
+    assert again.submit_id == submission.submit_id  # same results, same id
+    other = compile_results(
+        DataToClient(
+            claim_id=data.claim_id + 1, base=data.base,
+            range_start=data.range_start, range_end=data.range_end,
+            range_size=data.range_size,
+        ),
+        results, SearchMode.DETAILED, "chaos",
+    )
+    assert other.submit_id != submission.submit_id
+
+
+def test_spool_journal_and_replay(server, tmp_path):
+    base_url, db_path = server
+    data, submission = _claim_and_compile(base_url)
+    spool = SubmissionSpool(str(tmp_path / "spool"))
+
+    # Server unreachable: the entry defers and survives for the next pass.
+    spool.add(submission)
+    assert len(spool.pending()) == 1
+    counts = spool.replay("http://127.0.0.1:9", max_retries=0)
+    assert counts == {"delivered": 0, "rejected": 0, "deferred": 1}
+    assert len(spool.pending()) == 1
+
+    # Server back: delivered and retired; a second pass is a no-op.
+    counts = spool.replay(base_url, max_retries=0)
+    assert counts["delivered"] == 1
+    assert spool.pending() == []
+    assert spool.replay(base_url, max_retries=0) == {
+        "delivered": 0, "rejected": 0, "deferred": 0
+    }
+
+    db = Db(db_path)
+    claim = db.get_claim_by_id(data.claim_id)
+    subs = db.get_detailed_submissions_by_field(claim.field_id)
+    db.close()
+    assert len(subs) == 1
+
+
+def test_spool_quarantines_rejected_entries(server, tmp_path):
+    base_url, _ = server
+    data, submission = _claim_and_compile(base_url)
+    submission.claim_id = 999_999  # no such claim -> definitive 4xx
+    spool = SubmissionSpool(str(tmp_path / "spool"))
+    spool.add(submission)
+    counts = spool.replay(base_url, max_retries=0)
+    assert counts == {"delivered": 0, "rejected": 1, "deferred": 0}
+    assert spool.pending() == []
+    assert glob.glob(os.path.join(str(tmp_path / "spool"), "*.rejected"))
+
+
+def test_rejournaling_same_submission_overwrites(tmp_path, server):
+    base_url, _ = server
+    _, submission = _claim_and_compile(base_url)
+    spool = SubmissionSpool(str(tmp_path / "spool"))
+    p1 = spool.add(submission)
+    p2 = spool.add(submission)
+    assert p1 == p2
+    assert len(spool.pending()) == 1
+
+
+def test_server_side_injected_500_is_retryable(server, monkeypatch):
+    base_url, _ = server
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    faults.configure("server.status:500@1")
+    got = api_client.retry_request(
+        f"{base_url}/status", max_retries=2, endpoint="other"
+    )
+    assert got["status"] == "ok"
+
+
+# --- backend degradation chain -------------------------------------------
+
+
+BASE = 22
+
+
+def _field(size):
+    lo, _hi = base_range.get_base_range(BASE)
+    return FieldSize(lo, lo + size)
+
+
+def test_detailed_fallback_jnp_to_scalar_is_equivalent():
+    r = _field(40_000)
+    canon = scalar.process_range_detailed(r, BASE)
+    faults.configure("engine.dispatch:raise@2", seed=0)
+    res = engine.process_range_detailed(r, BASE, backend="jnp", batch_size=1024)
+    assert res.backend_downgrades == ("jnp->scalar",)
+    assert res.distribution == canon.distribution
+    assert res.nice_numbers == canon.nice_numbers
+
+
+def test_detailed_fallback_full_chain_pallas_to_scalar():
+    r = _field(20_000)
+    canon = scalar.process_range_detailed(r, BASE)
+    # Two one-shot rules: the first kills pallas's first dispatch, the second
+    # (never consulted while the first fires) kills jnp's first dispatch.
+    faults.configure("engine.dispatch:raise@1,engine.dispatch:raise@1", seed=0)
+    res = engine.process_range_detailed(
+        r, BASE, backend="pallas", batch_size=1024
+    )
+    assert res.backend_downgrades == ("pallas->jnp", "jnp->scalar")
+    assert res.distribution == canon.distribution
+    assert res.nice_numbers == canon.nice_numbers
+
+
+def test_niceonly_fallback_chain_is_equivalent():
+    r = _field(40_000)
+    canon = scalar.process_range_niceonly(r, BASE)
+    faults.configure("engine.dispatch:raise@1,engine.dispatch:raise@1", seed=0)
+    res = engine.process_range_niceonly(
+        r, BASE, backend="pallas", batch_size=1024
+    )
+    assert res.backend_downgrades == ("pallas->jnp", "jnp->scalar")
+    assert res.nice_numbers == canon.nice_numbers
+
+
+def test_fallback_resumes_rather_than_restarts(monkeypatch):
+    """The fallback must re-dispatch only the failed batch onward: the
+    scalar leg sees a resume cursor past the batches jnp completed."""
+    r = _field(40_000)
+    seen = {}
+    orig = engine._chunked_host_scan
+
+    def spy(range_, base, mode, chunk, progress, checkpoint_cb, resume,
+            *args, **kwargs):
+        seen["resume_cursor"] = None if resume is None else resume["cursor"]
+        return orig(range_, base, mode, chunk, progress, checkpoint_cb,
+                    resume, *args, **kwargs)
+
+    monkeypatch.setattr(engine, "_chunked_host_scan", spy)
+    faults.configure("engine.dispatch:raise@3", seed=0)
+    res = engine.process_range_detailed(r, BASE, backend="jnp", batch_size=1024)
+    assert res.backend_downgrades == ("jnp->scalar",)
+    assert seen["resume_cursor"] is not None
+    assert seen["resume_cursor"] > r.start()  # kept jnp's completed batches
+
+
+def test_no_fallback_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_NO_FALLBACK", "1")
+    faults.configure("engine.dispatch:raise@1", seed=0)
+    with pytest.raises(engine.BackendDispatchError) as ei:
+        engine.process_range_detailed(
+            _field(10_000), BASE, backend="jnp", batch_size=1024
+        )
+    assert ei.value.backend == "jnp"
+    assert ei.value.state is not None
+    assert ei.value.state["cursor"] >= _field(10_000).start()
+
+
+def test_chain_exhaustion_propagates():
+    """An always-on dispatch fault takes down every backend; the scalar
+    leg's failure must reach the caller, not loop forever."""
+    faults.configure("engine.dispatch:raise")
+    with pytest.raises(RuntimeError, match="injected engine.dispatch"):
+        engine.process_range_detailed(
+            _field(10_000), BASE, backend="jnp", batch_size=1024
+        )
+
+
+# --- checkpoint write truncation ------------------------------------------
+
+
+def test_ckpt_truncate_fault_is_detected(tmp_path):
+    path = str(tmp_path / "snap.ckpt")
+    manifest = {"cursor": 123}
+    arrays = {"hist": np.arange(24, dtype=np.int64)}
+    faults.configure("ckpt.write:truncate@1")
+    write_snapshot(path, manifest, arrays)
+    with pytest.raises(SnapshotError):
+        read_snapshot(path)
+    # The hook fired once; the rewrite is clean and fully readable.
+    write_snapshot(path, manifest, arrays)
+    got_manifest, got_arrays = read_snapshot(path)
+    assert got_manifest["cursor"] == 123
+    assert np.array_equal(got_arrays["hist"], arrays["hist"])

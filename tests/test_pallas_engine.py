@@ -186,6 +186,9 @@ def test_zero_count_audit_catches_device_undercount(monkeypatch):
     # Single-device path: the sharded step calls the kernel callable
     # directly, bypassing the patched batch entry point.
     monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    # Without this, the audit failure would (correctly) degrade to jnp and
+    # heal; this test pins the detection itself.
+    monkeypatch.setenv("NICE_TPU_NO_FALLBACK", "1")
 
     def zeroed(plan, spec, desc, periods=pe.STRIDED_PERIODS, n_real=None):
         return np.zeros((8, 128), dtype=np.int32)
@@ -221,8 +224,10 @@ def test_pipeline_propagates_producer_failure(monkeypatch):
 
 def test_pipeline_propagates_dispatch_failure(monkeypatch):
     """A device-dispatch crash must shut down producer and collector cleanly
-    and re-raise on the caller."""
+    and re-raise on the caller (fallback disabled; with it on, the same
+    crash degrades to jnp instead — tests/test_faults.py covers that)."""
     monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    monkeypatch.setenv("NICE_TPU_NO_FALLBACK", "1")
 
     def boom(*a, **k):
         raise RuntimeError("dispatch exploded")

@@ -228,6 +228,28 @@ def _error_line(metric: str, error: str) -> dict:
     }
 
 
+def _span_sums() -> dict:
+    """{span name: (wall_secs, count)} snapshot of the trace-span histogram.
+
+    Differencing two snapshots yields a per-phase wall-time summary for the
+    window between them — where inside the engine (dispatch vs collect vs
+    stats readback) a mode's wall clock actually went, without needing the
+    JSON trace sink enabled."""
+    from nice_tpu.obs.trace import SPAN_SECONDS
+
+    return {key[0]: (s, c) for key, (s, c) in SPAN_SECONDS.label_sums().items()}
+
+
+def _span_delta(before: dict, after: dict) -> dict:
+    """{span name: {"wall_secs": s, "count": n}} for spans that ran."""
+    out = {}
+    for name, (s1, c1) in after.items():
+        s0, c0 = before.get(name, (0.0, 0))
+        if c1 - c0:
+            out[name] = {"wall_secs": round(s1 - s0, 3), "count": c1 - c0}
+    return out
+
+
 def _init_jax(remaining):
     """Import jax and force backend init, re-exec'ing on transient failure.
 
@@ -467,6 +489,7 @@ def main() -> int:
     results: dict[tuple, dict] = {}
     headline = None
     wedged = False
+    suite_spans0 = _span_sums()
     _phase("suite", "begin", modes=[f"{k}/{m}" for m, k in suite],
            n_chips=n_chips, backend=jax.default_backend())
     for mode, kind in suite:
@@ -498,7 +521,11 @@ def main() -> int:
                 cap = max(10.0, min(cap, remaining() - 15.0))
             _phase(f"mode.{kind}.{mode}", "begin", batch=batch,
                    cap_secs=cap)
+            spans_before = _span_sums()
             line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
+            mode_spans = _span_delta(spans_before, _span_sums())
+            if mode_spans:
+                line["spans"] = mode_spans
             _phase(
                 f"mode.{kind}.{mode}",
                 "error" if ("error" in line or wedged) else "end",
@@ -529,6 +556,10 @@ def main() -> int:
     }
     headline["budget_secs"] = budget
     headline["budget_used_secs"] = round(budget - remaining(), 1)
+    # Per-phase wall-time across the whole suite (engine dispatch/collect/
+    # stats spans + any server/client spans that ran in-process): the driver
+    # artifact carries not just the throughput but where the wall went.
+    headline["span_summary"] = _span_delta(suite_spans0, _span_sums())
     _phase("suite", "end", budget_used_secs=round(budget - remaining(), 1))
     print(json.dumps(headline), flush=True)
     return 1 if any("error" in r for r in results.values()) else 0

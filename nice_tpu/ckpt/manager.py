@@ -32,6 +32,7 @@ import numpy as np
 
 from nice_tpu.ckpt.snapshot import SnapshotError, read_snapshot, write_snapshot
 from nice_tpu.core.types import DataToClient, SearchMode
+from nice_tpu.obs import flight
 from nice_tpu.obs.series import CKPT_BYTES, CKPT_REJECTED, CKPT_WRITES
 
 log = logging.getLogger("nice_tpu.ckpt")
@@ -115,6 +116,10 @@ class FieldCheckpointer:
         nbytes = write_snapshot(self.path, manifest, arrays)
         CKPT_WRITES.inc()
         CKPT_BYTES.inc(nbytes)
+        flight.record(
+            "checkpoint", claim=self.data.claim_id,
+            cursor=str(manifest["cursor"]), bytes=nbytes,
+        )
         log.debug(
             "checkpoint: claim %d cursor %s (%d bytes)",
             self.data.claim_id, manifest["cursor"], nbytes,
@@ -149,6 +154,10 @@ class FieldCheckpointer:
             CKPT_REJECTED.labels("signature").inc()
             self.delete()
             return None
+        flight.record(
+            "restore", claim=self.data.claim_id,
+            cursor=str(manifest.get("cursor")),
+        )
         return _snapshot_to_state(manifest, arrays)
 
     def delete(self) -> None:

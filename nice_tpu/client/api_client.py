@@ -30,7 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from email.message import Message
 from typing import Any, Optional
 
-from nice_tpu import faults
+from nice_tpu import faults, obs
 from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
 from nice_tpu.core.types import DataToClient, DataToServer, SearchMode, ValidationData
 from nice_tpu.obs.series import CLIENT_REQUEST_SECONDS, CLIENT_RETRIES
@@ -104,6 +104,11 @@ def _request_json(
     if body is not None:
         data = json.dumps(body).encode()
         headers["Content-Type"] = "application/json"
+    # Resolved here (not threaded through the retry loop) so the thread's
+    # ambient trace context alone decides the header.
+    traceparent = obs.current_traceparent()
+    if traceparent:
+        headers["traceparent"] = traceparent
     req = urllib.request.Request(url, data=data, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         payload = resp.read()
@@ -122,7 +127,10 @@ def retry_request(
     the response carried Retry-After (server overload shed), which wins.
 
     endpoint labels the per-attempt latency histogram and retry counter
-    (claim / submit / validate / renew / other)."""
+    (claim / submit / validate / renew / other). Every attempt carries a
+    W3C traceparent header from the thread's ambient trace context (wrap the
+    call in obs.trace_context to set it) so the server's handler span joins
+    the field's distributed trace."""
     attempt = 0
     while True:
         t0 = time.monotonic()
@@ -158,6 +166,8 @@ def retry_request(
         if attempt >= max_retries:
             raise ApiError(f"request to {url} failed after {attempt} retries: {err}")
         CLIENT_RETRIES.labels(endpoint).inc()
+        obs.flight.record("retry", endpoint=endpoint, attempt=attempt,
+                          error=str(err)[:200])
         hinted = _retry_after_secs(err)
         if hinted is not None:
             delay = min(hinted, MAX_BACKOFF_SECS)
@@ -189,10 +199,17 @@ def submit_field_to_server(
     """POST /submit (reference client_api_sync.rs:144-172). Returns the
     server's response dict; {"duplicate": true} means a retried submit was
     already accepted (exactly-once via submit_id) — success, not an error."""
-    resp = retry_request(
-        f"{api_base}/submit", submit_data.to_json(), max_retries=max_retries,
-        endpoint="submit",
-    )
+    # Derived (not ambient) trace id: AsyncApi runs submits on pool threads
+    # where the field's trace_context isn't set, but the claim id is in the
+    # payload, so the submit span still joins the field's trace.
+    trace_id = obs.claim_trace_id(submit_data.claim_id)
+    with obs.trace_context(trace_id), obs.span(
+        "client.submit", claim=submit_data.claim_id
+    ):
+        resp = retry_request(
+            f"{api_base}/submit", submit_data.to_json(),
+            max_retries=max_retries, endpoint="submit",
+        )
     if isinstance(resp, dict) and resp.get("duplicate"):
         log.info(
             "submit for claim %d was a duplicate: a retried request had "
@@ -210,9 +227,26 @@ def renew_claim(
     next one, or the submit itself, lands well inside the expiry window), so
     the renewer thread must never sit in a 10-deep backoff while the scan it
     protects finishes."""
+    # The renewer runs on its own thread, so re-derive the field's trace
+    # context from the claim id rather than relying on an ambient one.
+    with obs.trace_context(obs.claim_trace_id(claim_id)):
+        retry_request(
+            f"{api_base}/renew_claim", {"claim_id": claim_id},
+            max_retries=max_retries, endpoint="renew",
+        )
+
+
+def post_telemetry(
+    api_base: str, snap: dict, max_retries: int = 1
+) -> None:
+    """POST /telemetry — lightweight fleet-visibility heartbeat.
+
+    Best-effort by design (low retry budget, like renew_claim): a dropped
+    heartbeat only delays the fleet dashboard by one period, and the
+    reporter thread must never back off for minutes while the scan runs."""
     retry_request(
-        f"{api_base}/renew_claim", {"claim_id": claim_id},
-        max_retries=max_retries, endpoint="renew",
+        f"{api_base}/telemetry", snap, max_retries=max_retries,
+        endpoint="telemetry",
     )
 
 

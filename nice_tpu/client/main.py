@@ -145,6 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
         "/renew_claim; 0 disables (env NICE_RENEW_SECS)",
     )
     p.add_argument(
+        "--telemetry-secs",
+        type=float,
+        default=float(_env("TELEMETRY_SECS", 60)),
+        help="seconds between fleet-telemetry heartbeats to /telemetry "
+        "(throughput, backend mix, downgrades, spool depth); 0 disables "
+        "(env NICE_TELEMETRY_SECS)",
+    )
+    p.add_argument(
         "--benchmark",
         default=_env("BENCHMARK", None),
         choices=[m.value for m in BenchmarkMode],
@@ -362,6 +370,66 @@ def run_validate(args) -> int:
     return 1
 
 
+def _fleet_snapshot(args, spool) -> dict:
+    """This client's current obs.telemetry snapshot, spool depth included."""
+    depth = 0
+    if spool is not None:
+        try:
+            depth = len(spool.pending())
+        except OSError:
+            pass
+    return obs.telemetry.snapshot(
+        username=args.username, backend=args.backend, spool_depth=depth,
+        client_version=CLIENT_VERSION,
+    )
+
+
+class _TelemetryReporter:
+    """Background fleet-visibility heartbeat: POSTs /telemetry immediately
+    on entry and then every every_secs, so long-scanning clients show up on
+    the server's fleet dashboard before their first submission. Failures
+    are logged and swallowed — telemetry must never hurt the scan."""
+
+    def __init__(self, args, spool):
+        import threading
+
+        self.args = args
+        self.spool = spool
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-report", daemon=True
+        )
+
+    def _report_once(self) -> None:
+        try:
+            api_client.post_telemetry(
+                self.args.api_base, _fleet_snapshot(self.args, self.spool)
+            )
+        except Exception as e:
+            log.debug("telemetry heartbeat failed: %s", e)
+
+    def _run(self) -> None:
+        self._report_once()
+        while not self._stop.wait(self.args.telemetry_secs):
+            self._report_once()
+
+    def __enter__(self) -> "_TelemetryReporter":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def _maybe_telemetry(args, spool):
+    from contextlib import nullcontext
+
+    if args.telemetry_secs and args.telemetry_secs > 0:
+        return _TelemetryReporter(args, spool)
+    return nullcontext()
+
+
 class _ClaimRenewer:
     """Background lease heartbeat for one claim: POSTs /renew_claim
     immediately on entry (a resumed claim may be near expiry) and then every
@@ -464,13 +532,26 @@ def run_single_iteration(
     args, api: api_client.AsyncApi, mode: SearchMode, spool=None
 ) -> None:
     data, resume, ckptr = _resume_or_claim(args, api, mode)
-    with _maybe_renewer(args, data.claim_id):
-        results, _ = process_field(
-            data, mode, args.backend, args.batch_size, args.progress_secs,
-            checkpointer=ckptr, resume=resume,
-            checkpoint_secs=args.checkpoint_secs,
+    # One distributed trace per claim lifecycle: the id is derived from the
+    # claim id, so the server's handler spans (continued from the request's
+    # traceparent header) and the engine's scan spans share it.
+    with obs.trace_context(obs.claim_trace_id(data.claim_id)):
+        obs.trace_event(
+            "client.claim", claim=data.claim_id, base=data.base,
+            range_start=str(data.range_start), size=data.range_size,
+            resumed=resume is not None,
         )
+        obs.flight.record("claim", claim=data.claim_id, base=data.base)
+        with _maybe_renewer(args, data.claim_id):
+            results, _ = process_field(
+                data, mode, args.backend, args.batch_size, args.progress_secs,
+                checkpointer=ckptr, resume=resume,
+                checkpoint_secs=args.checkpoint_secs,
+            )
     submission = compile_results(data, results, mode, args.username)
+    # Telemetry rides along AFTER submit_id is stamped: it must not perturb
+    # the content hash that makes replays idempotent.
+    submission.telemetry = _fleet_snapshot(args, spool)
     _await_submit(api.submit_async(submission), submission, spool)
     # Only an owned submit (confirmed or spooled) retires the snapshot; any
     # failure before this point leaves it on disk for the next startup.
@@ -496,12 +577,19 @@ def run_pipelined_loop(
             # moment to drain journaled submissions once the server is back.
             spool.replay(args.api_base)
         next_claim = api.claim_async(mode)  # overlap with processing
-        with _maybe_renewer(args, data.claim_id):
-            results, _ = process_field(
-                data, mode, args.backend, args.batch_size, args.progress_secs,
-                checkpointer=ckptr, resume=resume,
-                checkpoint_secs=args.checkpoint_secs,
+        with obs.trace_context(obs.claim_trace_id(data.claim_id)):
+            obs.trace_event(
+                "client.claim", claim=data.claim_id, base=data.base,
+                range_start=str(data.range_start), size=data.range_size,
+                resumed=resume is not None,
             )
+            obs.flight.record("claim", claim=data.claim_id, base=data.base)
+            with _maybe_renewer(args, data.claim_id):
+                results, _ = process_field(
+                    data, mode, args.backend, args.batch_size,
+                    args.progress_secs, checkpointer=ckptr, resume=resume,
+                    checkpoint_secs=args.checkpoint_secs,
+                )
         if pending_submit is not None:
             # Settle the previous submit before queueing the next one; only
             # an owned submit (confirmed or spooled) retires its snapshot.
@@ -510,6 +598,7 @@ def run_pipelined_loop(
             if prev_ckptr is not None:
                 prev_ckptr.delete()
         submission = compile_results(data, results, mode, args.username)
+        submission.telemetry = _fleet_snapshot(args, spool)
         pending_submit = (api.submit_async(submission), ckptr, submission)
         fields += 1
         numbers += data.range_size
@@ -543,6 +632,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     # Local /metrics endpoint (NICE_TPU_METRICS_PORT): exposes the client's
     # field/latency series plus the engine pipeline registry.
     obs.maybe_serve_metrics()
+    # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR).
+    obs.flight.install()
     if args.threads > 0:
         # The native backend sizes its pools from NICE_THREADS (engine
         # _native_threads); the flag is the CLI face of the same knob
@@ -576,10 +667,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         # kill-during-outage case) before claiming new work.
         spool.replay(args.api_base)
     try:
-        if args.repeat:
-            run_pipelined_loop(args, api, mode, spool=spool)
-        else:
-            run_single_iteration(args, api, mode, spool=spool)
+        with _maybe_telemetry(args, spool):
+            if args.repeat:
+                run_pipelined_loop(args, api, mode, spool=spool)
+            else:
+                run_single_iteration(args, api, mode, spool=spool)
     except KeyboardInterrupt:
         log.info("interrupted; shutting down")
     finally:

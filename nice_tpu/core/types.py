@@ -158,7 +158,12 @@ class DataToServer:
     payloads stay byte-interchangeable with reference clients/servers that
     never heard of them. submit_id (claim id + content hash) is the
     exactly-once idempotency key; backend_downgrades records any mid-field
-    engine fallbacks (e.g. "pallas->jnp") that produced these results."""
+    engine fallbacks (e.g. "pallas->jnp") that produced these results;
+    telemetry piggybacks the client's fleet snapshot (obs.telemetry) on the
+    submission so the server's client_telemetry table stays fresh without
+    an extra request. telemetry is attached AFTER submit_id is computed —
+    it must never perturb the content hash (a recomputed submission would
+    otherwise mint a new submit_id and defeat exactly-once dedup)."""
 
     claim_id: int
     username: str
@@ -167,6 +172,7 @@ class DataToServer:
     nice_numbers: list[NiceNumberSimple]
     submit_id: Optional[str] = None
     backend_downgrades: Optional[list[str]] = None
+    telemetry: Optional[dict] = None
 
     def to_json(self) -> dict[str, Any]:
         out = {
@@ -188,6 +194,8 @@ class DataToServer:
             out["submit_id"] = self.submit_id
         if self.backend_downgrades:
             out["backend_downgrades"] = list(self.backend_downgrades)
+        if self.telemetry is not None:
+            out["telemetry"] = dict(self.telemetry)
         return out
 
     @staticmethod
@@ -213,6 +221,7 @@ class DataToServer:
             backend_downgrades=None
             if downgrades is None
             else [str(x) for x in downgrades],
+            telemetry=d.get("telemetry"),
         )
 
 

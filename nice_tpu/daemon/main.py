@@ -231,6 +231,8 @@ def main(argv=None) -> int:
     # Local /metrics (NICE_TPU_METRICS_PORT): heartbeat gauge + restart
     # counter make a silently-dead supervisor loop externally detectable.
     obs.maybe_serve_metrics()
+    # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR).
+    obs.flight.install()
     monitor = CpuMonitor(args.sample_interval)
     log.info("cpu sampler backend: %s", monitor.backend)
     client_args = list(args.client_args or ["--repeat"])

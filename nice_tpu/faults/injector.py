@@ -38,6 +38,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
+from nice_tpu.obs import flight
 from nice_tpu.obs.series import FAULTS_INJECTED
 
 log = logging.getLogger("nice_tpu.faults")
@@ -161,6 +162,7 @@ class FaultPlan:
             for rule in rules:
                 if rule.should_fire(ctx):
                     FAULTS_INJECTED.labels(site, rule.action).inc()
+                    flight.record("fault", site=site, action=rule.action)
                     log.warning(
                         "injected fault at %s: action=%s ctx=%s (call %d)",
                         site, rule.action, ctx, rule.calls,

@@ -30,6 +30,7 @@ from typing import Optional
 
 from nice_tpu.client import api_client
 from nice_tpu.core.types import DataToServer
+from nice_tpu.obs import flight
 from nice_tpu.obs.series import SPOOL_JOURNALED, SPOOL_REPLAYS
 
 log = logging.getLogger(__name__)
@@ -59,6 +60,7 @@ class SubmissionSpool:
             os.fsync(f.fileno())
         os.replace(tmp, path)
         SPOOL_JOURNALED.inc()
+        flight.record("spool", claim=data.claim_id, path=path)
         log.warning(
             "journaled undeliverable submission for claim %d to %s "
             "(will replay)", data.claim_id, path,
@@ -146,6 +148,10 @@ class SubmissionSpool:
             os.replace(path, path + ".rejected")
         except OSError:
             pass
+        # A definitively-rejected submission is exactly when the preceding
+        # event history matters: dump the flight ring next to the wreckage.
+        flight.record("quarantine", path=path + ".rejected")
+        flight.dump(reason="quarantine")
 
 
 def maybe_spool(

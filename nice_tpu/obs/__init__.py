@@ -1,22 +1,32 @@
 """nice_tpu.obs — zero-hard-dependency observability layer.
 
-Three pieces, all stdlib-only at import time:
+Five pieces, all stdlib-only at import time:
 
 - ``metrics``: a process-wide Prometheus-text registry (counters, gauges,
   histograms) shared by the HTTP server, the client's local /metrics port,
   and the engine pipeline.
 - ``trace``: ``span(name)`` / ``trace_event`` structured JSON trace events
-  (begin flushed *before* the body runs, so hangs leave evidence), plus an
-  opt-in ``profiler`` wrapper around jax.profiler.
+  (begin flushed *before* the body runs, so hangs leave evidence), plus
+  distributed-trace plumbing — ``trace_context`` stamps spans with a
+  trace_id derived from the claim id (``claim_trace_id``) and carried
+  between processes as a W3C ``traceparent`` header — and an opt-in
+  ``profiler`` wrapper around jax.profiler.
 - ``series``: the well-known series names, declared once so emitters and
   scrapers can't drift apart.
+- ``flight``: bounded in-process ring of recent structured events, dumped
+  atomically to disk on crash / SIGUSR2 / spool quarantine and served at
+  ``/debug/flight``.
+- ``telemetry``: condenses this process's registry into the compact
+  per-client snapshot the server aggregates fleet-wide.
 
-Env vars: NICE_TPU_METRICS_PORT (serve /metrics locally), NICE_TPU_TRACE
-(span sink: "stderr"/"1" or a file path), NICE_TPU_PROFILE (jax profiler
-output dir).
+Env vars: NICE_TPU_METRICS_PORT (serve /metrics locally; 0 = ephemeral
+port, exported as nice_metrics_bound_port), NICE_TPU_TRACE (span sink:
+"stderr"/"1" or a file path; NICE_TPU_TRACE_MAX_BYTES caps+rotates file
+sinks), NICE_TPU_PROFILE (jax profiler output dir), NICE_TPU_FLIGHT_DIR /
+NICE_TPU_FLIGHT_EVENTS (flight-recorder dump dir / ring capacity).
 """
 
-from . import series  # noqa: F401 — importing pre-seeds the series
+from . import flight, series, telemetry  # noqa: F401 — importing pre-seeds
 from .metrics import (  # noqa: F401
     REGISTRY,
     Counter,
@@ -29,7 +39,18 @@ from .metrics import (  # noqa: F401
     render,
 )
 from .serve import maybe_serve_metrics, serve_metrics  # noqa: F401
-from .trace import profiler, span, trace_enabled, trace_event  # noqa: F401
+from .trace import (  # noqa: F401
+    claim_trace_id,
+    current_trace_id,
+    current_traceparent,
+    make_traceparent,
+    parse_traceparent,
+    profiler,
+    span,
+    trace_context,
+    trace_enabled,
+    trace_event,
+)
 
 __all__ = [
     "REGISTRY",
@@ -42,10 +63,18 @@ __all__ = [
     "histogram",
     "render",
     "series",
+    "flight",
+    "telemetry",
     "serve_metrics",
     "maybe_serve_metrics",
     "span",
     "trace_event",
     "trace_enabled",
+    "trace_context",
+    "current_trace_id",
+    "current_traceparent",
+    "claim_trace_id",
+    "make_traceparent",
+    "parse_traceparent",
     "profiler",
 ]

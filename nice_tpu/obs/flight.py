@@ -1,0 +1,196 @@
+"""Crash flight recorder: a bounded in-process ring of recent structured
+events, dumped atomically to disk when something goes wrong.
+
+Metrics say how often things happen; the flight recorder says what the last
+N of them WERE. Production hooks record dispatches-gone-wrong, HTTP retries,
+injected faults, checkpoint writes/restores, backend downgrades, and spool
+journal/quarantine transitions — cheap enough to leave permanently armed
+(one deque append under a lock).
+
+Dump triggers:
+  * crash: ``install()`` chains onto ``sys.excepthook``, so any uncaught
+    exception leaves a dump next to the wreckage;
+  * SIGUSR2: operator-triggered dump of a live, healthy-looking process
+    (the "what has it been doing" escape hatch for a wedged client);
+  * spool quarantine: a submission the server definitively rejected is
+    exactly the moment the preceding event history matters (faults/spool.py
+    calls ``dump(reason="quarantine")``);
+  * ``GET /debug/flight`` on the local metrics server (obs/serve.py) and on
+    the API server reads the live ring without dumping.
+
+Dumps are atomic (tmp + rename) JSON files under ``NICE_TPU_FLIGHT_DIR``
+(default: the system temp dir), named ``nice-flight-<pid>-<reason>.json``.
+A repeated trigger with the same reason overwrites — the LATEST history
+wins, and a crash-looping client cannot fill the disk with dumps.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from . import metrics
+
+log = logging.getLogger("nice_tpu.obs")
+
+__all__ = ["FlightRecorder", "RECORDER", "record", "snapshot", "dump",
+           "install"]
+
+DEFAULT_CAPACITY = 512
+
+FLIGHT_EVENTS = metrics.counter(
+    "nice_flight_events_total",
+    "Structured events appended to the in-process flight-recorder ring, "
+    "by kind.",
+    labelnames=("kind",),
+)
+FLIGHT_DUMPS = metrics.counter(
+    "nice_flight_dumps_total",
+    "Flight-recorder ring dumps written to disk, by trigger reason.",
+    labelnames=("reason",),
+)
+
+# Kinds the production hooks emit, pre-seeded so a scrape of a clean process
+# shows the series at zero (registry convention, see obs/series.py).
+_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint", "restore",
+                "downgrade", "spool", "quarantine", "submit", "claim",
+                "crash", "telemetry")
+for _k in _KNOWN_KINDS:
+    FLIGHT_EVENTS.labels(_k)
+for _r in ("crash", "sigusr2", "quarantine", "manual"):
+    FLIGHT_DUMPS.labels(_r)
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of {seq, ts, kind, **fields} events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, kind: str, **fields) -> None:
+        rec = {"seq": 0, "ts": time.time(), "kind": kind}
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._events.append(rec)
+        FLIGHT_EVENTS.labels(kind).inc()
+
+    def snapshot(self) -> list[dict]:
+        """Copy of the ring, oldest first."""
+        with self._lock:
+            return list(self._events)
+
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def dump(self, reason: str = "manual",
+             path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the ring to disk; returns the path (None when the
+        write failed — dumping must never take the process down with it)."""
+        events = self.snapshot()
+        if path is None:
+            out_dir = os.environ.get(
+                "NICE_TPU_FLIGHT_DIR", tempfile.gettempdir()
+            )
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+            except OSError:
+                return None
+            path = os.path.join(
+                out_dir, f"nice-flight-{os.getpid()}-{reason}.json"
+            )
+        payload = {
+            "dumped_at": time.time(),
+            "reason": reason,
+            "pid": os.getpid(),
+            "argv": sys.argv,
+            "total_recorded": self.total_recorded(),
+            "capacity": self.capacity,
+            "events": events,
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=repr)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("flight-recorder dump to %s failed: %s", path, exc)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        FLIGHT_DUMPS.labels(reason).inc()
+        log.info("flight recorder dumped %d events to %s (reason=%s)",
+                 len(events), path, reason)
+        return path
+
+
+def _capacity() -> int:
+    try:
+        return max(
+            16, int(os.environ.get("NICE_TPU_FLIGHT_EVENTS", DEFAULT_CAPACITY))
+        )
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+RECORDER = FlightRecorder(_capacity())
+
+record = RECORDER.record
+snapshot = RECORDER.snapshot
+dump = RECORDER.dump
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def install() -> None:
+    """Arm the crash/SIGUSR2 dump triggers (idempotent).
+
+    Chains the previous sys.excepthook; the SIGUSR2 handler is only
+    installed from the main thread on platforms that have the signal, and
+    never clobbers a non-default handler someone else installed."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+
+    prev_hook = sys.excepthook
+
+    def _crash_hook(exc_type, exc, tb):
+        record("crash", error=repr(exc), type=exc_type.__name__)
+        RECORDER.dump(reason="crash")
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _crash_hook
+
+    if (
+        hasattr(signal, "SIGUSR2")
+        and threading.current_thread() is threading.main_thread()
+    ):
+        try:
+            existing = signal.getsignal(signal.SIGUSR2)
+            if existing in (signal.SIG_DFL, signal.SIG_IGN, None):
+                signal.signal(
+                    signal.SIGUSR2,
+                    lambda signum, frame: RECORDER.dump(reason="sigusr2"),
+                )
+        except (OSError, ValueError):
+            pass  # e.g. restricted environments; crash hook still armed

@@ -113,6 +113,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def values(self) -> Dict[LabelKey, float]:
+        """Snapshot of every label combination's value (telemetry/fleet
+        aggregation reads the registry instead of double-counting)."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> Iterable[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -163,6 +169,10 @@ class Gauge(_Metric):
         key = self._key(labelvalues)
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def values(self) -> Dict[LabelKey, float]:
+        with self._lock:
+            return dict(self._values)
 
     def render(self) -> Iterable[str]:
         with self._lock:

@@ -204,6 +204,72 @@ SERVER_SQLITE_BUSY_RETRIES = metrics.counter(
     "Write transactions retried after SQLITE_BUSY before succeeding.",
 )
 
+# --- fleet telemetry aggregation (server/app.py, server/db.py) -----------
+# Re-exported from client_telemetry rows the server persists: each client
+# ships a compact registry snapshot with every submission and with the
+# lightweight POST /telemetry heartbeat. Refreshed on every /status,
+# /metrics, and /telemetry request.
+FLEET_CLIENTS = metrics.gauge(
+    "nice_fleet_clients",
+    "Distinct clients whose telemetry heartbeat is fresher than the "
+    "activity window (NICE_TPU_FLEET_ACTIVE_SECS, default 900).",
+)
+FLEET_FIELDS = metrics.gauge(
+    "nice_fleet_fields_total",
+    "Fields completed across all reporting clients, by mode.",
+    labelnames=("mode",),
+)
+FLEET_NUMBERS = metrics.gauge(
+    "nice_fleet_numbers",
+    "Candidate numbers processed across all reporting clients.",
+)
+FLEET_RATE = metrics.gauge(
+    "nice_fleet_numbers_per_sec",
+    "Summed most-recent per-client throughput (numbers/sec).",
+)
+FLEET_DOWNGRADES = metrics.gauge(
+    "nice_fleet_backend_downgrades",
+    "Mid-field backend downgrades across all reporting clients.",
+)
+FLEET_RESTORES = metrics.gauge(
+    "nice_fleet_checkpoint_restores",
+    "Checkpoint restores across all reporting clients.",
+)
+FLEET_FAULTS = metrics.gauge(
+    "nice_fleet_faults_injected",
+    "Chaos faults fired across all reporting clients.",
+)
+FLEET_SPOOL_DEPTH = metrics.gauge(
+    "nice_fleet_spool_depth",
+    "Submissions sitting in on-disk spools across all reporting clients.",
+)
+FLEET_FIELD_LATENCY = metrics.gauge(
+    "nice_fleet_field_seconds",
+    "Recent server-observed field latency quantiles (claim->accepted "
+    "submission), over the last ~200 submissions.",
+    labelnames=("quantile",),
+)
+SERVER_FIELD_ELAPSED = metrics.histogram(
+    "nice_server_field_elapsed_seconds",
+    "Claim-to-accepted-submission elapsed time as observed by the server, "
+    "by mode.",
+    labelnames=("mode",),
+    buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0, 10800.0, 43200.0),
+)
+SERVER_TELEMETRY_REPORTS = metrics.counter(
+    "nice_server_telemetry_reports_total",
+    "Client telemetry snapshots persisted, by source (heartbeat POST "
+    "/telemetry vs piggyback on a submission).",
+    labelnames=("source",),
+)
+
+# --- local metrics endpoint (obs/serve.py) -------------------------------
+METRICS_BOUND_PORT = metrics.gauge(
+    "nice_metrics_bound_port",
+    "TCP port the local /metrics endpoint actually bound (matters when "
+    "NICE_TPU_METRICS_PORT=0 asks for an ephemeral port; 0 = not serving).",
+)
+
 # --- daemon (daemon/main.py) --------------------------------------------
 DAEMON_HEARTBEAT = metrics.gauge(
     "nice_daemon_heartbeat_timestamp_seconds",
@@ -248,9 +314,16 @@ for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques",
     PALLAS_DISPATCH_SECONDS.labels(_kernel)
 for _phase in ("import-jax", "configure", "devices"):
     BACKEND_INIT_SECONDS.labels(_phase)
-for _endpoint in ("claim", "submit", "validate", "renew"):
+for _endpoint in ("claim", "submit", "validate", "renew", "telemetry"):
     CLIENT_REQUEST_SECONDS.labels(_endpoint)
     CLIENT_RETRIES.labels(_endpoint)
+for _mode in ("detailed", "niceonly"):
+    FLEET_FIELDS.labels(_mode)
+    SERVER_FIELD_ELAPSED.labels(_mode)
+for _q in ("0.5", "0.95"):
+    FLEET_FIELD_LATENCY.labels(_q)
+for _source in ("heartbeat", "submission"):
+    SERVER_TELEMETRY_REPORTS.labels(_source)
 for _reason in ("corrupt", "signature", "version"):
     CKPT_REJECTED.labels(_reason)
 for _outcome in ("delivered", "rejected", "deferred"):

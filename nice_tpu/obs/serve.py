@@ -1,19 +1,25 @@
-"""Local /metrics HTTP endpoint for processes that aren't the API server.
+"""Local /metrics + /debug/flight HTTP endpoint for processes that aren't
+the API server.
 
 The client and daemon run hot loops with no HTTP surface of their own; a
 tiny stdlib ThreadingHTTPServer on a localhost port makes their registry
-scrapeable. Opt-in via NICE_TPU_METRICS_PORT (port 0 picks a free one).
+scrapeable and their flight-recorder ring inspectable without signalling
+the process. Opt-in via NICE_TPU_METRICS_PORT — port 0 binds an ephemeral
+port so client+daemon on one host never collide; the actually-bound port is
+logged and exported as the ``nice_metrics_bound_port`` gauge (scrape the
+daemon, learn where its clients live). Unknown paths get a real 404.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from . import metrics
+from . import flight, metrics, series
 
 log = logging.getLogger("nice_tpu.obs")
 
@@ -23,12 +29,26 @@ _started: Optional[ThreadingHTTPServer] = None
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = metrics.render().encode("utf-8")
+            ctype = "text/plain; version=0.0.4"
+        elif path == "/debug/flight":
+            body = json.dumps(
+                {
+                    "pid": os.getpid(),
+                    "capacity": flight.RECORDER.capacity,
+                    "total_recorded": flight.RECORDER.total_recorded(),
+                    "events": flight.snapshot(),
+                },
+                default=repr,
+            ).encode("utf-8")
+            ctype = "application/json"
+        else:
             self.send_error(404)
             return
-        body = metrics.render().encode("utf-8")
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -41,6 +61,7 @@ def serve_metrics(port: int, host: str = "127.0.0.1") -> ThreadingHTTPServer:
     """Start a daemon-thread metrics server; returns the server (read the
     bound port from ``server.server_address[1]`` when port=0)."""
     server = ThreadingHTTPServer((host, port), _MetricsHandler)
+    series.METRICS_BOUND_PORT.set(server.server_address[1])
     t = threading.Thread(
         target=server.serve_forever, name="nice-metrics", daemon=True
     )
@@ -49,8 +70,9 @@ def serve_metrics(port: int, host: str = "127.0.0.1") -> ThreadingHTTPServer:
 
 
 def maybe_serve_metrics() -> Optional[ThreadingHTTPServer]:
-    """Start the local /metrics endpoint iff NICE_TPU_METRICS_PORT is set.
-    Idempotent per process; a busy port logs a warning instead of raising."""
+    """Start the local /metrics endpoint iff NICE_TPU_METRICS_PORT is set
+    (0 = pick a free port). Idempotent per process; a busy port logs a
+    warning instead of raising."""
     global _started
     raw = os.environ.get("NICE_TPU_METRICS_PORT", "")
     if not raw:

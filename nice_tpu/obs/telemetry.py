@@ -1,0 +1,91 @@
+"""Client-side fleet telemetry snapshot.
+
+``snapshot()`` condenses this process's metrics registry into a compact,
+JSON-safe dict the server can aggregate: throughput, backend mix, mid-field
+downgrades, checkpoint restores, injected faults, and spool depth. It reads
+the same counters the local /metrics endpoint renders — no second set of
+books — and adds a per-call rate sample (numbers/sec since the previous
+snapshot) so the server can sum instantaneous fleet throughput without
+differentiating counters itself.
+
+Two transports carry the snapshot (both in client/api_client.py):
+piggybacked on every submission under ``DataToServer.telemetry``, and a
+lightweight ``POST /telemetry`` heartbeat so idle or long-scanning clients
+stay visible. ``client_id`` is stable for the life of the process
+(user@host/pid), so the server's ``client_telemetry`` table upserts one row
+per running client.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from . import series
+
+__all__ = ["snapshot", "client_id", "SNAPSHOT_VERSION"]
+
+SNAPSHOT_VERSION = 1
+
+_lock = threading.Lock()
+_prev_numbers = 0.0
+_prev_time: Optional[float] = None
+
+
+def client_id(username: str = "") -> str:
+    """Process-stable fleet identity: user@host/pid."""
+    host = socket.gethostname() or "unknown-host"
+    user = username or os.environ.get("USER", "anonymous")
+    return f"{user}@{host}/{os.getpid()}"
+
+
+def _sum(counter) -> float:
+    return sum(counter.values().values())
+
+
+def snapshot(
+    username: str = "",
+    backend: str = "",
+    spool_depth: int = 0,
+    client_version: str = "",
+) -> dict:
+    """Current registry condensed to the /telemetry wire format."""
+    global _prev_numbers, _prev_time
+    now = time.time()
+    numbers = _sum(series.CLIENT_NUMBERS)
+    with _lock:
+        if _prev_time is None or now <= _prev_time:
+            rate = 0.0
+        else:
+            rate = max(0.0, (numbers - _prev_numbers) / (now - _prev_time))
+        _prev_numbers = numbers
+        _prev_time = now
+    fields = {
+        mode: int(v)
+        for (mode,), v in series.CLIENT_FIELDS.values().items()
+        if v
+    }
+    downgrades = {
+        f"{frm}->{to}": int(v)
+        for (frm, to), v in series.ENGINE_BACKEND_DOWNGRADES.values().items()
+        if v
+    }
+    return {
+        "v": SNAPSHOT_VERSION,
+        "client_id": client_id(username),
+        "username": username,
+        "client_version": client_version,
+        "backend": backend,
+        "ts": now,
+        "numbers": int(numbers),
+        "numbers_per_sec": round(rate, 3),
+        "fields": fields,
+        "downgrades": downgrades,
+        "downgrades_total": int(_sum(series.ENGINE_BACKEND_DOWNGRADES)),
+        "restores": int(series.CKPT_RESTORES.value()),
+        "faults": int(_sum(series.FAULTS_INJECTED)),
+        "spool_depth": int(spool_depth),
+    }

@@ -6,10 +6,25 @@ before the body runs, a hang inside the span (the classic wedged axon device
 lease) still leaves a begin-without-end record naming the exact stalled
 phase; BENCH rounds 4/5 died with no such evidence.
 
+Distributed tracing: spans and events stamped inside a ``trace_context``
+carry a ``trace_id``, so one field's lifecycle — claim on the server, scan on
+the client, submit back on the server — reconstructs from the JSON sinks on
+either side by grouping on that id. The id is DERIVED from the claim id
+(``claim_trace_id``), so both processes agree on it without negotiating:
+the client stamps a W3C-style ``traceparent`` header on its requests and the
+server continues the same trace in its handler spans. Each span also gets a
+random ``span_id`` (and its parent's as ``parent_id``) for exact tree
+reconstruction; the human-readable ``parent`` name field is kept alongside.
+
 Sink selection via ``NICE_TPU_TRACE``:
   unset / "" / "0"  -> disabled (spans still feed the duration histogram)
   "1" or "stderr"   -> JSON lines on stderr
   anything else     -> append to that file path
+
+File sinks are size-capped: past ``NICE_TPU_TRACE_MAX_BYTES`` (default
+64 MiB; 0 disables) the file rotates to ``<path>.1`` (one backup kept), so a
+week-long daemon run cannot grow the sink unboundedly. The sink is flushed
+and closed at interpreter exit.
 
 The env var is re-read when its value changes, so tests can redirect the
 sink per-test with monkeypatch. ``profiler(name)`` additionally wraps a
@@ -19,10 +34,13 @@ output directory — import-guarded so the module stays jax-free otherwise.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
+import hashlib
 import io
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -30,7 +48,18 @@ from typing import Optional
 
 from . import metrics
 
-__all__ = ["span", "trace_event", "trace_enabled", "profiler"]
+__all__ = [
+    "span",
+    "trace_event",
+    "trace_enabled",
+    "profiler",
+    "trace_context",
+    "current_trace_id",
+    "current_traceparent",
+    "claim_trace_id",
+    "make_traceparent",
+    "parse_traceparent",
+]
 
 SPAN_SECONDS = metrics.histogram(
     "nice_trace_span_seconds",
@@ -38,14 +67,79 @@ SPAN_SECONDS = metrics.histogram(
     labelnames=("span",),
 )
 
+DEFAULT_MAX_SINK_BYTES = 64 * 1024 * 1024
+
 _lock = threading.Lock()
 _sink_env: Optional[str] = None
 _sink: Optional[io.TextIOBase] = None
+_sink_bytes = 0  # current file-sink size (tracked to trigger rotation)
 _local = threading.local()
 
 
+# --- trace context ---------------------------------------------------------
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$"
+)
+
+
+def claim_trace_id(claim_id: int) -> str:
+    """Deterministic 16-byte trace id for one claim's whole lifecycle.
+
+    Derived (not negotiated): client and server independently compute the
+    same id from the claim id, so spans from both processes join into one
+    trace even when a request's traceparent header is lost."""
+    return hashlib.sha256(f"nice-claim:{claim_id}".encode()).hexdigest()[:32]
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]):
+    """Stamp every span/event in this thread with trace_id (None = no-op)."""
+    prev = getattr(_local, "trace_id", None)
+    _local.trace_id = trace_id
+    try:
+        yield
+    finally:
+        _local.trace_id = prev
+
+
+def current_trace_id() -> Optional[str]:
+    return getattr(_local, "trace_id", None)
+
+
+def make_traceparent(trace_id: str, span_id: Optional[str] = None) -> str:
+    """W3C traceparent header value for an outgoing request."""
+    return f"00-{trace_id}-{span_id or os.urandom(8).hex()}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """trace_id from a traceparent header, or None when absent/malformed."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    return m.group(1) if m else None
+
+
+def current_traceparent() -> Optional[str]:
+    """Header value for the ambient trace context, or None outside one."""
+    tid = current_trace_id()
+    return make_traceparent(tid) if tid else None
+
+
+# --- sink management -------------------------------------------------------
+
+
+def _max_sink_bytes() -> int:
+    try:
+        return int(
+            os.environ.get("NICE_TPU_TRACE_MAX_BYTES", DEFAULT_MAX_SINK_BYTES)
+        )
+    except ValueError:
+        return DEFAULT_MAX_SINK_BYTES
+
+
 def _get_sink() -> Optional[io.TextIOBase]:
-    global _sink_env, _sink
+    global _sink_env, _sink, _sink_bytes
     env = os.environ.get("NICE_TPU_TRACE", "")
     with _lock:
         if env == _sink_env:
@@ -64,6 +158,7 @@ def _get_sink() -> Optional[io.TextIOBase]:
         else:
             try:
                 _sink = open(env, "a", encoding="utf-8")
+                _sink_bytes = os.path.getsize(env)
             except OSError as exc:
                 print(f"nice_tpu.obs: cannot open trace sink {env!r}: {exc}",
                       file=sys.stderr)
@@ -71,11 +166,45 @@ def _get_sink() -> Optional[io.TextIOBase]:
         return _sink
 
 
+def _rotate_locked() -> None:
+    """Rotate the current file sink to <path>.1 and reopen. _lock held."""
+    global _sink, _sink_bytes
+    path = _sink_env
+    try:
+        _sink.close()
+    except OSError:
+        pass
+    try:
+        os.replace(path, path + ".1")
+    except OSError:
+        pass  # rotation is best-effort; keep appending to the same file
+    try:
+        _sink = open(path, "a", encoding="utf-8")
+        _sink_bytes = 0
+    except OSError as exc:
+        print(f"nice_tpu.obs: cannot reopen trace sink {path!r}: {exc}",
+              file=sys.stderr)
+        _sink = None
+
+
+@atexit.register
+def _flush_sink_at_exit() -> None:
+    with _lock:
+        if _sink is not None:
+            try:
+                _sink.flush()
+                if _sink is not sys.stderr:
+                    _sink.close()
+            except (OSError, ValueError):
+                pass
+
+
 def trace_enabled() -> bool:
     return _get_sink() is not None
 
 
 def _emit(record: dict) -> None:
+    global _sink_bytes
     sink = _get_sink()
     if sink is None:
         return
@@ -85,12 +214,20 @@ def _emit(record: dict) -> None:
             sink.write(line + "\n")
             sink.flush()  # hang evidence must hit the sink before the body
         except (OSError, ValueError):
-            pass
+            return
+        if sink is not sys.stderr:
+            _sink_bytes += len(line) + 1
+            cap = _max_sink_bytes()
+            if cap > 0 and _sink_bytes >= cap:
+                _rotate_locked()
 
 
 def trace_event(name: str, event: str = "instant", **fields) -> None:
     """One flushed JSON line outside any span lifecycle."""
     rec = {"ts": time.time(), "name": name, "event": event}
+    tid = current_trace_id()
+    if tid:
+        rec["trace_id"] = tid
     rec.update(fields)
     _emit(rec)
 
@@ -105,24 +242,32 @@ def _stack() -> list:
 @contextlib.contextmanager
 def span(name: str, **attrs):
     """Context manager: begin event now, end event (with wall_secs and
-    process_secs) on exit. Nesting is tracked per-thread via parent/depth."""
+    process_secs) on exit. Nesting is tracked per-thread via parent/depth;
+    span_id/parent_id give exact tree edges and trace_id joins the ambient
+    distributed trace (see trace_context)."""
     st = _stack()
     parent = st[-1] if st else None
     depth = len(st)
     enabled = trace_enabled()
+    span_id = os.urandom(8).hex() if enabled else ""
+    trace_id = current_trace_id()
     if enabled:
         rec = {
             "ts": time.time(),
             "name": name,
             "event": "begin",
             "depth": depth,
+            "span_id": span_id,
         }
+        if trace_id:
+            rec["trace_id"] = trace_id
         if parent:
-            rec["parent"] = parent
+            rec["parent"] = parent[0]
+            rec["parent_id"] = parent[1]
         if attrs:
             rec.update(attrs)
         _emit(rec)
-    st.append(name)
+    st.append((name, span_id))
     t0 = time.perf_counter()
     p0 = time.process_time()
     status = "ok"
@@ -141,12 +286,16 @@ def span(name: str, **attrs):
                 "name": name,
                 "event": "end",
                 "depth": depth,
+                "span_id": span_id,
                 "status": status,
                 "wall_secs": wall,
                 "process_secs": time.process_time() - p0,
             }
+            if trace_id:
+                rec["trace_id"] = trace_id
             if parent:
-                rec["parent"] = parent
+                rec["parent"] = parent[0]
+                rec["parent_id"] = parent[1]
             _emit(rec)
 
 

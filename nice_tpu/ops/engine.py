@@ -286,11 +286,24 @@ def _run_with_fallback(impl, range_, base, backend, kwargs) -> FieldResults:
         try:
             results = impl(range_, base, backend=backend, **kw)
         except BackendDispatchError as e:
+            obs.flight.record(
+                "dispatch_error", backend=e.backend, base=base,
+                cause=repr(e.cause)[:200],
+            )
             nxt = _FALLBACK_NEXT.get(e.backend)
             if nxt is None or not _fallback_enabled():
                 raise
             ENGINE_BACKEND_DOWNGRADES.labels(e.backend, nxt).inc()
             downgrades.append(f"{e.backend}->{nxt}")
+            cursor = e.state["cursor"] if e.state is not None else None
+            obs.flight.record(
+                "downgrade", from_backend=e.backend, to_backend=nxt,
+                base=base, cursor=cursor, cause=repr(e.cause)[:200],
+            )
+            obs.trace_event(
+                "engine.downgrade", from_backend=e.backend, to_backend=nxt,
+                base=base, cursor=cursor,
+            )
             log.warning(
                 "backend %s failed mid-field [%d, %d): %r — %s on %s "
                 "(downgrade %d)",
@@ -488,29 +501,36 @@ def _chunked_host_scan(
         _CkptTicker(every_batches, every_secs) if checkpoint_cb else None
     )
     n_batch = 0
-    while done < total:
-        n = min(chunk, total - done)
-        # End of the degradation chain: an injected (or real) scalar failure
-        # propagates to the caller — there is nothing left to fall back to.
-        _fire_dispatch_fault(n_batch, "scalar", start + done)
-        n_batch += 1
-        sub_range = FieldSize(start + done, start + done + n)
-        if detailed:
-            sub = scalar.process_range_detailed(sub_range, base)
-            for d in sub.distribution:
-                hist[d.num_uniques] += d.count
-        else:
-            sub = scalar.process_range_niceonly(sub_range, base, stride_table)
-        nice.extend(sub.nice_numbers)
-        done += n
-        if progress is not None:
-            progress(done, total)
-        if ticker is not None and ticker.tick():
-            checkpoint_cb({
-                "cursor": start + done,
-                "hist": None if hist is None else hist.copy(),
-                "nice_numbers": [(x.number, x.num_uniques) for x in nice],
-            })
+    with obs.span("engine.scalar", base=base, size=total, mode=mode,
+                  backend="scalar"):
+        while done < total:
+            n = min(chunk, total - done)
+            # End of the degradation chain: an injected (or real) scalar
+            # failure propagates to the caller — there is nothing left to
+            # fall back to.
+            _fire_dispatch_fault(n_batch, "scalar", start + done)
+            n_batch += 1
+            sub_range = FieldSize(start + done, start + done + n)
+            if detailed:
+                sub = scalar.process_range_detailed(sub_range, base)
+                for d in sub.distribution:
+                    hist[d.num_uniques] += d.count
+            else:
+                sub = scalar.process_range_niceonly(
+                    sub_range, base, stride_table
+                )
+            nice.extend(sub.nice_numbers)
+            done += n
+            if progress is not None:
+                progress(done, total)
+            if ticker is not None and ticker.tick():
+                checkpoint_cb({
+                    "cursor": start + done,
+                    "hist": None if hist is None else hist.copy(),
+                    "nice_numbers": [
+                        (x.number, x.num_uniques) for x in nice
+                    ],
+                })
     nice.sort(key=lambda x: x.number)
     if not detailed:
         return FieldResults(distribution=(), nice_numbers=tuple(nice))
@@ -1441,7 +1461,9 @@ def _process_range_detailed(
     (checkpoint_cb is ignored; resume raises)."""
     if backend == "scalar":
         if checkpoint_cb is None and resume is None:
-            return scalar.process_range_detailed(range_, base)
+            with obs.span("engine.scalar", base=base, size=range_.size(),
+                          mode="detailed", backend="scalar"):
+                return scalar.process_range_detailed(range_, base)
         return _chunked_host_scan(
             range_, base, "detailed", batch_size, progress,
             checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
@@ -1610,7 +1632,8 @@ def _process_range_detailed(
     dispatch_failure = None  # (exception, cursor of the failed batch)
     with _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
-        with obs.span("engine.detailed", base=base, size=total):
+        with obs.span("engine.detailed", base=base, size=total,
+                      backend=backend):
             done = done0
             n_batch = 0
             while done < total:
@@ -1741,7 +1764,11 @@ def _process_range_niceonly(
     exactly the remaining set."""
     if backend == "scalar":
         if checkpoint_cb is None and resume is None:
-            return scalar.process_range_niceonly(range_, base, stride_table)
+            with obs.span("engine.scalar", base=base, size=range_.size(),
+                          mode="niceonly", backend="scalar"):
+                return scalar.process_range_niceonly(
+                    range_, base, stride_table
+                )
         return _chunked_host_scan(
             range_, base, "niceonly", batch_size, progress,
             checkpoint_cb, resume, checkpoint_batches, checkpoint_secs,
@@ -1831,7 +1858,8 @@ def _process_range_niceonly(
             # the dominant cost at this scale, and sub-RTT fields are mostly
             # ones the MSD filter cannot prune anyway (else they'd be cheap).
             ENGINE_HOST_FALLBACK.labels("host-route").inc()
-            with obs.span("engine.niceonly-host", base=base, size=core.size()):
+            with obs.span("engine.niceonly-host", base=base,
+                          size=core.size(), backend="native"):
                 sub = _native_niceonly(
                     core, base, None, _native_threads(), progress,
                     msd_floor=max(1 << 20, core.size() // 8),
@@ -1860,7 +1888,8 @@ def _process_range_niceonly(
 
         try:
             with obs.span(
-                "engine.niceonly-strided", base=base, size=core.size()
+                "engine.niceonly-strided", base=base, size=core.size(),
+                backend="pallas",
             ):
                 found = _niceonly_pallas(
                     core, base, progress=progress,
@@ -1967,7 +1996,8 @@ def _process_range_niceonly(
     dispatch_failure = None  # (exception, cursor of the failed batch)
     with _Collector(collect_item, DISPATCH_WINDOW, "dense-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
-        with obs.span("engine.niceonly-dense", base=base, size=core.size()):
+        with obs.span("engine.niceonly-dense", base=base, size=core.size(),
+                      backend=backend):
             n_batch = 0
             for sub_range in sub_ranges:
                 if collector.failed() or dispatch_failure is not None:

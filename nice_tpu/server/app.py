@@ -10,6 +10,7 @@ Prometheus /metrics exporter with per-endpoint request timing.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import logging
 import os
@@ -30,8 +31,19 @@ from nice_tpu.core.types import (
     SearchMode,
 )
 from nice_tpu.obs.series import (
+    FLEET_CLIENTS,
+    FLEET_DOWNGRADES,
+    FLEET_FAULTS,
+    FLEET_FIELD_LATENCY,
+    FLEET_FIELDS,
+    FLEET_NUMBERS,
+    FLEET_RATE,
+    FLEET_RESTORES,
+    FLEET_SPOOL_DEPTH,
     SERVER_DUPLICATE_SUBMITS,
+    SERVER_FIELD_ELAPSED,
     SERVER_OVERLOAD_RESPONSES,
+    SERVER_TELEMETRY_REPORTS,
 )
 from nice_tpu.ops import scalar
 from nice_tpu.server.db import Db
@@ -73,6 +85,11 @@ class Metrics:
     def record(self, endpoint: str, status: int, elapsed: float) -> None:
         self._requests.labels(endpoint, str(status)).inc()
         self._latency.labels(endpoint).observe(elapsed)
+
+    def request_counts(self) -> dict:
+        """{(endpoint, status): count} snapshot (the /status fleet block's
+        request/error rollup reads the same counters /metrics renders)."""
+        return self._requests.values()
 
     def render(self) -> str:
         lines = [self.registry.render().rstrip("\n")]
@@ -307,6 +324,18 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
                 field.field_id, field.canon_submission_id, 2
             )
 
+    mode_label = (
+        "niceonly" if claim.search_mode == SearchMode.NICEONLY else "detailed"
+    )
+    SERVER_FIELD_ELAPSED.labels(mode_label).observe(elapsed_secs)
+    if data.telemetry is not None:
+        # Piggybacked fleet snapshot: persisted after the submission so a
+        # malformed snapshot can never reject valid results.
+        _persist_telemetry(ctx, data.telemetry, user_ip, "submission")
+    obs.flight.record(
+        "submit", claim=data.claim_id, field=claim.field_id,
+        mode=mode_label, elapsed_secs=round(elapsed_secs, 3),
+    )
     log.info(
         "New Submission: mode=%s field=%d claim=%d username=%s%s",
         claim.search_mode,
@@ -334,6 +363,106 @@ def handle_renew_claim(ctx: ApiContext, payload: dict) -> dict:
     from nice_tpu.server.db import ts
 
     return {"status": "OK", "renewed_at": ts(renewed_at)}
+
+
+def _persist_telemetry(
+    ctx: ApiContext, snap, user_ip: str, source: str
+) -> bool:
+    """Upsert one client snapshot; False (never an error) when the snapshot
+    is unusable — telemetry is best-effort on both sides of the wire."""
+    if not isinstance(snap, dict):
+        return False
+    try:
+        ctx.db.upsert_client_telemetry(snap, user_ip)
+    except (ValueError, sqlite3.Error) as e:
+        log.warning("discarding bad telemetry snapshot (%s): %s", source, e)
+        return False
+    SERVER_TELEMETRY_REPORTS.labels(source).inc()
+    return True
+
+
+def handle_telemetry(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+    """POST /telemetry — the fleet heartbeat. Body is one obs.telemetry
+    snapshot; the row is upserted by client_id, so a client reporting every
+    minute costs one row, not one per report."""
+    if not _persist_telemetry(ctx, payload, user_ip, "heartbeat"):
+        raise ApiError(400, "body must be a telemetry snapshot with client_id")
+    return {"status": "OK"}
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return round(float(sorted_vals[idx]), 3)
+
+
+def fleet_active_secs() -> float:
+    return float(os.environ.get("NICE_TPU_FLEET_ACTIVE_SECS", 900))
+
+
+def build_fleet_block(ctx: ApiContext) -> dict:
+    """The /status `fleet` block: claim health + per-client telemetry rolled
+    up across the fleet. Side effect: refreshes the nice_fleet_* gauges so a
+    /metrics scrape right after /status agrees with it."""
+    clients = ctx.db.get_client_telemetry(fleet_active_secs())
+    claim_stats = ctx.db.get_fleet_claim_stats()
+    elapsed = sorted(ctx.db.get_recent_field_elapsed())
+    p50 = _percentile(elapsed, 0.50)
+    p95 = _percentile(elapsed, 0.95)
+
+    backends: dict = {}
+    fields_by_mode = {"detailed": 0, "niceonly": 0}
+    numbers = 0
+    rate = downgrades = restores = faults_total = spool_depth = 0
+    for c in clients:
+        if c["backend"]:
+            backends[c["backend"]] = backends.get(c["backend"], 0) + 1
+        fields_by_mode["detailed"] += c["fields_detailed"]
+        fields_by_mode["niceonly"] += c["fields_niceonly"]
+        numbers += int(c["numbers_total"])
+        rate += c["numbers_per_sec"]
+        downgrades += c["downgrades"]
+        restores += c["restores"]
+        faults_total += c["faults"]
+        spool_depth += c["spool_depth"]
+
+    FLEET_CLIENTS.set(len(clients))
+    FLEET_FIELDS.labels("detailed").set(fields_by_mode["detailed"])
+    FLEET_FIELDS.labels("niceonly").set(fields_by_mode["niceonly"])
+    FLEET_NUMBERS.set(float(numbers))
+    FLEET_RATE.set(rate)
+    FLEET_DOWNGRADES.set(downgrades)
+    FLEET_RESTORES.set(restores)
+    FLEET_FAULTS.set(faults_total)
+    FLEET_SPOOL_DEPTH.set(spool_depth)
+    FLEET_FIELD_LATENCY.labels("0.5").set(p50)
+    FLEET_FIELD_LATENCY.labels("0.95").set(p95)
+
+    requests: dict = {}
+    errors = 0
+    for (endpoint, status), count in ctx.metrics.request_counts().items():
+        requests[endpoint] = requests.get(endpoint, 0) + int(count)
+        if status.startswith(("4", "5")):
+            errors += int(count)
+    return {
+        "active_secs": fleet_active_secs(),
+        "clients": clients,
+        "client_count": len(clients),
+        "backends": backends,
+        "fields": fields_by_mode,
+        "numbers_total": str(numbers),
+        "numbers_per_sec": round(rate, 3),
+        "downgrades": downgrades,
+        "checkpoint_restores": restores,
+        "faults_injected": faults_total,
+        "spool_depth": spool_depth,
+        "field_seconds_p50": p50,
+        "field_seconds_p95": p95,
+        "requests": requests,
+        "error_responses": errors,
+        **claim_stats,
+    }
 
 
 def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
@@ -368,6 +497,14 @@ def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
 NOT_FOUND_MESSAGE = (
     "The requested resource could not be found. Available resources include"
     " /claim/detailed, /claim/niceonly, /claim/validate, and /submit."
+)
+
+# Path segments that may name a handler span. Everything else collapses to
+# "static" (file-like) or "other" so arbitrary 404 probes cannot mint
+# unbounded label values in the span-duration histogram.
+_SPAN_SEGS = frozenset(
+    {"claim", "submit", "renew_claim", "status", "metrics", "stats", "query",
+     "telemetry", "debug", "admin", "root"}
 )
 
 
@@ -409,6 +546,25 @@ def make_handler(ctx: ApiContext):
             endpoint = path or "/"
             status = 200
             within_cap = ctx.enter_request()
+            seg = (path.lstrip("/").split("/", 1)[0]) or "root"
+            # Distributed-trace continuation: a request stamped with a
+            # traceparent header (every api_client call inside a field's
+            # trace_context) gets its handler span joined to the client's
+            # trace — grep both JSON sinks for one trace_id and the whole
+            # claim -> scan -> submit lifecycle reconstructs.
+            span_seg = (
+                seg if seg in _SPAN_SEGS
+                else ("static" if "." in seg else "other")
+            )
+            span_ctx = contextlib.ExitStack()
+            span_ctx.enter_context(
+                obs.trace_context(
+                    obs.parse_traceparent(self.headers.get("traceparent"))
+                )
+            )
+            span_ctx.enter_context(
+                obs.span(f"server.{span_seg}", method=method)
+            )
             try:
                 # Overload shed: past the in-flight cap, answer 503 with a
                 # Retry-After hint instead of queueing unboundedly. /metrics
@@ -431,7 +587,6 @@ def make_handler(ctx: ApiContext):
                 # server.claim, ...). Numeric actions inject that status
                 # before the real handler runs; "drop" closes the connection
                 # without a response (the client sees a mid-request crash).
-                seg = (path.lstrip("/").split("/", 1)[0]) or "root"
                 act = faults.fire(f"server.{seg}", path=path, method=method)
                 if act is not None:
                     if act == "drop":
@@ -483,6 +638,18 @@ def make_handler(ctx: ApiContext):
                             "status": "ok",
                             "niceonly_queue_size": ctx.queue.niceonly_queue_size(),
                             "detailed_thin_queue_size": ctx.queue.detailed_thin_queue_size(),
+                            "fleet": build_fleet_block(ctx),
+                        },
+                    )
+                elif method == "GET" and path == "/debug/flight":
+                    self._send(
+                        200,
+                        {
+                            "pid": os.getpid(),
+                            "capacity": obs.flight.RECORDER.capacity,
+                            "total_recorded":
+                                obs.flight.RECORDER.total_recorded(),
+                            "events": obs.flight.snapshot(),
                         },
                     )
                 elif method == "GET" and path == "/metrics":
@@ -539,6 +706,13 @@ def make_handler(ctx: ApiContext):
                     except json.JSONDecodeError as e:
                         raise ApiError(400, f"Invalid JSON body: {e}")
                     self._send(200, handle_submit(ctx, payload, user_ip))
+                elif method == "POST" and path == "/telemetry":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError as e:
+                        raise ApiError(400, f"Invalid JSON body: {e}")
+                    self._send(200, handle_telemetry(ctx, payload, user_ip))
                 elif method == "POST" and path == "/renew_claim":
                     length = int(self.headers.get("Content-Length", 0))
                     try:
@@ -564,6 +738,7 @@ def make_handler(ctx: ApiContext):
                 log.exception("internal error handling %s %s", method, path)
                 self._error(500, f"Internal server error: {e}")
             finally:
+                span_ctx.close()
                 ctx.exit_request()
                 ctx.metrics.record(endpoint, status, time.monotonic() - t0)
 
@@ -675,6 +850,9 @@ def main(argv=None) -> int:
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR); the live
+    # ring is also served at GET /debug/flight.
+    obs.flight.install()
     if args.init_base:
         db = Db(args.db)
         for base in args.init_base:

@@ -888,6 +888,171 @@ class Db:
         finally:
             conn.close()
 
+    # -- fleet telemetry (client_telemetry table; /telemetry heartbeat and
+    # submission piggyback feed it, the /status fleet block reads it) -------
+
+    def upsert_client_telemetry(self, snap: dict, user_ip: str = "") -> None:
+        """Persist one client's snapshot (obs.telemetry wire format), keyed
+        by its process-stable client_id. Later reports win; first_seen is
+        preserved across updates."""
+        client_id = str(snap.get("client_id") or "")[:256]
+        if not client_id:
+            raise ValueError("telemetry snapshot missing client_id")
+
+        def _i(key):
+            try:
+                return int(snap.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        fields = snap.get("fields") or {}
+        if not isinstance(fields, dict):
+            fields = {}
+        try:
+            rate = float(snap.get("numbers_per_sec", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            rate = 0.0
+        when = ts(now_utc())
+        row = (
+            client_id,
+            str(snap.get("username") or "")[:256],
+            user_ip,
+            str(snap.get("client_version") or "")[:64],
+            str(snap.get("backend") or "")[:32],
+            when,
+            when,
+            int(fields.get("detailed", 0) or 0),
+            int(fields.get("niceonly", 0) or 0),
+            pad(max(0, _i("numbers"))),
+            rate,
+            _i("downgrades_total"),
+            _i("restores"),
+            _i("faults"),
+            _i("spool_depth"),
+            json.dumps(snap)[: 64 * 1024],
+        )
+        with self._lock, self._txn():
+            self._conn.execute(
+                "INSERT INTO client_telemetry (client_id, username, user_ip,"
+                " client_version, backend, first_seen, last_seen,"
+                " fields_detailed, fields_niceonly, numbers_total,"
+                " numbers_per_sec, downgrades, restores, faults, spool_depth,"
+                " snapshot)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+                " ON CONFLICT(client_id) DO UPDATE SET"
+                " username = excluded.username,"
+                " user_ip = excluded.user_ip,"
+                " client_version = excluded.client_version,"
+                " backend = excluded.backend,"
+                " last_seen = excluded.last_seen,"
+                " fields_detailed = excluded.fields_detailed,"
+                " fields_niceonly = excluded.fields_niceonly,"
+                " numbers_total = excluded.numbers_total,"
+                " numbers_per_sec = excluded.numbers_per_sec,"
+                " downgrades = excluded.downgrades,"
+                " restores = excluded.restores,"
+                " faults = excluded.faults,"
+                " spool_depth = excluded.spool_depth,"
+                " snapshot = excluded.snapshot",
+                row,
+            )
+
+    def get_client_telemetry(self, active_secs: float = 900.0) -> list[dict]:
+        """Per-client rows whose last report is fresher than active_secs,
+        newest first (the fleet dashboard's client table)."""
+        cutoff = ts(now_utc() - timedelta(seconds=active_secs))
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT * FROM client_telemetry WHERE last_seen >= ?"
+                " ORDER BY last_seen DESC",
+                (cutoff,),
+            ).fetchall()
+        out = []
+        for r in rows:
+            out.append(
+                {
+                    "client_id": r["client_id"],
+                    "username": r["username"],
+                    "user_ip": r["user_ip"],
+                    "client_version": r["client_version"],
+                    "backend": r["backend"],
+                    "first_seen": r["first_seen"],
+                    "last_seen": r["last_seen"],
+                    "fields_detailed": r["fields_detailed"],
+                    "fields_niceonly": r["fields_niceonly"],
+                    "numbers_total": str(unpad(r["numbers_total"])),
+                    "numbers_per_sec": r["numbers_per_sec"],
+                    "downgrades": r["downgrades"],
+                    "restores": r["restores"],
+                    "faults": r["faults"],
+                    "spool_depth": r["spool_depth"],
+                }
+            )
+        return out
+
+    def get_fleet_claim_stats(self, slowest_limit: int = 10) -> dict:
+        """Claim-side fleet health: active leases, expired-but-unsubmitted
+        claims (lost work the expiry predicate will hand out again), total
+        submissions, and the longest-running in-flight claims."""
+        cutoff = self.claim_expiry_cutoff()
+        now = now_utc()
+        with self._read_conn() as conn:
+            active = conn.execute(
+                "SELECT COUNT(*) FROM fields WHERE last_claim_time >= ?",
+                (ts(cutoff),),
+            ).fetchone()[0]
+            expired = conn.execute(
+                "SELECT COUNT(*) FROM claims c"
+                " LEFT JOIN submissions s ON s.claim_id = c.id"
+                " WHERE s.id IS NULL AND c.claim_time < ?",
+                (ts(cutoff),),
+            ).fetchone()[0]
+            submissions = conn.execute(
+                "SELECT COUNT(*) FROM submissions"
+            ).fetchone()[0]
+            slow_rows = conn.execute(
+                "SELECT c.id AS claim_id, f.base_id AS base, c.claim_time,"
+                " c.search_mode, c.user_ip"
+                " FROM claims c JOIN fields f ON f.id = c.field_id"
+                " LEFT JOIN submissions s ON s.claim_id = c.id"
+                " WHERE s.id IS NULL AND f.last_claim_time >= ?"
+                " ORDER BY c.claim_time ASC LIMIT ?",
+                (ts(cutoff), slowest_limit),
+            ).fetchall()
+        slowest = [
+            {
+                "claim_id": r["claim_id"],
+                "base": r["base"],
+                "mode": r["search_mode"],
+                "user_ip": r["user_ip"],
+                "in_flight_secs": round(
+                    max(
+                        0.0,
+                        (now - parse_ts(r["claim_time"])).total_seconds(),
+                    ),
+                    1,
+                ),
+            }
+            for r in slow_rows
+        ]
+        return {
+            "claims_active": active,
+            "claims_expired_unsubmitted": expired,
+            "submissions_total": submissions,
+            "slowest_in_flight": slowest,
+        }
+
+    def get_recent_field_elapsed(self, limit: int = 200) -> list[float]:
+        """elapsed_secs of the most recent submissions (for the fleet p50/p95
+        field-latency gauges)."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT elapsed_secs FROM submissions ORDER BY id DESC"
+                " LIMIT ?",
+                (int(limit),),
+            ).fetchall()
+        return [float(r["elapsed_secs"]) for r in rows]
+
     # -- analytics (dashboard REST surface; reference serves these via
     # PostgREST views over the same tables, web/index.html:203-276) ---------
 

@@ -114,3 +114,29 @@ CREATE INDEX IF NOT EXISTS idx_cache_rate_daily_mode_date
     ON cache_search_rate_daily(search_mode, date);
 CREATE INDEX IF NOT EXISTS idx_cache_leaderboard_mode
     ON cache_search_leaderboard(search_mode, total_range DESC);
+
+-- Fleet telemetry: one row per running client process, upserted from the
+-- POST /telemetry heartbeat and from the snapshot piggybacked on each
+-- submission. Aggregated into the /status fleet block and re-exported as
+-- nice_fleet_* gauges. client_id is user@host/pid (process-stable).
+CREATE TABLE IF NOT EXISTS client_telemetry (
+    client_id       TEXT PRIMARY KEY,
+    username        TEXT NOT NULL DEFAULT '',
+    user_ip         TEXT NOT NULL DEFAULT '',
+    client_version  TEXT NOT NULL DEFAULT '',
+    backend         TEXT NOT NULL DEFAULT '',
+    first_seen      TEXT NOT NULL,                 -- ISO-8601 UTC
+    last_seen       TEXT NOT NULL,                 -- ISO-8601 UTC
+    fields_detailed INTEGER NOT NULL DEFAULT 0,
+    fields_niceonly INTEGER NOT NULL DEFAULT 0,
+    numbers_total   TEXT NOT NULL DEFAULT '0',     -- padded u128 decimal
+    numbers_per_sec REAL NOT NULL DEFAULT 0,
+    downgrades      INTEGER NOT NULL DEFAULT 0,
+    restores        INTEGER NOT NULL DEFAULT 0,
+    faults          INTEGER NOT NULL DEFAULT 0,
+    spool_depth     INTEGER NOT NULL DEFAULT 0,
+    snapshot        TEXT NOT NULL DEFAULT '{}'     -- full JSON snapshot
+);
+
+CREATE INDEX IF NOT EXISTS idx_client_telemetry_last_seen
+    ON client_telemetry(last_seen);

@@ -1,12 +1,20 @@
 #!/usr/bin/env python
-"""CI telemetry smoke: run a tiny instrumented field search and verify the
-pipeline metrics and trace spans actually come out the other end.
+"""CI telemetry + fleet smoke: run a tiny instrumented field search and verify
+the pipeline metrics and trace spans come out the other end, then run a live
+server with two clients and verify the fleet observability surfaces.
 
-Runs a small detailed field on the scalar and jax backends with
-NICE_TPU_TRACE pointed at a temp file, then greps the rendered /metrics text
-for the engine series names and the trace file for span events. Exits
-nonzero (with a diff of what's missing) if any expected signal is absent —
-catching the failure mode where a refactor silently disconnects the
+Part 1 (single-process engine telemetry): a small detailed field on the jax
+backend with NICE_TPU_TRACE pointed at a temp file; greps the rendered
+/metrics text for the engine series names and the trace file for span events.
+
+Part 2 (fleet): an in-process API server + two simulated clients, each doing
+a real claim -> scan -> submit cycle inside its claim-derived trace context.
+Verifies the distributed-tracing acceptance path (client AND server spans for
+one field share a single trace_id), that /status's fleet block reports both
+clients, and that a SIGUSR2 flight-recorder dump is valid JSON.
+
+Exits nonzero (with a diff of what's missing) if any expected signal is
+absent — catching the failure mode where a refactor silently disconnects the
 instrumentation while the tests that merely import obs still pass.
 """
 
@@ -14,8 +22,12 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import tempfile
+import threading
+import time
+import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -32,16 +44,14 @@ EXPECTED_SERIES = [
     "nice_backend_init_seconds",
     "nice_client_request_seconds",
     "nice_trace_span_seconds",
+    "nice_fleet_clients",
+    "nice_flight_events_total",
 ]
 
 EXPECTED_SPANS = ["engine.detailed"]
 
 
-def main() -> int:
-    trace_path = os.path.join(tempfile.mkdtemp(prefix="nice-obs-"), "trace.jsonl")
-    os.environ["NICE_TPU_TRACE"] = trace_path
-    os.environ.setdefault("NICE_TPU_SHARD", "0")  # single-chip engine path
-
+def _engine_smoke(trace_path: str, failures: list) -> None:
     from nice_tpu import obs
     from nice_tpu.core.types import FieldSize
     from nice_tpu.obs.series import ENGINE_NUMBERS
@@ -51,10 +61,8 @@ def main() -> int:
     want = scalar.process_range_detailed(rng, 10)
     got = engine.process_range_detailed(rng, 10, backend="jax", batch_size=256)
     if got != want:
-        print("FAIL: instrumented jax run diverged from scalar", file=sys.stderr)
-        return 1
-
-    failures = []
+        failures.append("engine: instrumented jax run diverged from scalar")
+        return
 
     text = obs.render()
     for name in EXPECTED_SERIES:
@@ -72,10 +80,127 @@ def main() -> int:
     names = {e.get("name") for e in events}
     for span in EXPECTED_SPANS:
         if span not in names:
-            failures.append(f"trace: no span events for {span!r} (saw {sorted(names)})")
+            failures.append(
+                f"trace: no span events for {span!r} (saw {sorted(names)})"
+            )
     for e in events:
         if e.get("event") == "end" and "wall_secs" not in e:
             failures.append(f"trace: end event without wall_secs: {e}")
+
+
+def _run_client(base_url: str, username: str) -> int:
+    """One simulated fleet client: claim -> scan -> submit with telemetry
+    piggybacked and a heartbeat, all inside the claim's trace context.
+    Returns the claim id."""
+    from nice_tpu import obs
+    from nice_tpu.client import api_client
+    from nice_tpu.client.main import compile_results, process_field
+    from nice_tpu.core.types import SearchMode
+    from nice_tpu.obs import telemetry
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, username, max_retries=0
+    )
+    with obs.trace_context(obs.claim_trace_id(data.claim_id)):
+        obs.trace_event("client.claim", claim=data.claim_id, base=data.base)
+        results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+        submission = compile_results(
+            data, results, SearchMode.DETAILED, username
+        )
+        submission.telemetry = telemetry.snapshot(
+            username=username, backend="scalar"
+        )
+        api_client.submit_field_to_server(base_url, submission, max_retries=0)
+    api_client.post_telemetry(
+        base_url, telemetry.snapshot(username=username, backend="scalar")
+    )
+    return data.claim_id
+
+
+def _fleet_smoke(trace_path: str, flight_dir: str, failures: list) -> None:
+    from nice_tpu import obs
+    from nice_tpu.obs import telemetry
+    from nice_tpu.server import app as server_app
+    from nice_tpu.server.db import Db
+
+    db_path = os.path.join(tempfile.mkdtemp(prefix="nice-fleet-"), "smoke.db")
+    db = Db(db_path)
+    db.seed_base(10, field_size=20)  # [47,100) -> 3 fields
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        claim_ids = [
+            _run_client(base_url, "smoke-a"),
+            _run_client(base_url, "smoke-b"),
+        ]
+
+        # Acceptance: one field's spans on BOTH sides share a single
+        # trace_id covering claim -> scan -> submit.
+        with open(trace_path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+        tid = obs.claim_trace_id(claim_ids[0])
+        by_side = {"client.submit": 0, "server.submit": 0,
+                   "client.claim": 0, "engine.scalar": 0}
+        for e in events:
+            if e.get("trace_id") == tid and e.get("name") in by_side:
+                by_side[e["name"]] += 1
+        for name, n in by_side.items():
+            if not n:
+                failures.append(
+                    f"fleet trace: no {name!r} events with trace_id {tid}"
+                )
+
+        # /status fleet block reports both clients.
+        with urllib.request.urlopen(f"{base_url}/status", timeout=10) as r:
+            fleet = json.loads(r.read())["fleet"]
+        ids = {c["client_id"] for c in fleet["clients"]}
+        for user in ("smoke-a", "smoke-b"):
+            if telemetry.client_id(user) not in ids:
+                failures.append(
+                    f"fleet block: client {user!r} missing (saw {sorted(ids)})"
+                )
+        if fleet["submissions_total"] < 2:
+            failures.append(
+                f"fleet block: expected >=2 submissions, "
+                f"saw {fleet['submissions_total']}"
+            )
+
+        # SIGUSR2 dumps the flight ring as valid JSON.
+        if hasattr(signal, "SIGUSR2"):
+            obs.flight.install()
+            os.kill(os.getpid(), signal.SIGUSR2)
+            dump = os.path.join(
+                flight_dir, f"nice-flight-{os.getpid()}-sigusr2.json"
+            )
+            deadline = time.monotonic() + 5.0
+            while not os.path.exists(dump) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if not os.path.exists(dump):
+                failures.append(f"flight: no SIGUSR2 dump at {dump}")
+            else:
+                try:
+                    payload = json.loads(open(dump).read())
+                    if payload["reason"] != "sigusr2" or not payload["events"]:
+                        failures.append(f"flight: malformed dump {payload}")
+                except (ValueError, KeyError) as e:
+                    failures.append(f"flight: SIGUSR2 dump not valid JSON: {e}")
+    finally:
+        srv.shutdown()
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="nice-obs-")
+    trace_path = os.path.join(tmp, "trace.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+    os.environ["NICE_TPU_TRACE"] = trace_path
+    os.environ["NICE_TPU_FLIGHT_DIR"] = flight_dir
+    os.environ.setdefault("NICE_TPU_SHARD", "0")  # single-chip engine path
+
+    failures: list = []
+    _engine_smoke(trace_path, failures)
+    _fleet_smoke(trace_path, flight_dir, failures)
 
     if failures:
         print("telemetry smoke FAILED:", file=sys.stderr)
@@ -85,7 +210,7 @@ def main() -> int:
 
     print(
         f"telemetry smoke OK: {len(EXPECTED_SERIES)} series present, "
-        f"{len(events)} trace events in {trace_path}"
+        f"fleet block reported 2 clients, trace sink at {trace_path}"
     )
     return 0
 

@@ -423,7 +423,13 @@ def test_client_resume_single_iteration(tmp_path):
     assert len(submitted) == 1
     ref = scalar.process_range_detailed(RANGE, BASE)
     expect = compile_results(data, ref, SearchMode.DETAILED, "t")
-    assert json.dumps(submitted[0].to_json(), sort_keys=True) == json.dumps(
+    got = submitted[0].to_json()
+    # The client piggybacks a fleet-telemetry snapshot on every submission;
+    # it carries wall-clock fields, so compare it structurally and the rest
+    # of the payload exactly.
+    tele = got.pop("telemetry", None)
+    assert tele is not None and tele["username"] == "t"
+    assert json.dumps(got, sort_keys=True) == json.dumps(
         expect.to_json(), sort_keys=True
     )
     assert not os.path.exists(ck.path)  # retired after the confirmed submit

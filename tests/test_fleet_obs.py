@@ -1,0 +1,349 @@
+"""Fleet observability tests: distributed per-field tracing across
+client -> server -> engine, telemetry aggregation (POST /telemetry + the
+/status fleet block), the crash flight recorder (ring semantics, dumps,
+SIGUSR2, quarantine), and the local metrics server's fleet surfaces."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nice_tpu import obs
+from nice_tpu.client import api_client
+from nice_tpu.client.main import compile_results, process_field
+from nice_tpu.core.types import SearchMode
+from nice_tpu.obs import flight as obs_flight
+from nice_tpu.obs import series
+from nice_tpu.obs import telemetry as obs_telemetry
+from nice_tpu.server import app as server_app
+from nice_tpu.server.db import Db
+
+
+@pytest.fixture()
+def server(tmp_path):
+    db_path = str(tmp_path / "fleet-test.db")
+    db = Db(db_path)
+    db.seed_base(10, field_size=20)  # [47,100) -> 3 fields
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base_url, db_path
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+# --- trace id plumbing -----------------------------------------------------
+
+
+def test_claim_trace_id_is_deterministic_and_wellformed():
+    a = obs.claim_trace_id(42)
+    assert a == obs.claim_trace_id(42)  # client and server derive the same id
+    assert a != obs.claim_trace_id(43)
+    assert len(a) == 32 and int(a, 16) >= 0
+
+
+def test_traceparent_roundtrip_and_malformed_rejection():
+    tid = obs.claim_trace_id(7)
+    header = obs.make_traceparent(tid)
+    assert obs.parse_traceparent(header) == tid
+    for bad in (None, "", "garbage", "00-short-beef-01",
+                "00-" + "g" * 32 + "-" + "0" * 16 + "-01"):
+        assert obs.parse_traceparent(bad) is None
+
+
+def test_trace_context_is_thread_local_and_restores():
+    assert obs.current_trace_id() is None
+    with obs.trace_context("a" * 32):
+        assert obs.current_trace_id() == "a" * 32
+        assert obs.parse_traceparent(obs.current_traceparent()) == "a" * 32
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(obs.current_trace_id()))
+        t.start()
+        t.join()
+        assert seen == [None]  # context never leaks across threads
+    assert obs.current_trace_id() is None
+    assert obs.current_traceparent() is None
+
+
+def test_one_trace_covers_claim_scan_submit(server, tmp_path, monkeypatch):
+    """The acceptance path: one field's lifecycle yields client, engine, and
+    server spans that all share the claim-derived trace id."""
+    base_url, _ = server
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("NICE_TPU_TRACE", str(sink))
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "tracer", max_retries=0
+    )
+    tid = obs.claim_trace_id(data.claim_id)
+    with obs.trace_context(tid):
+        obs.trace_event("client.claim", claim=data.claim_id, base=data.base)
+        results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+        submission = compile_results(
+            data, results, SearchMode.DETAILED, "tracer"
+        )
+        api_client.submit_field_to_server(base_url, submission, max_retries=0)
+    time.sleep(0.2)  # the server handler span flushes from its own thread
+
+    events = [json.loads(line) for line in sink.read_text().splitlines()]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+
+    # client side: claim event, scan span, submit span
+    assert any(e.get("trace_id") == tid for e in by_name["client.claim"])
+    assert any(
+        e.get("trace_id") == tid for e in by_name["client.process_field"]
+    )
+    assert any(e.get("trace_id") == tid for e in by_name["client.submit"])
+    # engine side: the scan span inherits the ambient context (scalar
+    # backend -> the host-scan span; device backends emit engine.detailed)
+    assert any(e.get("trace_id") == tid for e in by_name["engine.scalar"])
+    # server side: the handler continued the trace from the traceparent header
+    assert any(e.get("trace_id") == tid for e in by_name["server.submit"])
+    # span ids are present so the tree reconstructs exactly
+    ends = [e for e in by_name["client.submit"] if e["event"] == "end"]
+    assert ends and ends[0]["span_id"]
+
+
+# --- telemetry aggregation -------------------------------------------------
+
+
+def _snap(client_id, backend="jax", numbers=1000, rate=50.0, spool=0):
+    return {
+        "v": obs_telemetry.SNAPSHOT_VERSION,
+        "client_id": client_id,
+        "username": client_id.split("@")[0],
+        "client_version": "test",
+        "backend": backend,
+        "ts": time.time(),
+        "numbers": numbers,
+        "numbers_per_sec": rate,
+        "fields": {"detailed": 2, "niceonly": 1},
+        "downgrades": {"pallas->jnp": 1},
+        "downgrades_total": 1,
+        "restores": 2,
+        "faults": 3,
+        "spool_depth": spool,
+    }
+
+
+def test_telemetry_heartbeat_feeds_fleet_block(server):
+    base_url, _ = server
+    api_client.post_telemetry(
+        base_url, _snap("alice@h1/1", backend="jax", numbers=1000, rate=40.0)
+    )
+    api_client.post_telemetry(
+        base_url, _snap("bob@h2/2", backend="tpu", numbers=500, rate=60.0,
+                        spool=2)
+    )
+
+    fleet = _get(f"{base_url}/status")["fleet"]
+    assert fleet["client_count"] == 2
+    ids = {c["client_id"] for c in fleet["clients"]}
+    assert ids == {"alice@h1/1", "bob@h2/2"}
+    assert fleet["backends"] == {"jax": 1, "tpu": 1}
+    assert fleet["numbers_total"] == "1500"
+    assert fleet["numbers_per_sec"] == pytest.approx(100.0)
+    assert fleet["fields"] == {"detailed": 4, "niceonly": 2}
+    assert fleet["downgrades"] == 2
+    assert fleet["checkpoint_restores"] == 4
+    assert fleet["spool_depth"] == 2
+    for key in ("claims_active", "claims_expired_unsubmitted",
+                "submissions_total", "slowest_in_flight", "requests",
+                "error_responses", "field_seconds_p50", "field_seconds_p95"):
+        assert key in fleet
+
+    # building the block refreshed the fleet gauges: /metrics agrees
+    with urllib.request.urlopen(f"{base_url}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "nice_fleet_clients 2" in text
+    assert 'nice_fleet_fields_total{mode="detailed"} 4' in text
+    assert "nice_fleet_numbers_per_sec 100" in text
+    assert 'nice_server_telemetry_reports_total{source="heartbeat"} 2' in text
+
+
+def test_telemetry_heartbeat_rejects_garbage(server):
+    base_url, _ = server
+    with pytest.raises(api_client.ApiError) as err:
+        api_client.post_telemetry(base_url, {"nope": 1}, max_retries=0)
+    assert "400" in str(err.value)
+
+
+def test_telemetry_upsert_is_one_row_per_client(server):
+    base_url, db_path = server
+    for n in (100, 250):  # same client reporting twice
+        api_client.post_telemetry(base_url, _snap("carol@h/9", numbers=n))
+    db = Db(db_path)
+    rows = db.get_client_telemetry()
+    db.close()
+    carol = [r for r in rows if r["client_id"] == "carol@h/9"]
+    assert len(carol) == 1
+    assert carol[0]["numbers_total"] == "250"  # later report wins
+    assert carol[0]["first_seen"] <= carol[0]["last_seen"]
+
+
+def test_submission_piggybacks_telemetry(server, monkeypatch):
+    base_url, _ = server
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "piggy", max_retries=0
+    )
+    results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+    submission = compile_results(data, results, SearchMode.DETAILED, "piggy")
+    # Telemetry is attached AFTER compile_results stamped submit_id, so the
+    # snapshot never perturbs the exactly-once content hash.
+    submission.telemetry = obs_telemetry.snapshot(
+        username="piggy", backend="scalar"
+    )
+    api_client.submit_field_to_server(base_url, submission, max_retries=0)
+
+    fleet = _get(f"{base_url}/status")["fleet"]
+    ids = {c["client_id"] for c in fleet["clients"]}
+    assert obs_telemetry.client_id("piggy") in ids
+    assert fleet["submissions_total"] >= 1
+    # the submission landed its elapsed-seconds sample for the percentiles
+    assert fleet["field_seconds_p95"] >= 0.0
+
+
+def test_snapshot_wire_format_tracks_registry():
+    snap = obs_telemetry.snapshot(username="u", backend="jnp", spool_depth=3)
+    assert snap["v"] == obs_telemetry.SNAPSHOT_VERSION
+    assert snap["client_id"].startswith("u@")
+    assert snap["client_id"].endswith(f"/{os.getpid()}")
+    assert snap["backend"] == "jnp"
+    assert snap["spool_depth"] == 3
+    assert snap["numbers"] == int(sum(series.CLIENT_NUMBERS.values().values()))
+    json.dumps(snap)  # must be JSON-safe as-is
+
+
+# --- flight recorder -------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_ordered():
+    fr = obs_flight.FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("retry", attempt=i)
+    events = fr.snapshot()
+    assert len(events) == 4  # bounded: oldest two evicted
+    assert [e["attempt"] for e in events] == [2, 3, 4, 5]  # oldest first
+    assert [e["seq"] for e in events] == [3, 4, 5, 6]
+    assert fr.total_recorded() == 6
+    assert all(e["kind"] == "retry" and e["ts"] > 0 for e in events)
+
+
+def test_flight_dump_atomic_valid_json_and_overwrites(tmp_path, monkeypatch):
+    monkeypatch.setenv("NICE_TPU_FLIGHT_DIR", str(tmp_path))
+    fr = obs_flight.FlightRecorder(capacity=8)
+    fr.record("fault", site="http.submit", action="500")
+    path = fr.dump(reason="manual")
+    assert path is not None and os.path.basename(path) == (
+        f"nice-flight-{os.getpid()}-manual.json"
+    )
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "manual"
+    assert payload["pid"] == os.getpid()
+    assert payload["events"][-1]["site"] == "http.submit"
+    # same reason overwrites: a crash loop cannot fill the disk
+    fr.record("fault", site="http.submit", action="conn_error")
+    assert fr.dump(reason="manual") == path
+    assert json.loads(open(path).read())["events"][-1]["action"] == "conn_error"
+    assert len(list(tmp_path.glob("nice-flight-*"))) == 1
+
+
+@pytest.mark.skipif(
+    not hasattr(signal, "SIGUSR2"), reason="platform has no SIGUSR2"
+)
+def test_sigusr2_dumps_live_ring(tmp_path, monkeypatch):
+    monkeypatch.setenv("NICE_TPU_FLIGHT_DIR", str(tmp_path))
+    obs_flight.install()
+    obs_flight.record("telemetry", note="pre-signal breadcrumb")
+    os.kill(os.getpid(), signal.SIGUSR2)
+    path = tmp_path / f"nice-flight-{os.getpid()}-sigusr2.json"
+    deadline = time.monotonic() + 5.0
+    while not path.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)  # handlers run at the next bytecode boundary
+    assert path.exists()
+    payload = json.loads(path.read_text())
+    assert payload["reason"] == "sigusr2"
+    assert any(
+        e.get("note") == "pre-signal breadcrumb" for e in payload["events"]
+    )
+
+
+def test_spool_quarantine_dumps_ring(tmp_path, monkeypatch):
+    from nice_tpu.faults.spool import SubmissionSpool
+
+    monkeypatch.setenv("NICE_TPU_FLIGHT_DIR", str(tmp_path / "dumps"))
+    spool = SubmissionSpool(str(tmp_path / "spool"))
+    bad = tmp_path / "spool" / "corrupt.json"
+    bad.write_text("{ not json")
+    counts = spool.replay("http://127.0.0.1:9")  # api never reached
+    assert counts["rejected"] == 1
+    assert (tmp_path / "spool" / "corrupt.json.rejected").exists()
+    dump = tmp_path / "dumps" / f"nice-flight-{os.getpid()}-quarantine.json"
+    assert dump.exists()
+    payload = json.loads(dump.read_text())
+    assert payload["events"][-1]["kind"] == "quarantine"
+
+
+def test_debug_flight_on_api_server(server):
+    base_url, _ = server
+    obs_flight.record("telemetry", note="api-ring-probe")
+    body = _get(f"{base_url}/debug/flight")
+    assert body["pid"] == os.getpid()
+    assert body["capacity"] >= 16
+    assert body["total_recorded"] >= 1
+    assert any(e.get("note") == "api-ring-probe" for e in body["events"])
+
+
+# --- local metrics server (serve.py satellites) ----------------------------
+
+
+def test_metrics_server_flight_endpoint_404_and_bound_port():
+    srv = obs.serve_metrics(0)
+    port = srv.server_address[1]
+    try:
+        assert series.METRICS_BOUND_PORT.value() == port
+        obs_flight.record("telemetry", note="local-ring-probe")
+        body = _get(f"http://127.0.0.1:{port}/debug/flight")
+        assert any(
+            e.get("note") == "local-ring-probe" for e in body["events"]
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5
+            )
+        assert err.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# --- trace sink rotation ---------------------------------------------------
+
+
+def test_trace_sink_rotates_at_size_cap(tmp_path, monkeypatch):
+    sink = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("NICE_TPU_TRACE", str(sink))
+    monkeypatch.setenv("NICE_TPU_TRACE_MAX_BYTES", "400")
+    for i in range(40):
+        obs.trace_event("rotation-probe", i=i)
+    backup = tmp_path / "trace.jsonl.1"
+    assert backup.exists()  # rotated at the cap, one backup kept
+    assert sink.exists() and sink.stat().st_size <= 400
+    # every line in both files is still valid JSON (no torn rotation)
+    for p in (sink, backup):
+        for line in p.read_text().splitlines():
+            json.loads(line)

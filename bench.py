@@ -18,10 +18,16 @@ The record is designed to be UN-LOSABLE under a driver wall-clock kill:
   boot-relative, so the value stays comparable across execve). A mode whose
   conservative cost estimate exceeds the remaining budget is skipped with an
   explicit {"skipped": "budget"} line instead of the process dying mid-mode.
-- Every mode additionally runs under a hard wall cap in a worker thread; a
-  mode that exceeds its cap is recorded as an error line, the (possibly
-  wedged) device is not handed the remaining modes ({"skipped":
-  "timeout-wedge"}), and the final headline line is still printed.
+- Every mode additionally runs under a PER-CASE wall budget in a worker
+  thread, clipped so the cases still queued behind it keep their reserved
+  share of the remaining budget (one slow case can no longer starve the
+  suite into the driver's rc=124 kill — BENCH r04). A case that blows its
+  budget but finishes within a short grace window is recorded with its real
+  numbers and over_budget=true; only a worker still running after the grace
+  is treated as wedged — recorded as an error line, the (possibly wedged)
+  device is not handed the remaining modes ({"skipped": "timeout-wedge"}),
+  and the final headline line is still printed. Every line (skips included)
+  carries case_elapsed_secs, and executed cases case_budget_secs.
 - TPU init is guarded with SHORT, budget-aware attempt timeouts (60/90/120 s,
   clamped to the remaining budget): a transient backend failure (the axon
   tunnel is occasionally unavailable) re-execs this process so jax's cached
@@ -115,6 +121,14 @@ _CAP_SECS = {
     ("massive", "niceonly"): 330.0,
 }
 _CAP_DEFAULT = 150.0
+
+# Grace window after a case blows its per-case budget: a worker still making
+# progress gets this long to finish and be recorded with over_budget=true
+# instead of being discarded as wedged. (BENCH r04: one slow case rode the
+# whole process into the driver's rc=124 kill, starving every later case of
+# its record — the per-case budget + grace turns that into one over-budget
+# line plus a full suite.)
+_CASE_GRACE_SECS = 15.0
 
 # Default suite: the HEADLINE (detailed extra-large) first so its provisional
 # line exists from the first seconds of the run; cheap modes next; massive
@@ -408,11 +422,16 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
 def _run_mode_capped(
     mode: str, kind: str, batch_size: int, n_chips: int, cap: float
 ) -> tuple[dict, bool]:
-    """Run one mode under a hard wall cap in a worker thread.
+    """Run one mode under a per-case wall budget in a worker thread.
 
-    Returns (line, wedged): wedged=True means the worker blew the cap and is
-    still running (almost certainly blocked on the device tunnel) — the
-    device must not be handed further work this process."""
+    A worker that blows the budget gets a short grace join: if it finishes
+    inside _CASE_GRACE_SECS its real line is recorded with over_budget=true
+    (slow, but the numbers are good and later cases still run). Only a worker
+    still running after the grace is treated as wedged.
+
+    Returns (line, wedged): wedged=True means the worker is still running
+    (almost certainly blocked on the device tunnel) — the device must not be
+    handed further work this process."""
     box: dict = {}
 
     def work():
@@ -425,16 +444,27 @@ def _run_mode_capped(
     t.start()
     t.join(cap)
     metric = f"numbers/sec/chip {kind} ({mode})"
+    over_budget = False
     if t.is_alive():
-        return (
-            _error_line(
-                metric, f"mode exceeded its {cap:.0f}s wall cap (wedged?)"
-            ),
-            True,
-        )
+        _phase(f"mode.{kind}.{mode}", "over-budget", cap_secs=cap,
+               grace_secs=_CASE_GRACE_SECS)
+        t.join(_CASE_GRACE_SECS)
+        if t.is_alive():
+            return (
+                _error_line(
+                    metric,
+                    f"mode exceeded its {cap:.0f}s case budget plus "
+                    f"{_CASE_GRACE_SECS:.0f}s grace (wedged?)",
+                ),
+                True,
+            )
+        over_budget = True
     if "exc" in box:
         return _error_line(metric, repr(box["exc"])), False
-    return box["line"], False
+    line = box["line"]
+    if over_budget:
+        line["over_budget"] = True
+    return line, False
 
 
 def _parse_suite(raw: str) -> tuple:
@@ -492,8 +522,10 @@ def main() -> int:
     suite_spans0 = _span_sums()
     _phase("suite", "begin", modes=[f"{k}/{m}" for m, k in suite],
            n_chips=n_chips, backend=jax.default_backend())
-    for mode, kind in suite:
+    for idx, (mode, kind) in enumerate(suite):
         metric = f"numbers/sec/chip {kind} ({mode})"
+        t_case = time.monotonic()
+        case_budget = None
         if wedged:
             line = dict(_error_line(metric, ""), skipped="timeout-wedge")
             del line["error"]
@@ -513,14 +545,25 @@ def main() -> int:
             )
             batch = int(os.environ.get("NICE_BENCH_BATCH", default_batch))
             cap = _CAP_SECS.get((mode, kind), _CAP_DEFAULT)
+            # Reserve wall for the cases still queued behind this one (at
+            # their estimate, capped) so one slow case is budget-clipped and
+            # recorded over_budget instead of starving the rest of the suite
+            # into the driver's kill (BENCH r04: rc=124, one line).
+            reserve = sum(
+                min(_EST_SECS.get(c, _EST_DEFAULT),
+                    _CAP_SECS.get(c, _CAP_DEFAULT))
+                for c in suite[idx + 1:]
+            )
             if (mode, kind) == HEADLINE:
                 # The headline always gets a chance to run, but never more
                 # wall than would erase the final print.
                 cap = max(30.0, min(cap, remaining() - 10.0))
             else:
-                cap = max(10.0, min(cap, remaining() - 15.0))
+                cap = max(10.0, min(cap, remaining() - 15.0,
+                                    remaining() - reserve - 10.0))
+            case_budget = cap
             _phase(f"mode.{kind}.{mode}", "begin", batch=batch,
-                   cap_secs=cap)
+                   cap_secs=round(cap, 1), reserved_secs=round(reserve, 1))
             spans_before = _span_sums()
             line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
             mode_spans = _span_delta(spans_before, _span_sums())
@@ -531,10 +574,17 @@ def main() -> int:
                 "error" if ("error" in line or wedged) else "end",
                 **{
                     k: line[k]
-                    for k in ("value", "elapsed_secs", "error")
+                    for k in ("value", "elapsed_secs", "error",
+                              "over_budget")
                     if k in line
                 },
             )
+        # Per-case accounting on EVERY line (skips included): what this case
+        # actually cost and what it was allowed — the committed bench record
+        # carries the whole suite's wall split even when cases were clipped.
+        line["case_elapsed_secs"] = round(time.monotonic() - t_case, 3)
+        if case_budget is not None:
+            line["case_budget_secs"] = round(case_budget, 1)
         results[(mode, kind)] = line
         print(json.dumps(line), flush=True)  # every mode flushes immediately
         if (mode, kind) == HEADLINE:
@@ -550,7 +600,8 @@ def main() -> int:
             for k, v in r.items()
             if k
             in ("value", "vs_baseline", "elapsed_secs", "error", "hits",
-                "skipped")
+                "skipped", "case_elapsed_secs", "case_budget_secs",
+                "over_budget")
         }
         for (mode, kind), r in results.items()
     }

@@ -38,13 +38,16 @@ from nice_tpu.obs.series import CKPT_BYTES, CKPT_REJECTED, CKPT_WRITES
 log = logging.getLogger("nice_tpu.ckpt")
 
 
-def plan_signature(mode: SearchMode, base: int, backend: str, batch_size: int) -> dict:
+def plan_signature(mode: SearchMode, base: int, backend: str,
+                   batch_size: int | None) -> dict:
     """The compatibility fingerprint a snapshot must match to be resumed.
 
     Everything that changes what a batch cursor MEANS (mode, base, backend,
     batch size) plus the jax runtime fingerprint for device backends — a
     snapshot from a different jax build or platform is rejected rather than
-    trusted across an upgrade boundary."""
+    trusted across an upgrade boundary. batch_size None means "autotuned":
+    the cursor is an absolute number position either way, so two autotuned
+    runs match each other even if the tuned batch changed between them."""
     if backend in ("jax", "jnp", "pallas"):
         import jax
 

@@ -96,9 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--batch-size",
-        type=int,
-        default=int(_env("BATCH_SIZE", engine.DEFAULT_BATCH_SIZE)),
-        help="device lanes per dispatch (env NICE_BATCH_SIZE)",
+        type=lambda v: int(v) or None,
+        default=int(_env("BATCH_SIZE", 0)) or None,
+        help="device lanes per dispatch; 0 = resolved by the autotuner "
+        "(tuned winners table, falling back to "
+        f"{engine.DEFAULT_BATCH_SIZE}) (env NICE_BATCH_SIZE)",
     )
     p.add_argument(
         "--threads",
@@ -209,7 +211,7 @@ def _progress_logger(every_secs: float):
 
 
 def process_field(
-    data: DataToClient, mode: SearchMode, backend: str, batch_size: int,
+    data: DataToClient, mode: SearchMode, backend: str, batch_size: int | None,
     progress_secs: float = 0.0, *,
     checkpointer=None, resume=None, checkpoint_secs=None,
 ) -> tuple[FieldResults, float]:

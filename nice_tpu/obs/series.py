@@ -87,6 +87,20 @@ COMPILE_CACHE_EVENTS = metrics.counter(
     labelnames=("layer", "event"),
 )
 
+# --- kernel autotuner (ops/autotune.py) ----------------------------------
+AUTOTUNE_EVENTS = metrics.counter(
+    "nice_autotune_events_total",
+    "Autotuner winners-table traffic: hit (a tuned winner was applied), miss"
+    " (no entry; built-in default used), invalidated (entry dropped because"
+    " its plan signature no longer matches this runtime), env_override (an"
+    " NICE_TPU_* env var took precedence), sweep (a timing sweep ran), store"
+    " (a winner was persisted).",
+    labelnames=("event",),
+)
+for _ev in ("hit", "miss", "invalidated", "env_override", "sweep", "store"):
+    AUTOTUNE_EVENTS.labels(_ev)
+del _ev
+
 # --- backend init (utils/platform.py) -----------------------------------
 BACKEND_INIT_SECONDS = metrics.histogram(
     "nice_backend_init_seconds",

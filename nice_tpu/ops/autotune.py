@@ -1,0 +1,236 @@
+"""Per-(mode, base, backend) kernel-shape autotuner with a persistent winners
+table.
+
+The measured-sweep discipline of the reference's floor sweep
+(client_process_gpu.rs:85-94) applied to the kernel shape knobs this repo
+previously hand-committed: block_rows (Pallas grid block), batch size (lanes
+per dispatch), and carry_interval (the carry-save resolution interval in
+ops/vector_engine.py). `sweep()` times configurations through the
+scripts/tune_kernels.py harness (--json mode) in a subprocess — real dispatch
+path, compile excluded by warmup — and persists the winner per
+(mode, base, backend) key in a JSON table stored BESIDE the persistent
+compile cache, keyed the same way the executable cache keys its entries.
+
+Every entry carries a plan signature (base, limb widths, jax version +
+platform). A lookup whose stored signature no longer matches the current
+runtime is dropped and counted as `invalidated` — a JAX upgrade or a plan
+change (new limb widths after a base-range fix) silently falls back to
+defaults until re-tuned, never applies stale shapes.
+
+Precedence when the engine resolves a knob (engine.resolve_tuning):
+    1. explicit env var (NICE_TPU_BATCH / NICE_TPU_BLOCK_ROWS /
+       NICE_TPU_CARRY_INTERVAL) — operator pin, counted as env_override
+    2. tuned winner from this table — counted as hit
+    3. built-in default — counted as miss
+
+Traffic lands in nice_autotune_events_total (obs/series.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from pathlib import Path
+
+from nice_tpu.obs.series import AUTOTUNE_EVENTS
+
+# Knob -> operator env-var pin. The same vars steer scripts/tune_kernels.py
+# configs, so the sweep exercises exactly the precedence path it tunes.
+ENV_VARS = {
+    "batch_size": "NICE_TPU_BATCH",
+    "block_rows": "NICE_TPU_BLOCK_ROWS",
+    "carry_interval": "NICE_TPU_CARRY_INTERVAL",
+}
+
+_lock = threading.Lock()
+_cache: dict = {"path": None, "mtime": None, "table": None}
+
+
+def winners_path() -> Path:
+    """Where the winners table lives: NICE_TPU_AUTOTUNE_FILE wins; else
+    beside the persistent compile cache (JAX_COMPILATION_CACHE_DIR); else a
+    per-user cache dir (same fallback family as the compile cache docs)."""
+    p = os.environ.get("NICE_TPU_AUTOTUNE_FILE")
+    if p:
+        return Path(p)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        return Path(cache_dir) / "nice_autotune.json"
+    return Path.home() / ".cache" / "nice_tpu" / "nice_autotune.json"
+
+
+def key(mode: str, base: int, backend: str) -> str:
+    """Winners-table key, spelled like a compile_cache executable key."""
+    return f"{mode}|b{base}|{backend}"
+
+
+def signature(base: int) -> dict:
+    """Invalidation fingerprint: shape-determining plan constants plus the
+    runtime (same runtime spelling as ckpt.manager.plan_signature). Any
+    drift — a JAX upgrade, a different accelerator, a plan change — makes
+    stored winners unusable until a re-tune."""
+    import jax
+
+    from nice_tpu.ops.limbs import get_plan
+
+    plan = get_plan(base)
+    return {
+        "base": base,
+        "limbs": [plan.limbs_n, plan.limbs_sq, plan.limbs_cu],
+        "runtime": f"jax-{jax.__version__}-{jax.default_backend()}",
+    }
+
+
+def reset_for_tests() -> None:
+    """Drop the in-process winners cache (the file is left alone)."""
+    with _lock:
+        _cache.update(path=None, mtime=None, table=None)
+
+
+def _load() -> dict:
+    """Winners table, cached per (path, mtime) so repeated lookups on the
+    dispatch path cost a stat, not a parse."""
+    path = winners_path()
+    try:
+        mtime = path.stat().st_mtime_ns
+    except OSError:
+        return {}
+    with _lock:
+        if _cache["path"] == str(path) and _cache["mtime"] == mtime:
+            return _cache["table"]
+    try:
+        with open(path) as f:
+            table = json.load(f)
+        if not isinstance(table, dict):
+            table = {}
+    except (OSError, ValueError):
+        table = {}
+    with _lock:
+        _cache.update(path=str(path), mtime=mtime, table=table)
+    return table
+
+
+def params(mode: str, base: int, backend: str) -> dict | None:
+    """The tuned winner params for one key, or None. Signature-checked:
+    a stale entry counts as `invalidated` and reads as absent."""
+    entry = _load().get(key(mode, base, backend))
+    if entry is None:
+        return None
+    try:
+        if entry.get("signature") != signature(base):
+            AUTOTUNE_EVENTS.labels("invalidated").inc()
+            return None
+    except Exception:
+        return None  # no valid plan for this base anymore
+    return entry.get("params") or None
+
+
+def choose(mode: str, base: int, backend: str, param: str, default: int) -> int:
+    """One knob under the env > tuned > default precedence (see module doc)."""
+    env = ENV_VARS.get(param)
+    if env:
+        raw = os.environ.get(env)
+        if raw:
+            AUTOTUNE_EVENTS.labels("env_override").inc()
+            return int(raw)
+    tuned = params(mode, base, backend)
+    if tuned is not None and param in tuned:
+        AUTOTUNE_EVENTS.labels("hit").inc()
+        return int(tuned[param])
+    AUTOTUNE_EVENTS.labels("miss").inc()
+    return default
+
+
+def record(mode: str, base: int, backend: str, new_params: dict,
+           throughput: float | None = None, swept: list | None = None) -> Path:
+    """Persist a winner (atomic tmp+rename; concurrent writers last-wins at
+    whole-file granularity, which is fine for a tuning table)."""
+    path = winners_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    table = dict(_load())
+    table[key(mode, base, backend)] = {
+        "params": {k: int(v) for k, v in new_params.items()},
+        "signature": signature(base),
+        "throughput": throughput,
+        "swept": swept or [],
+    }
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    AUTOTUNE_EVENTS.labels("store").inc()
+    reset_for_tests()  # next lookup re-reads the fresh file
+    return path
+
+
+def sweep(mode: str, bench_mode: str, backend: str, *,
+          batch_shifts: list[int], rows: list[int] | None = None,
+          carry: list[int] | None = None, slice_size: int = 1_000_000,
+          timeout: float = 900.0) -> dict | None:
+    """Run the scripts/tune_kernels.py timing harness over the cartesian
+    config grid and persist the best-throughput config as this key's winner.
+
+    The harness runs in a SUBPROCESS (fresh jax, honest compile-cache
+    behavior) with --json; each stdout line is one timed config. Returns the
+    winning params dict, or None if no config produced a timing."""
+    script = Path(__file__).resolve().parent.parent.parent / "scripts" / "tune_kernels.py"
+    cmd = [
+        sys.executable, str(script), "detailed" if mode == "detailed" else "niceonly",
+        "--mode", bench_mode, "--backend", backend, "--json",
+        "--slice", str(slice_size),
+        "--batches", ",".join(str(s) for s in batch_shifts),
+    ]
+    if rows:
+        cmd += ["--sweep-rows", ",".join(str(r) for r in rows)]
+    if carry:
+        cmd += ["--carry", ",".join(str(c) for c in carry)]
+    AUTOTUNE_EVENTS.labels("sweep").inc()
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        cwd=str(script.parent.parent),
+    )
+    results = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("numbers_per_sec"):
+            results.append(rec)
+    if proc.returncode != 0 and not results:
+        raise RuntimeError(
+            f"tune_kernels sweep failed (rc={proc.returncode}): "
+            f"{proc.stderr[-2000:]}"
+        )
+    if not results:
+        return None
+    best = max(results, key=lambda r: r["numbers_per_sec"])
+    new_params = {
+        k: best[k]
+        for k in ("batch_size", "block_rows", "carry_interval")
+        if best.get(k) is not None
+    }
+    record(
+        mode, int(best["base"]), backend, new_params,
+        throughput=float(best["numbers_per_sec"]),
+        swept=[
+            {k: r.get(k) for k in
+             ("batch_size", "block_rows", "carry_interval", "numbers_per_sec")}
+            for r in results
+        ],
+    )
+    return new_params

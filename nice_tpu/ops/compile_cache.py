@@ -79,11 +79,12 @@ def setup() -> None:
         pass
 
 
-def aot(jitted, *args):
+def aot(jitted, *args, **kwargs):
     """AOT-compile a jitted function at example args (ShapeDtypeStructs are
     fine for the dynamic ones). The returned executable takes only the
-    dynamic args — static_argnums are burned in at lowering time."""
-    return jitted.lower(*args).compile()
+    dynamic args — static_argnums AND static keyword args (static_argnames,
+    e.g. the carry-save ``carry_interval``) are burned in at lowering time."""
+    return jitted.lower(*args, **kwargs).compile()
 
 
 def executable(key, build):

@@ -210,7 +210,8 @@ def _pick_backend(plan, batch_size: int, backend: str) -> str:
     if backend == "pallas":
         if not pe.supports_base(plan):
             raise ValueError(
-                f"base {plan.base} exceeds the Pallas stats tile (base+2 > 128)"
+                f"base {plan.base} exceeds the Pallas stats tile "
+                f"(base+2 > {pe._HIST_ROWS_MAX * 128})"
             )
         if batch_size % 128 != 0:
             raise ValueError(f"pallas batch_size must be a multiple of 128, got {batch_size}")
@@ -884,6 +885,38 @@ def _strided_setup(base: int, field_size: int) -> "_StridedSetup | None":
     )
 
 
+def resolve_tuning(mode: str, base: int, backend: str,
+                   batch_size: int | None = None) -> tuple[int, int, int]:
+    """Resolve the kernel-shape knobs for one dispatch: (batch_size,
+    block_rows, carry_interval) under the autotuner's env > tuned > default
+    precedence (ops/autotune.py; NICE_TPU_BATCH / NICE_TPU_BLOCK_ROWS /
+    NICE_TPU_CARRY_INTERVAL pin a knob for one run).
+
+    The table is keyed by the backend string the CALLER requested ("jax" /
+    "pallas" / "jnp") — the same spelling scripts/tune_kernels.py records
+    under — not the _pick_backend resolution; a tuned entry can't leak
+    across accelerators anyway because its signature pins the platform.
+    An explicitly passed batch_size is honored untouched (bench and the
+    tuning harness sweep it themselves); block_rows / carry_interval are
+    always resolved. Host backends (scalar/native) get plain defaults —
+    these knobs don't exist there."""
+    if backend not in ("jax", "jnp", "pallas"):
+        return batch_size or DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0
+    from nice_tpu.ops import autotune
+
+    if batch_size is None:
+        batch_size = autotune.choose(
+            mode, base, backend, "batch_size", DEFAULT_BATCH_SIZE
+        )
+    block_rows = autotune.choose(
+        mode, base, backend, "block_rows", pe.BLOCK_ROWS
+    )
+    carry_interval = autotune.choose(
+        mode, base, backend, "carry_interval", 0
+    )
+    return batch_size, block_rows, carry_interval
+
+
 def _batch_arg_shapes(plan):
     """Example (start_limbs, valid_count) arg shapes for AOT lowering."""
     import jax
@@ -895,55 +928,69 @@ def _batch_arg_shapes(plan):
     )
 
 
-def _detailed_accum_executable(plan, batch_size: int, backend: str):
+def _detailed_accum_executable(plan, batch_size: int, backend: str,
+                               block_rows: int = 0, carry_interval: int = 0):
     """AOT-compiled single-device detailed step with a device-resident
     accumulator: exec(hist_acc i32[base+2], start_limbs, valid) ->
-    (new_acc, near_miss_count). Cached per (plan, batch, backend) so a second
-    field of the same shape never re-lowers (and the persistent cache makes a
-    second *process* skip XLA compilation too)."""
+    (new_acc, near_miss_count). Cached per (plan, batch, backend, shape
+    knobs) so a second field of the same shape never re-lowers (and the
+    persistent cache makes a second *process* skip XLA compilation too).
+    carry_interval is a static argname burned in at lowering; block_rows only
+    shapes the pallas grid (0 = module default)."""
     import jax
     import jax.numpy as jnp
 
     def build():
         acc = jax.ShapeDtypeStruct((plan.base + 2,), jnp.int32)
         if backend == "pallas":
-            br = pe._effective_block_rows(batch_size, pe.BLOCK_ROWS)
-            jitted = pe._detailed_accum_callable(plan, batch_size, br)
+            br = pe._effective_block_rows(batch_size, block_rows or pe.BLOCK_ROWS)
+            jitted = pe._detailed_accum_callable(
+                plan, batch_size, br, carry_interval=carry_interval
+            )
             return compile_cache.aot(jitted, acc, *_batch_arg_shapes(plan))
         return compile_cache.aot(
             ve.detailed_accum_batch, plan, batch_size, acc,
-            *_batch_arg_shapes(plan),
+            *_batch_arg_shapes(plan), carry_interval=carry_interval,
         )
 
     return compile_cache.executable(
-        ("detailed-accum", backend, plan, batch_size), build
+        ("detailed-accum", backend, plan, batch_size, block_rows,
+         carry_interval),
+        build,
     )
 
 
-def _niceonly_dense_executable(plan, batch_size: int):
+def _niceonly_dense_executable(plan, batch_size: int, carry_interval: int = 0):
     """AOT-compiled single-device dense niceonly count step (jnp; the pallas
     niceonly path is strided and never reaches the dense loop)."""
 
     def build():
         return compile_cache.aot(
             ve.niceonly_dense_batch, plan, batch_size,
-            *_batch_arg_shapes(plan),
+            *_batch_arg_shapes(plan), carry_interval=carry_interval,
         )
 
-    return compile_cache.executable(("niceonly-dense", plan, batch_size), build)
+    return compile_cache.executable(
+        ("niceonly-dense", plan, batch_size, carry_interval), build
+    )
 
 
-def warm_detailed(base: int, batch_size: int = DEFAULT_BATCH_SIZE,
+def warm_detailed(base: int, batch_size: int | None = None,
                   backend: str = "jax") -> None:
     """Pre-lower/AOT-compile the exact per-batch executables a detailed field
     of this shape will dispatch (the detailed analog of warm_niceonly).
     Benchmarks call this before the timed region; a client calls it per
     claimed field — after the first call per (base, batch, backend) it is a
     pure executable-cache hit, and with JAX_COMPILATION_CACHE_DIR set a fresh
-    process deserializes instead of recompiling."""
+    process deserializes instead of recompiling. batch_size=None resolves the
+    shape knobs through resolve_tuning exactly like the field dispatch will,
+    so the warm compiles the kernel the field actually runs."""
     if backend in ("scalar", "native"):
         return
     compile_cache.setup()
+    batch_size, block_rows, carry_interval = resolve_tuning(
+        "detailed", base, backend, batch_size
+    )
     plan = get_plan(base)
     backend = _pick_backend(plan, batch_size, backend)
     mesh = _mesh_or_none()
@@ -959,7 +1006,9 @@ def warm_detailed(base: int, batch_size: int = DEFAULT_BATCH_SIZE,
         )
         pmesh.make_sharded_stats_fold(mesh)
     else:
-        _detailed_accum_executable(plan, batch_size, backend)
+        _detailed_accum_executable(
+            plan, batch_size, backend, block_rows, carry_interval
+        )
 
 
 def warm_niceonly(base: int, field_size: int = 0, field_start: int | None = None) -> None:
@@ -1403,7 +1452,7 @@ def process_range_detailed(
     range_: FieldSize,
     base: int,
     backend: str = "jax",
-    batch_size: int = DEFAULT_BATCH_SIZE,
+    batch_size: int | None = None,
     progress=None,
     *,
     checkpoint_cb=None,
@@ -1434,7 +1483,7 @@ def _process_range_detailed(
     range_: FieldSize,
     base: int,
     backend: str = "jax",
-    batch_size: int = DEFAULT_BATCH_SIZE,
+    batch_size: int | None = None,
     progress=None,
     *,
     checkpoint_cb=None,
@@ -1458,7 +1507,14 @@ def _process_range_detailed(
     always matches its cursor. resume: a state previously handed to
     checkpoint_cb; the scan restarts at its cursor with histogram/survivors
     preloaded and slivers NOT recomputed. backend='native' supports neither
-    (checkpoint_cb is ignored; resume raises)."""
+    (checkpoint_cb is ignored; resume raises).
+
+    batch_size=None (the default) resolves batch/block_rows/carry_interval
+    through the autotuner (resolve_tuning: env > tuned winners > defaults);
+    an explicit batch_size pins the batch and still resolves the other two."""
+    batch_size, block_rows, carry_interval = resolve_tuning(
+        "detailed", base, backend, batch_size
+    )
     if backend == "scalar":
         if checkpoint_cb is None and resume is None:
             with obs.span("engine.scalar", base=base, size=range_.size(),
@@ -1539,7 +1595,12 @@ def _process_range_detailed(
         fold_acc = fold_step  # ONE psum per field, on the collector thread
     else:
         lanes = batch_size
-        accum_exec = _detailed_accum_executable(plan, batch_size, backend)
+        # Tuned shape knobs apply on the single-device path; the sharded
+        # step above stays at module defaults (its per-device kernel shape
+        # is owned by parallel/mesh.py).
+        accum_exec = _detailed_accum_executable(
+            plan, batch_size, backend, block_rows, carry_interval
+        )
 
         def new_acc():
             return np.zeros(plan.base + 2, dtype=np.int32)
@@ -1705,7 +1766,7 @@ def process_range_niceonly(
     base: int,
     stride_table=None,
     backend: str = "jax",
-    batch_size: int = DEFAULT_BATCH_SIZE,
+    batch_size: int | None = None,
     progress=None,
     *,
     checkpoint_cb=None,
@@ -1740,7 +1801,7 @@ def _process_range_niceonly(
     base: int,
     stride_table=None,
     backend: str = "jax",
-    batch_size: int = DEFAULT_BATCH_SIZE,
+    batch_size: int | None = None,
     progress=None,
     *,
     checkpoint_cb=None,
@@ -1761,7 +1822,14 @@ def _process_range_niceonly(
     gaps the MSD/stride filters skipped contain no nice numbers by
     construction, so a resume that re-derives the filters (even at a
     different adaptive floor) under any plan with a matching signature finds
-    exactly the remaining set."""
+    exactly the remaining set.
+
+    batch_size=None resolves batch/carry_interval through the autotuner
+    (resolve_tuning); the strided pallas pipeline picks its own shapes and
+    ignores the dense-scan knobs."""
+    batch_size, _block_rows, carry_interval = resolve_tuning(
+        "niceonly", base, backend, batch_size
+    )
     if backend == "scalar":
         if checkpoint_cb is None and resume is None:
             with obs.span("engine.scalar", base=base, size=range_.size(),
@@ -1927,7 +1995,7 @@ def _process_range_niceonly(
         lanes = batch_size * n_dev
     else:
         lanes = batch_size
-        count_exec = _niceonly_dense_executable(plan, batch_size)
+        count_exec = _niceonly_dense_executable(plan, batch_size, carry_interval)
 
     def dispatch(batch_start, valid, core_end):
         if mesh is not None:

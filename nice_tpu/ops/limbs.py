@@ -125,3 +125,24 @@ def ints_to_limbs(xs: list[int], num_limbs: int) -> np.ndarray:
     for row, x in enumerate(xs):
         out[row] = int_to_limbs(x, num_limbs)
     return out
+
+
+def ints_to_limb_arrays(xs: list[int], num_limbs: int) -> list[np.ndarray]:
+    """Pack many ints into the engine's LIMB-MAJOR layout: a list of
+    num_limbs contiguous (len(xs),) u32 arrays, LSW first.
+
+    This is the layout every kernel computes in — one full array (a full
+    (rows, 128) VPU tile inside the Pallas kernels) per limb, so each
+    carry-save partial-product column is a single dense vector op with no
+    per-lane gather. The (rows, limbs) row-major form from ints_to_limbs is
+    only used for host-side packing of descriptor tables."""
+    packed = ints_to_limbs(xs, num_limbs)
+    return [np.ascontiguousarray(packed[:, i]) for i in range(num_limbs)]
+
+
+def limb_arrays_to_ints(limbs: list) -> list[int]:
+    """Inverse of ints_to_limb_arrays (accepts any list of u32 array-likes)."""
+    cols = [np.asarray(l, dtype=np.uint32) for l in limbs]
+    return [
+        limbs_to_int([c[row] for c in cols]) for row in range(len(cols[0]))
+    ]

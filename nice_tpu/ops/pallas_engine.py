@@ -15,9 +15,16 @@ the Mosaic kernel on (rows, 128) VPU blocks. One implementation, two
 compilers, bit-identical results (the cross-backend parity contract the whole
 reference test strategy is built on, SURVEY.md §4).
 
-Output tile layout (row, col):
-  [0, 0:base+2]  histogram of num_uniques (padding lanes counted in bin 0)
-  [1, 0]         near-miss count (detailed) / nice count (niceonly)
+Output tile layout (row, col), with hist_rows = ceil((base+2)/128):
+  [b // 128, b % 128]  histogram bin b of num_uniques, b < base+2 (padding
+                       lanes counted in bin 0)
+  [hist_rows, 0]       near-miss count (detailed) / nice count (niceonly)
+
+The histogram spans as many 128-lane SMEM rows as the base needs, so hi-base
+plans pass supports_base instead of falling back to jnp (the tile stays a few
+hundred bytes of SMEM either way); limb storage is limb-major throughout —
+one (rows, 128) VPU tile per limb — so every carry-save partial-product
+column is a full-tile vector op.
 
 On non-TPU backends the kernels run in interpreter mode automatically, which
 is how the test suite exercises them without hardware (the analog of the
@@ -53,9 +60,21 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Histogram rows in the stats tile. 4 rows (bases up to 510) is far beyond
+# any base with a valid range the scalar oracle can verify in test time; the
+# cap only bounds the unrolled per-bin accumulation in the kernel.
+_HIST_ROWS_MAX = 4
+
+
+def _hist_rows(plan: BasePlan) -> int:
+    """128-lane SMEM rows the histogram needs (bins 0..base+1)."""
+    return -(-(plan.base + 2) // 128)
+
+
 def supports_base(plan: BasePlan) -> bool:
-    """The stats tile keeps the histogram in one 128-lane row."""
-    return plan.base + 2 <= 128
+    """The stats tile spans ceil((base+2)/128) histogram rows (plus one
+    counter row); any base whose histogram fits _HIST_ROWS_MAX rows runs."""
+    return _hist_rows(plan) <= _HIST_ROWS_MAX
 
 
 def _effective_block_rows(batch_size: int, block_rows: int) -> int:
@@ -83,33 +102,39 @@ def _derive_lanes(plan: BasePlan, start_ref, idx, block_rows: int):
     return ve.add_u32(base_limbs, idx.astype(jnp.uint32))
 
 
-def _make_kernel(plan: BasePlan, mode: str, block_rows: int):
-    """mode: "detailed" (histogram + near-miss count) or "niceonly" (count)."""
+def _make_kernel(plan: BasePlan, mode: str, block_rows: int,
+                 carry_interval: int = 0):
+    """mode: "detailed" (histogram + near-miss count) or "niceonly" (count).
+    carry_interval: carry-save resolution interval threaded into
+    ve.num_uniques_lanes (bit-identical results at any value)."""
+    hist_rows = _hist_rows(plan)
 
     def kernel(start_ref, valid_ref, out_ref):
         step = pl.program_id(0)
         lane0 = step * (block_rows * 128)
         idx = _block_iota(block_rows) + lane0
         n = _derive_lanes(plan, start_ref, idx, block_rows)
-        uniques = ve.num_uniques_lanes(plan, n)
+        uniques = ve.num_uniques_lanes(plan, n, carry_interval)
         valid = idx < valid_ref[0]
 
         @pl.when(step == 0)
         def _():
             # Zero the whole tile (SMEM output buffers start undefined).
-            for r in range(2):
+            for r in range(hist_rows + 1):
                 for b in range(128):
                     out_ref[r, b] = 0
 
         if mode == "detailed":
             u = jnp.where(valid, uniques, 0)
             for b in range(plan.base + 2):
-                out_ref[0, b] += jnp.sum((u == b).astype(jnp.int32))
-            out_ref[1, 0] += jnp.sum(
+                out_ref[b // 128, b % 128] += jnp.sum(
+                    (u == b).astype(jnp.int32)
+                )
+            out_ref[hist_rows, 0] += jnp.sum(
                 (valid & (uniques > plan.near_miss_cutoff)).astype(jnp.int32)
             )
         else:
-            out_ref[1, 0] += jnp.sum(
+            out_ref[hist_rows, 0] += jnp.sum(
                 (valid & (uniques == plan.base)).astype(jnp.int32)
             )
 
@@ -117,9 +142,12 @@ def _make_kernel(plan: BasePlan, mode: str, block_rows: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _stats_callable(plan: BasePlan, mode: str, batch_size: int, block_rows: int):
+def _stats_callable(plan: BasePlan, mode: str, batch_size: int,
+                    block_rows: int, carry_interval: int = 0):
     assert batch_size % (block_rows * 128) == 0, (batch_size, block_rows)
     num_blocks = batch_size // (block_rows * 128)
+    hist_rows = _hist_rows(plan)
+    tile_rows = hist_rows + 1  # histogram rows + the counter row
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # start limbs + valid count land in SMEM
         grid=(num_blocks,),
@@ -127,12 +155,12 @@ def _stats_callable(plan: BasePlan, mode: str, batch_size: int, block_rows: int)
         # Stats tile lives in SMEM: Mosaic only allows scalar stores there,
         # and the per-bin counts are scalars by construction.
         out_specs=pl.BlockSpec(
-            (2, 128), lambda step, *_: (0, 0), memory_space=pltpu.SMEM
+            (tile_rows, 128), lambda step, *_: (0, 0), memory_space=pltpu.SMEM
         ),
     )
     call = pl.pallas_call(
-        _make_kernel(plan, mode, block_rows),
-        out_shape=jax.ShapeDtypeStruct((2, 128), jnp.int32),
+        _make_kernel(plan, mode, block_rows, carry_interval),
+        out_shape=jax.ShapeDtypeStruct((tile_rows, 128), jnp.int32),
         grid_spec=grid_spec,
         interpret=_interpret(),
     )
@@ -140,7 +168,7 @@ def _stats_callable(plan: BasePlan, mode: str, batch_size: int, block_rows: int)
     @jax.jit
     def run(start_limbs, valid_count):
         tile = call(start_limbs, jnp.reshape(valid_count, (1,)).astype(jnp.int32))
-        return tile[0], tile[1, 0]
+        return tile[:hist_rows].reshape(-1), tile[hist_rows, 0]
 
     return run
 
@@ -162,19 +190,22 @@ def _timed(kernel: str):
 
 
 def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
-                   block_rows: int = BLOCK_ROWS):
-    """(histogram i32[128] (bins 0..base+1), near_miss_count i32)."""
+                   block_rows: int = BLOCK_ROWS, carry_interval: int = 0):
+    """(histogram i32[128 * hist_rows] (bins 0..base+1), near_miss_count i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
-    run = _stats_callable(plan, "detailed", batch_size, block_rows)
+    run = _stats_callable(plan, "detailed", batch_size, block_rows,
+                          carry_interval)
     with _timed("detailed"):
         return run(start_limbs, valid_count)
 
 
 def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
-                         valid_count, block_rows: int = BLOCK_ROWS):
+                         valid_count, block_rows: int = BLOCK_ROWS,
+                         carry_interval: int = 0):
     """Count of fully nice lanes in a dense range batch (i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
-    run = _stats_callable(plan, "niceonly", batch_size, block_rows)
+    run = _stats_callable(plan, "niceonly", batch_size, block_rows,
+                          carry_interval)
     with _timed("niceonly_dense"):
         return run(start_limbs, valid_count)[1]
 
@@ -376,7 +407,8 @@ def niceonly_strided_batch(plan: BasePlan, spec: StrideSpec, desc: np.ndarray,
 # --------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _uniques_callable(plan: BasePlan, batch_size: int, block_rows: int):
+def _uniques_callable(plan: BasePlan, batch_size: int, block_rows: int,
+                      carry_interval: int = 0):
     assert batch_size % (block_rows * 128) == 0, (batch_size, block_rows)
     num_blocks = batch_size // (block_rows * 128)
 
@@ -384,7 +416,7 @@ def _uniques_callable(plan: BasePlan, batch_size: int, block_rows: int):
         step = pl.program_id(0)
         idx = _block_iota(block_rows) + step * (block_rows * 128)
         n = _derive_lanes(plan, start_ref, idx, block_rows)
-        out_ref[:] = ve.num_uniques_lanes(plan, n)
+        out_ref[:] = ve.num_uniques_lanes(plan, n, carry_interval)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -447,10 +479,12 @@ def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int):
+def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int,
+                             carry_interval: int = 0):
     """Detailed stats kernel folding into a device-resident accumulator
     (donated i32[base+2]); see ve.detailed_accum_batch."""
-    stats_call = _stats_callable(plan, "detailed", batch_size, block_rows)
+    stats_call = _stats_callable(plan, "detailed", batch_size, block_rows,
+                                 carry_interval)
     width = plan.base + 2
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -463,10 +497,12 @@ def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int):
 
 def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
                          start_limbs, valid_count,
-                         block_rows: int = BLOCK_ROWS):
+                         block_rows: int = BLOCK_ROWS,
+                         carry_interval: int = 0):
     """detailed_batch folded into a device-resident histogram accumulator
     (hist_acc i32[base+2], donated); returns (new_acc, near_miss_count)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
-    run = _detailed_accum_callable(plan, batch_size, block_rows)
+    run = _detailed_accum_callable(plan, batch_size, block_rows,
+                                   carry_interval)
     with _timed("detailed"):
         return run(hist_acc, start_limbs, valid_count)

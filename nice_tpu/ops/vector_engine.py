@@ -8,10 +8,20 @@ per limb — so XLA keeps limbs in registers and fuses the whole digit pipeline.
 Pipeline per candidate lane (mirrors reference nice_kernels.cu:420-531, but
 mask-based instead of warp-divergent early exit):
     n = start + iota                      (zero input transfer)
-    sq = n * n, cu = sq * n               (schoolbook 16-bit-half products)
+    sq = n * n, cu = sq * n               (carry-save 16-bit-half products)
     digits via chunked radix extraction   (constant divisors, fixed trip count)
     presence bitmasks -> popcount         -> num_uniques
     histogram via bincount; near-misses extracted on a rare second pass
+
+Multi-limb products are CARRY-SAVE: every 32x32->64 partial product is
+accumulated into independent per-column (sum, wrap-count) u32 pairs — no carry
+chain crosses columns during accumulation, so the partial products of one
+result have no serial dependence on each other — and carries are resolved in
+ONE deferred pass per result (plus optional periodic folds, the tunable
+`carry_interval`). Squaring goes through a dedicated specialization
+(`sqr_limbs`) that computes each off-diagonal a_i*a_j once and accumulates it
+twice, roughly halving the multiply count for n^2 and for the first half of
+n^3 = n^2 * n.
 
 Correctness contract: the processed range must lie inside the base's valid
 range (engine.py enforces; the exact-digit-count theorem holds there).
@@ -60,29 +70,105 @@ def _carry(flag):
     return flag.astype(U32)
 
 
-def mul_limbs(a: list, b: list, out_len: int) -> list:
-    """Schoolbook multiply of LSW-first limb lists, truncated to out_len."""
+def _cs_add(sums: list, wraps: list, k: int, v) -> None:
+    """Carry-save accumulate v into column k: sums[k] += v with the u32 wrap
+    counted in wraps[k] (each wrap is worth 2^32 at column k, i.e. exactly 1
+    at column k+1). No carry chain crosses columns, so accumulations into
+    different columns have no serial dependence."""
+    s = sums[k] + v
+    wraps[k] = wraps[k] + _carry(s < v)
+    sums[k] = s
+
+
+def _cs_fold(sums: list, wraps: list) -> None:
+    """Partial carry resolution: fold each column's wrap count into the next
+    column's sum (itself carry-save, so columns stay independent). Called
+    every `resolve_every` accumulation rows to keep the wrap counters near
+    zero mid-product; the final wrap count (worth 2^32^len, beyond the
+    truncation width) is dropped."""
+    zero = jnp.zeros_like(sums[0])
+    for k in range(1, len(sums)):
+        c = wraps[k - 1]
+        wraps[k - 1] = zero
+        _cs_add(sums, wraps, k, c)
+    wraps[-1] = zero
+
+
+def _cs_resolve(sums: list, wraps: list) -> list:
+    """One deferred carry-resolution pass: the only cross-column dependence
+    chain in the whole product. The carry into column k+1 is column k's wrap
+    count plus at most 1 (from adding the incoming carry), which is far below
+    2^32 — wrap counts are bounded by the number of accumulated terms
+    (<= 2 * limb count + folds)."""
+    out = []
+    carry = jnp.zeros_like(sums[0])
+    for k in range(len(sums)):
+        s = sums[k] + carry
+        wrap = _carry(s < carry)
+        out.append(s)
+        carry = wraps[k] + wrap
+    return out
+
+
+def mul_limbs(a: list, b: list, out_len: int, resolve_every: int = 0) -> list:
+    """Carry-save multiply of LSW-first limb lists, truncated to out_len
+    (result == a*b mod 2^(32*out_len); exact when out_len covers the product).
+
+    Each 32x32->64 partial product lands as independent (lo -> column i+j,
+    hi -> column i+j+1) carry-save accumulations; one _cs_resolve pass per
+    result propagates carries. resolve_every > 0 additionally folds wrap
+    counts back into the sums every that-many rows of a — a tuning knob
+    (shorter live ranges vs extra adds) exposed as the autotuner's
+    carry-resolution interval."""
     zero = jnp.zeros_like(a[0])
-    out = [zero] * out_len
+    sums = [zero] * out_len
+    wraps = [zero] * out_len
     for i, ai in enumerate(a):
         if i >= out_len:
             break
-        carry = zero
         for j, bj in enumerate(b):
             k = i + j
             if k >= out_len:
                 break
             lo, hi = mul32(ai, bj)
-            s1 = out[k] + lo
-            c1 = _carry(s1 < lo)
-            s2 = s1 + carry
-            c2 = _carry(s2 < carry)
-            out[k] = s2
-            # hi + c1 + c2 cannot wrap: the exact column total fits in 64 bits.
-            carry = hi + c1 + c2
-        if i + len(b) < out_len:
-            out[i + len(b)] = carry
-    return out
+            _cs_add(sums, wraps, k, lo)
+            if k + 1 < out_len:
+                _cs_add(sums, wraps, k + 1, hi)
+        if resolve_every > 0 and (i + 1) % resolve_every == 0:
+            _cs_fold(sums, wraps)
+    return _cs_resolve(sums, wraps)
+
+
+def sqr_limbs(a: list, out_len: int, resolve_every: int = 0) -> list:
+    """Squaring specialization of mul_limbs: a_i*a_j == a_j*a_i, so each
+    off-diagonal product is computed ONCE and accumulated twice (carry-save
+    adds are cheap; doubling the product instead would need its own carry-out
+    column), with the diagonal a_i^2 once — (la^2 + la) / 2 multiplies instead
+    of la^2. Same truncation semantics as mul_limbs."""
+    zero = jnp.zeros_like(a[0])
+    sums = [zero] * out_len
+    wraps = [zero] * out_len
+    la = len(a)
+    for i in range(la):
+        if 2 * i >= out_len:
+            break
+        lo, hi = mul32(a[i], a[i])
+        _cs_add(sums, wraps, 2 * i, lo)
+        if 2 * i + 1 < out_len:
+            _cs_add(sums, wraps, 2 * i + 1, hi)
+        for j in range(i + 1, la):
+            k = i + j
+            if k >= out_len:
+                break
+            lo, hi = mul32(a[i], a[j])
+            _cs_add(sums, wraps, k, lo)
+            _cs_add(sums, wraps, k, lo)
+            if k + 1 < out_len:
+                _cs_add(sums, wraps, k + 1, hi)
+                _cs_add(sums, wraps, k + 1, hi)
+        if resolve_every > 0 and (i + 1) % resolve_every == 0:
+            _cs_fold(sums, wraps)
+    return _cs_resolve(sums, wraps)
 
 
 def add_u32(limbs: list, x) -> list:
@@ -202,10 +288,14 @@ def accumulate_digit_masks(plan: BasePlan, masks: list, limbs: list, num_digits:
     return masks
 
 
-def num_uniques_lanes(plan: BasePlan, n_limbs: list):
-    """num_uniques of (n^2, n^3) for a batch of candidates given as limbs."""
-    sq = mul_limbs(n_limbs, n_limbs, plan.limbs_sq)
-    cu = mul_limbs(sq, n_limbs, plan.limbs_cu)
+def num_uniques_lanes(plan: BasePlan, n_limbs: list, carry_interval: int = 0):
+    """num_uniques of (n^2, n^3) for a batch of candidates given as limbs.
+
+    carry_interval is the carry-save resolution interval (0 = resolve only
+    once per product) — a pure performance knob, bit-identical results at any
+    value; the autotuner sweeps it per (mode, base, backend)."""
+    sq = sqr_limbs(n_limbs, plan.limbs_sq, resolve_every=carry_interval)
+    cu = mul_limbs(sq, n_limbs, plan.limbs_cu, resolve_every=carry_interval)
     masks = [jnp.zeros_like(n_limbs[0]) for _ in range(plan.n_masks)]
     masks = accumulate_digit_masks(plan, masks, sq, plan.d_sq, plan.hw_sq)
     masks = accumulate_digit_masks(plan, masks, cu, plan.d_cu, plan.hw_cu)
@@ -251,24 +341,28 @@ def detailed_from_uniques(plan: BasePlan, uniques, valid):
     return hist, nm_count
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   static_argnames=("carry_interval",))
+def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
+                   *, carry_interval: int = 0):
     """(histogram int32[base+2], near_miss_count int32) for one batch.
 
     Lanes >= valid_count are masked into histogram bin 0 (real candidates
     always have num_uniques >= 1).
     """
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n)
+    uniques = num_uniques_lanes(plan, n, carry_interval)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     return detailed_from_uniques(plan, uniques, lane < valid_count)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def uniques_batch(plan: BasePlan, batch_size: int, start_limbs):
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   static_argnames=("carry_interval",))
+def uniques_batch(plan: BasePlan, batch_size: int, start_limbs,
+                  *, carry_interval: int = 0):
     """Per-lane num_uniques (rare-path extraction of near misses / nice)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    return num_uniques_lanes(plan, n)
+    return num_uniques_lanes(plan, n, carry_interval)
 
 
 def compact_survivors(uniques, valid, thresh: int, cap: int):
@@ -294,21 +388,23 @@ def compact_survivors(uniques, valid, thresh: int, cap: int):
     return jnp.sum(mask.astype(jnp.int32)), idx, uniq
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3),
+                   static_argnames=("carry_interval",))
 def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
-                    start_limbs, valid_count):
+                    start_limbs, valid_count, *, carry_interval: int = 0):
     """Compacted rare-path extraction: (count, idx[cap], uniq[cap]) of lanes
     with num_uniques > thresh. thresh = near_miss_cutoff serves detailed;
     thresh = base - 1 serves niceonly (uniques > base-1 <=> == base)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n)
+    uniques = num_uniques_lanes(plan, n, carry_interval)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     return compact_survivors(uniques, lane < valid_count, thresh, cap)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,))
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,),
+                   static_argnames=("carry_interval",))
 def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
-                         start_limbs, valid_count):
+                         start_limbs, valid_count, *, carry_interval: int = 0):
     """detailed_batch folded into a DEVICE-RESIDENT histogram accumulator.
 
     hist_acc (i32[base+2], donated) is carried across batches on the device;
@@ -317,17 +413,19 @@ def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
     well before i32 bins could saturate). Padding lanes land in bin 0, which
     no consumer reads (distributions report bins 1..base)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n)
+    uniques = num_uniques_lanes(plan, n, carry_interval)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     hist, nm = detailed_from_uniques(plan, uniques, lane < valid_count)
     return hist_acc + hist, nm
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count):
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   static_argnames=("carry_interval",))
+def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
+                        valid_count, *, carry_interval: int = 0):
     """Count of fully nice lanes in a dense range batch."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n)
+    uniques = num_uniques_lanes(plan, n, carry_interval)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     valid = lane < valid_count
     return jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))

@@ -1,0 +1,118 @@
+"""Autotuner end-to-end smoke check: sweep -> persist -> reload -> invalidate.
+
+With ``NICE_TPU_AUTOTUNE_FILE`` pointed inside the directory given as
+argv[1], this script proves the full winner lifecycle on a tiny field:
+
+1. ``ops/autotune.sweep`` times 2 configurations of one small slice through
+   the scripts/tune_kernels.py harness (--json) and persists the best as the
+   (mode, base, backend) winner.
+2. A CHILD PROCESS (fresh interpreter — the restart the acceptance criteria
+   demand) resolves the same key through engine.resolve_tuning and must get
+   the swept winner back with the ``hit`` counter incremented, then run a
+   real field at the tuned shape and match the scalar oracle.
+3. The winner's stored plan signature is tampered (a fake jax runtime) and
+   the next resolve must fall back to defaults with the ``invalidated``
+   counter incremented — a stale winner is never applied.
+
+Prints ONE JSON line; exit 0 iff every stage held. Usage:
+
+    python scripts/autotune_smoke.py /tmp/autotune-dir
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, os, sys
+from nice_tpu.core import base_range
+from nice_tpu.core.types import FieldSize
+from nice_tpu.ops import engine, scalar
+from nice_tpu.obs.series import AUTOTUNE_EVENTS
+
+hits0 = AUTOTUNE_EVENTS.value(("hit",))
+bs, br, ci = engine.resolve_tuning("detailed", 40, "jax")
+hits = AUTOTUNE_EVENTS.value(("hit",)) - hits0
+
+lo, _hi = base_range.get_base_range(40)
+rng = FieldSize(lo, lo + 512)
+got = engine.process_range_detailed(rng, 40, backend="jax")
+want = scalar.process_range_detailed(rng, 40)
+print(json.dumps({
+    "resolved": [bs, br, ci],
+    "hits": hits,
+    "field_ok": got == want,
+}))
+"""
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/nice-autotune-smoke"
+    os.makedirs(workdir, exist_ok=True)
+    winners = os.path.join(workdir, "nice_autotune.json")
+    os.environ["NICE_TPU_AUTOTUNE_FILE"] = winners
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from nice_tpu.obs.series import AUTOTUNE_EVENTS
+    from nice_tpu.ops import autotune, engine
+
+    # 1. Sweep two configurations on a small slice; persist the winner.
+    won = autotune.sweep(
+        "detailed", "default", "jax",
+        batch_shifts=[12, 13], carry=[0], slice_size=4096, timeout=600,
+    )
+    stored = os.path.exists(winners)
+
+    # 2. Fresh process: the winner must survive the restart — resolve_tuning
+    # returns it (hit counter moves) and a real field runs at the tuned
+    # shape, matching the scalar oracle.
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        child = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        child = {"error": proc.stderr[-1500:]}
+    reloaded = (
+        won is not None
+        and child.get("hits", 0) > 0
+        and child.get("resolved", [None])[0] == won.get("batch_size")
+        and child.get("field_ok") is True
+    )
+
+    # 3. Tamper the stored signature: the next resolve must refuse the
+    # winner (invalidated counter) and fall back to the default batch.
+    with open(winners) as f:
+        table = json.load(f)
+    table["detailed|b40|jax"]["signature"]["runtime"] = "jax-0.0.0-nowhere"
+    with open(winners, "w") as f:
+        json.dump(table, f)
+    autotune.reset_for_tests()
+    inv0 = AUTOTUNE_EVENTS.value(("invalidated",))
+    bs, _br, _ci = engine.resolve_tuning("detailed", 40, "jax")
+    invalidated = (
+        AUTOTUNE_EVENTS.value(("invalidated",)) > inv0
+        and bs == engine.DEFAULT_BATCH_SIZE
+    )
+
+    ok = bool(won) and stored and reloaded and invalidated
+    print(json.dumps({
+        "ok": ok,
+        "winner": won,
+        "stored": stored,
+        "reloaded": reloaded,
+        "child": child,
+        "invalidated": invalidated,
+        "winners_file": winners,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

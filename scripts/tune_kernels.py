@@ -6,9 +6,19 @@ client_process_gpu.rs:85-94, prefilter gate :407-450): measure, don't guess.
 Run on a TPU host; each configuration times a slice of the chosen benchmark
 field after a same-shape warmup so compile time is excluded.
 
+The detailed/niceonly kinds sweep the cartesian grid of --batches x
+--sweep-rows x --carry, pinning block_rows / carry_interval through the same
+NICE_TPU_* env vars the engine's autotune precedence honors (env > tuned >
+default, ops/autotune.py) — so the sweep times exactly the dispatch path a
+pinned production run would take. --json emits one machine-readable line per
+configuration; ops/autotune.sweep() runs this script that way and persists
+the best-throughput config as the (mode, base, backend) winner.
+
 Usage:
     python scripts/tune_kernels.py detailed --mode extra-large \
         --slice 100000000 --batches 24,26,28
+    python scripts/tune_kernels.py detailed --mode hi-base --backend pallas \
+        --batches 24,26 --sweep-rows 64,128,256 --carry 0,2,4 --json
     python scripts/tune_kernels.py niceonly --mode extra-large \
         --slice 1000000000 --floors 65536,262144,1048576
     python scripts/tune_kernels.py blocks --mode extra-large
@@ -18,6 +28,8 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import itertools
+import json
 import os
 import sys
 import time
@@ -26,31 +38,33 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def time_detailed(data, batch_size: int, slice_size: int) -> float:
+def time_detailed(data, batch_size: int, slice_size: int,
+                  backend: str = "jax") -> float:
     from nice_tpu.core.types import FieldSize
     from nice_tpu.ops import engine
 
     warm = FieldSize(data.range_start, data.range_start + 1)
-    engine.process_range_detailed(warm, data.base, backend="jax",
+    engine.process_range_detailed(warm, data.base, backend=backend,
                                   batch_size=batch_size)
     rng = FieldSize(data.range_start, data.range_start + slice_size)
     t0 = time.monotonic()
-    engine.process_range_detailed(rng, data.base, backend="jax",
+    engine.process_range_detailed(rng, data.base, backend=backend,
                                   batch_size=batch_size)
     return time.monotonic() - t0
 
 
-def time_niceonly(data, slice_size: int) -> float:
+def time_niceonly(data, slice_size: int, batch_size: int = 1 << 20,
+                  backend: str = "jax") -> float:
     from nice_tpu.core.types import FieldSize
     from nice_tpu.ops import engine
 
     warm = FieldSize(data.range_start, data.range_start + 1)
-    engine.process_range_niceonly(warm, data.base, backend="jax",
-                                  batch_size=1 << 20)
+    engine.process_range_niceonly(warm, data.base, backend=backend,
+                                  batch_size=batch_size)
     rng = FieldSize(data.range_start, data.range_start + slice_size)
     t0 = time.monotonic()
-    engine.process_range_niceonly(rng, data.base, backend="jax",
-                                  batch_size=1 << 20)
+    engine.process_range_niceonly(rng, data.base, backend=backend,
+                                  batch_size=batch_size)
     return time.monotonic() - t0
 
 
@@ -134,16 +148,47 @@ def sweep_stride_blocks(data, rows_list) -> None:
         pe._strided_callable.cache_clear()
 
 
+def _pin_env(name: str, value: int | None) -> None:
+    """Pin (or clear) one NICE_TPU_* knob for the next timed config."""
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+
+
+def _emit(as_json: bool, human: str, rec: dict) -> None:
+    if as_json:
+        print(json.dumps(rec), flush=True)
+    else:
+        print(human, flush=True)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument(
         "kind", choices=["detailed", "niceonly", "blocks", "stride-blocks"]
     )
     p.add_argument("--mode", default="extra-large")
+    p.add_argument("--backend", default="jax",
+                   choices=["jax", "jnp", "pallas"],
+                   help="engine backend to time (jax auto-selects Pallas on "
+                   "TPU; pallas demands the Pallas kernels or fails)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object per timed configuration "
+                   "(consumed by ops/autotune.sweep)")
     p.add_argument("--slice", type=int, default=100_000_000)
-    p.add_argument("--batches", default="22,24,26,28",
-                   help="log2 batch sizes to sweep (detailed); the blocks "
-                   "sweep uses --block-batch instead")
+    p.add_argument("--batches", default=None,
+                   help="log2 batch sizes to sweep (default 22,24,26,28 for "
+                   "detailed, 20 for niceonly); the blocks sweep uses "
+                   "--block-batch instead")
+    p.add_argument("--sweep-rows", default="",
+                   help="block_rows values to sweep per batch "
+                   "(detailed/niceonly; pins NICE_TPU_BLOCK_ROWS per config; "
+                   "empty = engine default)")
+    p.add_argument("--carry", default="0",
+                   help="carry-save resolution intervals to sweep "
+                   "(pins NICE_TPU_CARRY_INTERVAL per config; 0 = resolve "
+                   "carries once at the end)")
     p.add_argument("--block-batch", type=int, default=26,
                    help="log2 batch for the blocks sweep (26 matches the "
                    "committed BLOCK_ROWS sweep in ops/pallas_engine.py)")
@@ -164,7 +209,25 @@ def main() -> int:
     from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
 
     data = get_benchmark_field(BenchmarkMode(args.mode))
-    print(f"{args.kind} {args.mode}: base {data.base}, slice {args.slice:.0e}")
+    if not args.json:
+        print(f"{args.kind} {args.mode}: base {data.base}, "
+              f"slice {args.slice:.0e}")
+
+    if args.batches is None:
+        args.batches = "22,24,26,28" if args.kind == "detailed" else "20"
+    shifts = [int(s) for s in args.batches.split(",")]
+    rows_sweep = [int(r) for r in args.sweep_rows.split(",")] \
+        if args.sweep_rows else [None]
+    carries = [int(c) for c in args.carry.split(",")]
+
+    def rec_for(batch_size, rows, carry, floor, el):
+        return {
+            "kind": args.kind, "mode": args.mode, "base": data.base,
+            "backend": args.backend, "batch_size": batch_size,
+            "block_rows": rows, "carry_interval": carry,
+            "msd_floor": floor, "elapsed_secs": round(el, 6),
+            "numbers_per_sec": round(args.slice / el, 1) if el > 0 else None,
+        }
 
     if args.kind == "blocks":
         sweep_stats_blocks(
@@ -173,11 +236,15 @@ def main() -> int:
     elif args.kind == "stride-blocks":
         sweep_stride_blocks(data, [int(r) for r in args.rows.split(",")])
     elif args.kind == "detailed":
-        for shift in (int(s) for s in args.batches.split(",")):
-            el = time_detailed(data, 1 << shift, args.slice)
-            print(
-                f"  batch 2^{shift}: {el:8.3f}s  "
-                f"{args.slice / el / 1e6:10.1f} M n/s"
+        for shift, rows, carry in itertools.product(shifts, rows_sweep, carries):
+            _pin_env("NICE_TPU_BLOCK_ROWS", rows)
+            _pin_env("NICE_TPU_CARRY_INTERVAL", carry)
+            el = time_detailed(data, 1 << shift, args.slice, args.backend)
+            _emit(
+                args.json,
+                f"  batch 2^{shift} rows {rows or 'def'} carry {carry}: "
+                f"{el:8.3f}s  {args.slice / el / 1e6:10.1f} M n/s",
+                rec_for(1 << shift, rows, carry, None, el),
             )
     else:
         from nice_tpu.ops import adaptive_floor
@@ -185,11 +252,15 @@ def main() -> int:
         for floor in (int(f) for f in args.floors.split(",")):
             os.environ["NICE_TPU_MSD_FLOOR"] = str(floor)
             adaptive_floor.reset_for_tests()  # re-read the pin
-            el = time_niceonly(data, args.slice)
-            print(
-                f"  floor {floor:>8}: {el:8.3f}s  "
-                f"{args.slice / el / 1e6:10.1f} M n/s"
-            )
+            for shift, carry in itertools.product(shifts, carries):
+                _pin_env("NICE_TPU_CARRY_INTERVAL", carry)
+                el = time_niceonly(data, args.slice, 1 << shift, args.backend)
+                _emit(
+                    args.json,
+                    f"  floor {floor:>8} batch 2^{shift} carry {carry}: "
+                    f"{el:8.3f}s  {args.slice / el / 1e6:10.1f} M n/s",
+                    rec_for(1 << shift, None, carry, floor, el),
+                )
     return 0
 
 

@@ -1,0 +1,127 @@
+"""Autotuner winners-table unit tests: precedence, persistence, invalidation.
+
+The subprocess sweep itself is covered by scripts/autotune_smoke.py in CI;
+these tests pin the table semantics the engine depends on — env > tuned >
+default resolution, signature-checked lookups, and atomic persistence that a
+fresh loader (simulating a process restart) reads back identically.
+"""
+
+import json
+
+import pytest
+
+from nice_tpu.obs.series import AUTOTUNE_EVENTS
+from nice_tpu.ops import autotune, engine
+from nice_tpu.ops import pallas_engine as pe
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "NICE_TPU_AUTOTUNE_FILE", str(tmp_path / "winners.json")
+    )
+    for var in ("NICE_TPU_BATCH", "NICE_TPU_BLOCK_ROWS",
+                "NICE_TPU_CARRY_INTERVAL"):
+        monkeypatch.delenv(var, raising=False)
+    autotune.reset_for_tests()
+    yield
+    autotune.reset_for_tests()
+
+
+def test_winners_path_precedence(tmp_path, monkeypatch):
+    assert autotune.winners_path() == tmp_path / "winners.json"
+    monkeypatch.delenv("NICE_TPU_AUTOTUNE_FILE")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "cc"))
+    assert autotune.winners_path() == tmp_path / "cc" / "nice_autotune.json"
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    assert autotune.winners_path().name == "nice_autotune.json"
+
+
+def test_choose_defaults_when_untuned():
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 123) == 123
+    assert AUTOTUNE_EVENTS.value(("miss",)) > 0
+
+
+def test_record_then_choose_roundtrip():
+    autotune.record(
+        "detailed", 40, "jax",
+        {"batch_size": 4096, "block_rows": 64, "carry_interval": 3},
+        throughput=1e6,
+    )
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 1) == 4096
+    assert autotune.choose("detailed", 40, "jax", "block_rows", 1) == 64
+    assert autotune.choose("detailed", 40, "jax", "carry_interval", 9) == 3
+    # Other keys are unaffected.
+    assert autotune.choose("niceonly", 40, "jax", "batch_size", 7) == 7
+    assert autotune.choose("detailed", 40, "pallas", "batch_size", 7) == 7
+
+
+def test_restart_persistence_hit_counter():
+    """A fresh in-process loader (the restart analog; the true fresh-process
+    check lives in scripts/autotune_smoke.py) reads the winner back from
+    disk and counts a hit."""
+    autotune.record("detailed", 40, "jax", {"batch_size": 2048})
+    autotune.reset_for_tests()  # drop the in-memory table: force a re-read
+    hits0 = AUTOTUNE_EVENTS.value(("hit",))
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 1) == 2048
+    assert AUTOTUNE_EVENTS.value(("hit",)) == hits0 + 1
+
+
+def test_env_overrides_tuned(monkeypatch):
+    autotune.record("detailed", 40, "jax", {"carry_interval": 3})
+    monkeypatch.setenv("NICE_TPU_CARRY_INTERVAL", "5")
+    ov0 = AUTOTUNE_EVENTS.value(("env_override",))
+    assert autotune.choose("detailed", 40, "jax", "carry_interval", 0) == 5
+    assert AUTOTUNE_EVENTS.value(("env_override",)) == ov0 + 1
+
+
+def test_signature_change_invalidates():
+    autotune.record("detailed", 40, "jax", {"batch_size": 2048})
+    path = autotune.winners_path()
+    table = json.loads(path.read_text())
+    table["detailed|b40|jax"]["signature"]["runtime"] = "jax-9.9.9-mars"
+    path.write_text(json.dumps(table))
+    autotune.reset_for_tests()
+    inv0 = AUTOTUNE_EVENTS.value(("invalidated",))
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 55) == 55
+    assert AUTOTUNE_EVENTS.value(("invalidated",)) == inv0 + 1
+
+
+def test_plan_change_invalidates():
+    """A limb-width drift (e.g. a base-range fix) must also refuse the
+    winner, not just a jax upgrade."""
+    autotune.record("detailed", 40, "jax", {"batch_size": 2048})
+    path = autotune.winners_path()
+    table = json.loads(path.read_text())
+    table["detailed|b40|jax"]["signature"]["limbs"] = [9, 9, 9]
+    path.write_text(json.dumps(table))
+    autotune.reset_for_tests()
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 55) == 55
+
+
+def test_corrupt_table_reads_as_empty():
+    path = autotune.winners_path()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("{not json")
+    assert autotune.params("detailed", 40, "jax") is None
+    assert autotune.choose("detailed", 40, "jax", "batch_size", 77) == 77
+
+
+def test_resolve_tuning_precedence(monkeypatch):
+    """The engine-facing resolver composes the three knobs: explicit batch
+    pins batch (tuned ignored), env pins any knob, host backends bypass the
+    table entirely."""
+    autotune.record(
+        "detailed", 40, "jax",
+        {"batch_size": 4096, "block_rows": 32, "carry_interval": 2},
+    )
+    assert engine.resolve_tuning("detailed", 40, "jax") == (4096, 32, 2)
+    bs, br, ci = engine.resolve_tuning("detailed", 40, "jax", 512)
+    assert (bs, br, ci) == (512, 32, 2)
+    monkeypatch.setenv("NICE_TPU_BLOCK_ROWS", "16")
+    assert engine.resolve_tuning("detailed", 40, "jax")[1] == 16
+    monkeypatch.delenv("NICE_TPU_BLOCK_ROWS")
+    assert engine.resolve_tuning("detailed", 40, "scalar") == (
+        engine.DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0,
+    )
+    assert engine.resolve_tuning("detailed", 40, "scalar", 64)[0] == 64

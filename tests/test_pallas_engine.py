@@ -91,6 +91,51 @@ def test_detailed_kernel_matches_scalar_b80():
     assert int(nm) == want_nm
 
 
+def test_widened_hist_layout():
+    """Bases past 126 need a multi-row histogram tile (base+2 bins > 128
+    lanes). supports_base previously rejected every such plan, silently
+    demoting hi-base detailed scans to jnp; it now admits anything within
+    _HIST_ROWS_MAX rows. Pure layout math — the kernel itself is diffed
+    against the oracle in the slow test below (interpreter-mode XLA compiles
+    of 2-row plans take minutes on CPU)."""
+    for base, rows, ok in [
+        (80, 1, True), (125, 1, True), (127, 2, True), (150, 2, True),
+        (510, 4, True), (512, 5, False),
+    ]:
+        plan = get_plan(base)
+        assert pe._hist_rows(plan) == rows, base
+        assert pe.supports_base(plan) is ok, base
+
+
+@pytest.mark.slow
+def test_detailed_kernel_widened_hist_b127():
+    """Multi-row histogram correctness: b127 is the smallest hist_rows=2
+    plan (cheapest interpreter-mode compile of the widened tile). Diff
+    against the scalar oracle, and prove the carry-resolution interval is
+    bit-invisible on the Pallas path too. Marked slow: the interpreter-mode
+    XLA compile of a 2-row plan runs minutes on CPU."""
+    base, batch = 127, 256
+    plan = get_plan(base)
+    assert pe._hist_rows(plan) == 2
+    br = base_range.get_base_range(base)
+    sl = int_to_limbs(br[0], plan.limbs_n)
+    h, nm = pe.detailed_batch(plan, batch, sl, np.int32(batch), block_rows=2)
+    h = np.asarray(h)
+    want = np.zeros(plan.base + 2, dtype=np.int64)
+    want_nm = 0
+    for n in range(br[0], br[0] + batch):
+        u = scalar.get_num_unique_digits(n, base)
+        want[u] += 1
+        want_nm += u > plan.near_miss_cutoff
+    assert np.array_equal(h[: plan.base + 2], want)
+    assert int(nm) == want_nm
+    h2, nm2 = pe.detailed_batch(
+        plan, batch, sl, np.int32(batch), block_rows=2, carry_interval=2
+    )
+    assert np.array_equal(np.asarray(h2), h)
+    assert int(nm2) == int(nm)
+
+
 def _stride_spec(base):
     from nice_tpu.ops import stride_filter
 

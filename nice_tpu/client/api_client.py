@@ -19,16 +19,20 @@ retries, exercising the exactly-once submit path.
 
 from __future__ import annotations
 
+import contextlib
+import http.client
 import io
 import json
 import logging
 import random
+import threading
 import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from email.message import Message
 from typing import Any, Optional
+from urllib.parse import urlsplit
 
 from nice_tpu import faults, obs
 from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
@@ -94,11 +98,63 @@ def _retry_after_secs(err: Exception) -> Optional[float]:
         return None
 
 
+# Per-thread keep-alive connection pool, keyed by (scheme, host:port). One
+# persistent socket per server per thread replaces the fresh TCP handshake
+# urllib.request paid on EVERY call; the server speaks HTTP/1.1 keep-alive
+# on both cores, so a pipelined client reuses one connection for its whole
+# lifetime (the load harness measures the RTT delta). Thread-local because
+# http.client connections are not thread-safe and the AsyncApi pool plus the
+# renew/telemetry threads each need their own.
+_conn_local = threading.local()
+
+# Errors that mean the REUSED socket went stale (server closed an idle
+# keep-alive connection): safe to transparently retry once on a fresh
+# socket. On a brand-new connection the same errors are real failures and
+# propagate to retry_request's backoff (which is also where the
+# exactly-once submit_id story absorbs any ambiguous resend).
+_STALE_ERRORS = (
+    http.client.BadStatusLine,
+    http.client.RemoteDisconnected,
+    BrokenPipeError,
+    ConnectionResetError,
+    ConnectionAbortedError,
+)
+
+
+def _conn_pool() -> dict:
+    pool = getattr(_conn_local, "pool", None)
+    if pool is None:
+        pool = _conn_local.pool = {}
+    return pool
+
+
+def _drop_connection(key) -> None:
+    conn = _conn_pool().pop(key, None)
+    if conn is not None:
+        with contextlib.suppress(Exception):
+            conn.close()
+
+
+def close_connections() -> None:
+    """Close this thread's pooled connections (tests / clean shutdown)."""
+    pool = _conn_pool()
+    for conn in pool.values():
+        with contextlib.suppress(Exception):
+            conn.close()
+    pool.clear()
+
+
 def _request_json(
     url: str,
     body: Optional[dict] = None,
     timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
 ) -> Any:
+    parts = urlsplit(url)
+    if parts.scheme not in ("http", "https"):
+        raise urllib.error.URLError(f"unsupported scheme in {url!r}")
+    target = parts.path or "/"
+    if parts.query:
+        target += "?" + parts.query
     data = None
     headers = {"Accept": "application/json"}
     if body is not None:
@@ -109,10 +165,43 @@ def _request_json(
     traceparent = obs.current_traceparent()
     if traceparent:
         headers["traceparent"] = traceparent
-    req = urllib.request.Request(url, data=data, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        payload = resp.read()
-    return json.loads(payload) if payload else None
+    method = "GET" if body is None else "POST"
+    key = (parts.scheme, parts.netloc)
+    pool = _conn_pool()
+    for fresh_retry in (False, True):
+        conn = pool.get(key)
+        reused = conn is not None
+        if conn is None:
+            cls = (
+                http.client.HTTPSConnection
+                if parts.scheme == "https"
+                else http.client.HTTPConnection
+            )
+            conn = cls(parts.netloc, timeout=timeout)
+            pool[key] = conn
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, target, body=data, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except _STALE_ERRORS as e:
+            _drop_connection(key)
+            if reused and not fresh_retry:
+                continue
+            raise urllib.error.URLError(f"{e.__class__.__name__}: {e}") from e
+        except OSError:
+            # Connect/socket failure: state unknown, never silently resend.
+            _drop_connection(key)
+            raise
+        if resp.will_close:
+            _drop_connection(key)
+        if resp.status >= 400:
+            raise urllib.error.HTTPError(
+                url, resp.status, resp.reason, resp.headers, io.BytesIO(payload)
+            )
+        return json.loads(payload) if payload else None
 
 
 def retry_request(
@@ -236,6 +325,68 @@ def renew_claim(
         )
 
 
+def claim_block_from_server(
+    mode: SearchMode,
+    api_base: str,
+    username: str,
+    count: int,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+) -> tuple[str, list[DataToClient]]:
+    """POST /claim_block — N fields per round-trip under one block lease.
+
+    Returns (block_id, fields). A server that predates block leases answers
+    404; callers treat that ApiError as "fall back to per-field claims"."""
+    mode_arg = "detailed" if mode == SearchMode.DETAILED else "niceonly"
+    resp = retry_request(
+        f"{api_base}/claim_block",
+        {"mode": mode_arg, "count": count, "username": username},
+        max_retries=max_retries,
+        endpoint="claim_block",
+    )
+    return resp["block_id"], [
+        DataToClient.from_json(f) for f in resp["fields"]
+    ]
+
+
+def submit_block_to_server(
+    api_base: str,
+    block_id: str,
+    submissions: list[DataToServer],
+    telemetry: Optional[dict] = None,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+) -> dict:
+    """POST /submit_block — batched results for a block claim. The reply has
+    one result per submission (in order) plus accepted/duplicates/rejected
+    counts; duplicates are exactly-once replays, success not failure."""
+    body: dict = {
+        "block_id": block_id,
+        "submissions": [s.to_json() for s in submissions],
+    }
+    if telemetry is not None:
+        body["telemetry"] = telemetry
+    with obs.span("client.submit_block", block=block_id, n=len(submissions)):
+        resp = retry_request(
+            f"{api_base}/submit_block", body,
+            max_retries=max_retries, endpoint="submit_block",
+        )
+    if isinstance(resp, dict) and resp.get("duplicates"):
+        log.info(
+            "submit_block %s: %d of %d results were duplicates (retried "
+            "requests already accepted)",
+            block_id, resp["duplicates"], len(submissions),
+        )
+    return resp if isinstance(resp, dict) else {"status": "OK"}
+
+
+def renew_block(api_base: str, block_id: str, max_retries: int = 1) -> None:
+    """POST /renew_claim {block_id} — one heartbeat re-arms every member of
+    the block lease (same low retry budget rationale as renew_claim)."""
+    retry_request(
+        f"{api_base}/renew_claim", {"block_id": block_id},
+        max_retries=max_retries, endpoint="renew",
+    )
+
+
 def post_telemetry(
     api_base: str, snap: dict, max_retries: int = 1
 ) -> None:
@@ -281,6 +432,23 @@ class AsyncApi:
     def submit_async(self, data: DataToServer):
         return self._pool.submit(
             submit_field_to_server, self.api_base, data, self.max_retries
+        )
+
+    def claim_block_async(self, mode: SearchMode, count: int):
+        return self._pool.submit(
+            claim_block_from_server, mode, self.api_base, self.username,
+            count, self.max_retries,
+        )
+
+    def submit_block_async(
+        self,
+        block_id: str,
+        submissions: list[DataToServer],
+        telemetry: Optional[dict] = None,
+    ):
+        return self._pool.submit(
+            submit_block_to_server, self.api_base, block_id, submissions,
+            telemetry, self.max_retries,
         )
 
     def shutdown(self) -> None:

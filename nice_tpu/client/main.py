@@ -140,6 +140,16 @@ def build_parser() -> argparse.ArgumentParser:
         "NICE_TPU_CKPT_BATCHES dispatches)",
     )
     p.add_argument(
+        "--claim-block",
+        type=int,
+        default=int(os.environ.get("NICE_TPU_CLAIM_BLOCK", 1)),
+        help="fields per claim round-trip: >1 claims through the block-lease "
+        "endpoints (/claim_block, /submit_block) with ONE lease covering the "
+        "whole block; 1 = per-field compatibility path. Falls back to "
+        "per-field automatically against servers without block support "
+        "(env NICE_TPU_CLAIM_BLOCK)",
+    )
+    p.add_argument(
         "--renew-secs",
         type=float,
         default=float(_env("RENEW_SECS", 900)),
@@ -479,6 +489,52 @@ def _maybe_renewer(args, claim_id: int):
     return nullcontext()
 
 
+class _BlockRenewer:
+    """Lease heartbeat for a block claim: one POST /renew_claim {block_id}
+    re-arms every member field's lease (same immediately-then-periodically
+    cadence and swallow-failures policy as _ClaimRenewer)."""
+
+    def __init__(self, api_base: str, block_id: str, every_secs: float):
+        import threading
+
+        self.api_base = api_base
+        self.block_id = block_id
+        self.every_secs = every_secs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="block-renew", daemon=True
+        )
+
+    def _renew_once(self) -> None:
+        try:
+            api_client.renew_block(self.api_base, self.block_id)
+            CKPT_RENEWALS.inc()
+            log.debug("renewed block %s lease", self.block_id)
+        except Exception as e:
+            log.warning("block %s lease renewal failed: %s", self.block_id, e)
+
+    def _run(self) -> None:
+        self._renew_once()
+        while not self._stop.wait(self.every_secs):
+            self._renew_once()
+
+    def __enter__(self) -> "_BlockRenewer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def _maybe_block_renewer(args, block_id: str):
+    from contextlib import nullcontext
+
+    if args.renew_secs and args.renew_secs > 0 and block_id:
+        return _BlockRenewer(args.api_base, block_id, args.renew_secs)
+    return nullcontext()
+
+
 def _new_checkpointer(args, data: DataToClient, mode: SearchMode):
     if not args.checkpoint_dir:
         return None
@@ -624,6 +680,156 @@ def run_pipelined_loop(
         )
 
 
+def _process_block(args, mode: SearchMode, block_id: str, fields, spool):
+    """Process every field of a block sequentially under ONE block-lease
+    renewer; returns [(submission, checkpointer), ...] in field order."""
+    submissions = []
+    with _maybe_block_renewer(args, block_id):
+        for data in fields:
+            ckptr = _new_checkpointer(args, data, mode)
+            with obs.trace_context(obs.claim_trace_id(data.claim_id)):
+                obs.trace_event(
+                    "client.claim", claim=data.claim_id, base=data.base,
+                    range_start=str(data.range_start), size=data.range_size,
+                    resumed=False, block=block_id,
+                )
+                obs.flight.record(
+                    "claim", claim=data.claim_id, base=data.base,
+                    block=block_id,
+                )
+                results, _ = process_field(
+                    data, mode, args.backend, args.batch_size,
+                    args.progress_secs, checkpointer=ckptr,
+                    checkpoint_secs=args.checkpoint_secs,
+                )
+            submissions.append(
+                (compile_results(data, results, mode, args.username), ckptr)
+            )
+    return submissions
+
+
+def _await_block_submit(future, submissions, spool) -> None:
+    """Settle one /submit_block: per-item rejections are logged (a replay of
+    a rejected payload can never succeed, so they still retire their
+    snapshots); retry exhaustion spools every member for per-field replay.
+    Once this returns, delivery of every member is owned."""
+    resp = None
+    try:
+        resp = future.result()
+        for (sub, _ck), result in zip(submissions, resp.get("results", [])):
+            if result.get("status") == "error":
+                log.error(
+                    "block submission for claim %d rejected (%s): %s",
+                    sub.claim_id, result.get("code"), result.get("message"),
+                )
+            else:
+                log.info(
+                    "submitted claim %d%s", sub.claim_id,
+                    " (duplicate)" if result.get("duplicate") else "",
+                )
+    except api_client.ApiError as e:
+        if spool is None or (e.status is not None and 400 <= e.status < 500):
+            raise
+        # The spool replays through the per-field /submit path, which the
+        # server keeps for exactly this kind of compatibility traffic.
+        for sub, _ck in submissions:
+            spool.add(sub)
+    for _sub, ck in submissions:
+        if ck is not None:
+            ck.delete()
+
+
+def _drain_resumable(args, api: api_client.AsyncApi, mode: SearchMode, spool):
+    """Block mode can't resume a lone per-field snapshot into a block, so a
+    crash-recovered scan finishes through the per-field path first."""
+    if not args.checkpoint_dir:
+        return
+    while ckpt.find_resumable(
+        args.checkpoint_dir, mode, args.backend, args.batch_size
+    ):
+        run_single_iteration(args, api, mode, spool=spool)
+
+
+def run_block_iteration(
+    args, api: api_client.AsyncApi, mode: SearchMode, spool=None
+) -> bool:
+    """Claim one block, process all members, submit batched. False means the
+    server predates block leases (404) and the caller should fall back."""
+    _drain_resumable(args, api, mode, spool)
+    try:
+        block_id, fields = api.claim_block_async(
+            mode, args.claim_block
+        ).result()
+    except api_client.ApiError as e:
+        if e.status == 404:
+            log.warning(
+                "server has no /claim_block; falling back to per-field claims"
+            )
+            return False
+        raise
+    log.info("claimed block %s: %d fields", block_id, len(fields))
+    submissions = _process_block(args, mode, block_id, fields, spool)
+    future = api.submit_block_async(
+        block_id, [s for s, _ in submissions], _fleet_snapshot(args, spool)
+    )
+    _await_block_submit(future, submissions, spool)
+    return True
+
+
+def run_block_pipelined_loop(
+    args, api: api_client.AsyncApi, mode: SearchMode, spool=None
+) -> bool:
+    """claim block N+1 || process block N || settle submit block N-1: the
+    3-stage pipeline over block leases — one HTTP round-trip per
+    --claim-block fields at each stage. False = server has no block support."""
+    _drain_resumable(args, api, mode, spool)
+    pending_submit = None  # (future, submissions) awaiting confirmation
+    try:
+        block_id, fields = api.claim_block_async(
+            mode, args.claim_block
+        ).result()
+    except api_client.ApiError as e:
+        if e.status == 404:
+            log.warning(
+                "server has no /claim_block; falling back to per-field claims"
+            )
+            return False
+        raise
+    stats_every = float(_env("STATS_SECS", 60))
+    t_start = time.monotonic()
+    last_stats = t_start
+    fields_done = 0
+    numbers = 0
+    while True:
+        if spool is not None:
+            spool.replay(args.api_base)
+        log.info("claimed block %s: %d fields", block_id, len(fields))
+        next_block = api.claim_block_async(mode, args.claim_block)
+        submissions = _process_block(args, mode, block_id, fields, spool)
+        if pending_submit is not None:
+            _await_block_submit(*pending_submit, spool)
+        pending_submit = (
+            api.submit_block_async(
+                block_id,
+                [s for s, _ in submissions],
+                _fleet_snapshot(args, spool),
+            ),
+            submissions,
+        )
+        fields_done += len(fields)
+        numbers += sum(d.range_size for d in fields)
+        now = time.monotonic()
+        if stats_every > 0 and now - last_stats >= stats_every:
+            last_stats = now
+            up = now - t_start
+            log.info(
+                "session stats: %d fields, %s numbers in %.0fs "
+                "(%s numbers/sec average)",
+                fields_done, f"{numbers:,}", up, f"{numbers / up:,.0f}",
+            )
+        block_id, fields = next_block.result()
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     level = {"trace": logging.DEBUG, "debug": logging.DEBUG, "info": logging.INFO,
@@ -670,10 +876,22 @@ def main(argv: Optional[list[str]] = None) -> int:
         spool.replay(args.api_base)
     try:
         with _maybe_telemetry(args, spool):
-            if args.repeat:
-                run_pipelined_loop(args, api, mode, spool=spool)
-            else:
-                run_single_iteration(args, api, mode, spool=spool)
+            handled = False
+            if args.claim_block > 1:
+                # Block-lease path: N fields per round-trip; a False return
+                # means the server predates /claim_block, so fall through to
+                # the per-field compatibility loop below.
+                if args.repeat:
+                    handled = run_block_pipelined_loop(
+                        args, api, mode, spool=spool
+                    )
+                else:
+                    handled = run_block_iteration(args, api, mode, spool=spool)
+            if not handled:
+                if args.repeat:
+                    run_pipelined_loop(args, api, mode, spool=spool)
+                else:
+                    run_single_iteration(args, api, mode, spool=spool)
     except KeyboardInterrupt:
         log.info("interrupted; shutting down")
     finally:

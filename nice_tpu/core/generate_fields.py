@@ -2,16 +2,25 @@
 
 from __future__ import annotations
 
+from typing import Iterator
+
 from nice_tpu.core.types import FieldSize
+
+
+def iter_fields(min_: int, max_: int, size: int) -> Iterator[FieldSize]:
+    """Stream [min_, max_) as half-open fields of width `size` (last smaller).
+
+    Generator form of break_range_into_fields: seeding a wide base produces
+    hundreds of thousands of fields, and the pre-generation pipeline wants to
+    feed them to executemany without materializing the whole list first.
+    """
+    start = min_
+    while start < max_:
+        end = min(start + size, max_)
+        yield FieldSize(start, end)
+        start = end
 
 
 def break_range_into_fields(min_: int, max_: int, size: int) -> list[FieldSize]:
     """Break [min_, max_) into half-open fields of width `size` (last smaller)."""
-    fields: list[FieldSize] = []
-    start = min_
-    end = min_
-    while end < max_:
-        end = min(start + size, max_)
-        fields.append(FieldSize(start, end))
-        start = end
-    return fields
+    return list(iter_fields(min_, max_, size))

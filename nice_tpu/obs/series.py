@@ -218,6 +218,33 @@ SERVER_SQLITE_BUSY_RETRIES = metrics.counter(
     "Write transactions retried after SQLITE_BUSY before succeeding.",
 )
 
+# --- single-writer DB actor + block leases (server/writer.py, server/app.py)
+SERVER_WRITE_BATCH_SIZE = metrics.histogram(
+    "nice_server_write_batch_size",
+    "Mutations coalesced into one SQLite transaction by the writer actor.",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+SERVER_WRITER_QUEUE_DEPTH = metrics.gauge(
+    "nice_server_writer_queue_depth",
+    "Mutations waiting in the writer actor's queue at batch-drain time.",
+)
+SERVER_BLOCK_LEASE_SIZE = metrics.histogram(
+    "nice_server_block_lease_size",
+    "Fields handed out per /claim_block lease.",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+)
+SERVER_FIELD_QUEUE_REFILLS = metrics.counter(
+    "nice_server_field_queue_refills_total",
+    "Low-water-mark refills performed by the field pre-generation pipeline, "
+    "by queue.",
+    labelnames=("queue",),
+)
+SERVER_STATUS_CACHE_EVENTS = metrics.counter(
+    "nice_server_status_cache_events_total",
+    "Read-snapshot cache traffic for the /status fleet block.",
+    labelnames=("event",),
+)
+
 # --- fleet telemetry aggregation (server/app.py, server/db.py) -----------
 # Re-exported from client_telemetry rows the server persists: each client
 # ships a compact registry snapshot with every submission and with the
@@ -338,6 +365,10 @@ for _q in ("0.5", "0.95"):
     FLEET_FIELD_LATENCY.labels(_q)
 for _source in ("heartbeat", "submission"):
     SERVER_TELEMETRY_REPORTS.labels(_source)
+for _event in ("hit", "miss"):
+    SERVER_STATUS_CACHE_EVENTS.labels(_event)
+for _queue in ("niceonly", "detailed_thin"):
+    SERVER_FIELD_QUEUE_REFILLS.labels(_queue)
 for _reason in ("corrupt", "signature", "version"):
     CKPT_REJECTED.labels(_reason)
 for _outcome in ("delivered", "rejected", "deferred"):

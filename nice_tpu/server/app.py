@@ -1,10 +1,19 @@
 """Coordination HTTP API server.
 
-Stdlib ThreadingHTTPServer equivalent of the reference's Rocket app
-(api/src/main.rs): claim endpoints with the 80/15/4/1 detailed strategy mix,
-in-memory pre-claim queues, submit-side verification that recomputes every
-submitted number with the trusted engine, /status queue depths, and a
-Prometheus /metrics exporter with per-endpoint request timing.
+The request core is async (nice_tpu/server/async_core.py): one event loop
+owns every socket, a bounded worker pool runs the transport-agnostic router
+below, and ALL database mutations funnel through a single-writer actor
+(nice_tpu/server/writer.py) that coalesces them into batched SQLite
+transactions — the stdlib equivalent of the reference's Rocket app over a
+pooled Postgres (api/src/main.rs), re-shaped for SQLite's one-writer
+reality. Claim endpoints keep the 80/15/4/1 detailed strategy mix and the
+in-memory pre-claim queues; /claim_block, /submit_block, and block-aware
+/renew_claim amortize one HTTP round-trip and one lease over N fields,
+while the original per-field endpoints remain as the compatibility path for
+the WASM/browser client. Submit-side verification still recomputes every
+submitted number with the trusted engine. /status serves its fleet block
+from a short-TTL read snapshot; /metrics is a Prometheus exporter with
+per-endpoint request timing.
 """
 
 from __future__ import annotations
@@ -15,6 +24,7 @@ import json
 import logging
 import os
 import random
+import secrets
 import sqlite3
 import threading
 import time
@@ -40,14 +50,18 @@ from nice_tpu.obs.series import (
     FLEET_RATE,
     FLEET_RESTORES,
     FLEET_SPOOL_DEPTH,
+    SERVER_BLOCK_LEASE_SIZE,
     SERVER_DUPLICATE_SUBMITS,
     SERVER_FIELD_ELAPSED,
     SERVER_OVERLOAD_RESPONSES,
+    SERVER_STATUS_CACHE_EVENTS,
     SERVER_TELEMETRY_REPORTS,
 )
 from nice_tpu.ops import scalar
+from nice_tpu.server.async_core import AsyncHTTPServer, Request, Response
 from nice_tpu.server.db import Db
 from nice_tpu.server.field_queue import U128_MAX, FieldQueue
+from nice_tpu.server.writer import DirectWriter, WriteActor
 
 log = logging.getLogger("nice_tpu.server")
 
@@ -117,19 +131,61 @@ class Metrics:
 class ApiContext:
     def __init__(self, db: Db):
         self.db = db
-        self.queue = FieldQueue(db)
+        # Single-writer DB actor: every mutation (claims, submits, renewals,
+        # telemetry upserts) is enqueued here and coalesced into batched
+        # transactions. NICE_TPU_WRITER=0 falls back to direct per-call
+        # transactions (useful for debugging; semantics are identical).
+        if os.environ.get("NICE_TPU_WRITER", "1") != "0":
+            self.writer = WriteActor(db)
+        else:
+            self.writer = DirectWriter(db)
+        self.queue = FieldQueue(db, writer=self.writer)
         self.metrics = Metrics()
         # Overload shed: when more than max_inflight requests are being
         # handled at once, new ones (except /metrics) get 503 + Retry-After
-        # instead of queueing unboundedly behind the thread-per-connection
-        # server. Clients honor the hint in retry_request.
+        # instead of queueing unboundedly behind the worker pool. Clients
+        # honor the hint in retry_request.
         self.max_inflight = int(os.environ.get("NICE_TPU_MAX_INFLIGHT", 128))
         self.retry_after_secs = int(os.environ.get("NICE_TPU_RETRY_AFTER_SECS", 2))
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Read-snapshot cache for the /status fleet block: dashboard polling
+        # is served from this instead of re-running the fleet queries every
+        # poll. Writes that change what the block reports (submissions,
+        # telemetry) invalidate it, so tests and operators never see stale
+        # data after their own write.
+        self.status_cache_ttl = float(
+            os.environ.get("NICE_TPU_STATUS_CACHE_SECS", 2.0)
+        )
+        self._status_cache: dict = {}
+        self._status_cache_lock = threading.Lock()
+
+    def write(self, fn, *args, **kwargs):
+        """Run one mutation through the writer actor, blocking for its
+        result (exceptions — notably IntegrityError — re-raise here)."""
+        return self.writer.call(fn, *args, **kwargs)
+
+    def cached_fleet_block(self) -> dict:
+        now = time.monotonic()
+        with self._status_cache_lock:
+            entry = self._status_cache.get("fleet")
+            if entry is not None and now - entry[0] < self.status_cache_ttl:
+                SERVER_STATUS_CACHE_EVENTS.labels("hit").inc()
+                return entry[1]
+        SERVER_STATUS_CACHE_EVENTS.labels("miss").inc()
+        block = build_fleet_block(self)
+        with self._status_cache_lock:
+            self._status_cache["fleet"] = (time.monotonic(), block)
+        return block
+
+    def invalidate_status_cache(self) -> None:
+        with self._status_cache_lock:
+            self._status_cache.pop("fleet", None)
 
     def enter_request(self) -> bool:
-        """Register an in-flight request; False means shed it (503)."""
+        """Register an in-flight request; False means shed it (503).
+        Used by the legacy thread-per-connection core; the async core
+        tracks dispatch depth on its event loop instead."""
         with self._inflight_lock:
             self._inflight += 1
             return self._inflight <= self.max_inflight
@@ -137,6 +193,10 @@ class ApiContext:
     def exit_request(self) -> None:
         with self._inflight_lock:
             self._inflight -= 1
+
+    def close(self) -> None:
+        self.queue.close()
+        self.writer.close()
 
 
 class ApiError(Exception):
@@ -146,55 +206,96 @@ class ApiError(Exception):
         self.message = message
 
 
-def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> DataToClient:
-    """Claim-strategy mix + queue fast path (reference api/src/main.rs:66-229)."""
+def _max_claim_block() -> int:
+    return max(1, int(os.environ.get("NICE_TPU_MAX_CLAIM_BLOCK", 128)))
+
+
+def _roll_claim_strategy(search_mode: SearchMode):
+    """The 80/15/4/1 detailed strategy mix (reference api/src/main.rs:66-229);
+    one roll covers a whole block."""
     if search_mode == SearchMode.NICEONLY:
-        claim_strategy, max_check_level, max_range_size = (
-            FieldClaimStrategy.NEXT, 0, U128_MAX,
-        )
+        return FieldClaimStrategy.NEXT, 0, U128_MAX
+    roll = random.randint(1, 100)
+    if roll <= 80:
+        claim_strategy, max_check_level = FieldClaimStrategy.THIN, 1
+    elif roll <= 95:
+        claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 1
+    elif roll <= 99:
+        claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 2
     else:
-        roll = random.randint(1, 100)
-        if roll <= 80:
-            claim_strategy, max_check_level = FieldClaimStrategy.THIN, 1
-        elif roll <= 95:
-            claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 1
-        elif roll <= 99:
-            claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 2
-        else:
-            claim_strategy, max_check_level = FieldClaimStrategy.RANDOM, 1
-        max_range_size = DETAILED_SEARCH_MAX_FIELD_SIZE
+        claim_strategy, max_check_level = FieldClaimStrategy.RANDOM, 1
+    return claim_strategy, max_check_level, DETAILED_SEARCH_MAX_FIELD_SIZE
 
-    field = None
+
+def _claim_fields(
+    ctx: ApiContext,
+    search_mode: SearchMode,
+    claim_strategy: FieldClaimStrategy,
+    max_check_level: int,
+    max_range_size: int,
+    count: int,
+):
+    """Pick up to count fields: queue fast path first, then the claim engine,
+    then the possibly-active fallback (reference api/src/main.rs:150-168).
+    Runs inside a writer-actor operation, so the pops + lease stamps of one
+    block are a single transaction."""
+    fields = []
     if search_mode == SearchMode.NICEONLY:
-        field = ctx.queue.claim_niceonly()
-        if field is None:
-            log.warning("niceonly queue exhausted; direct database claim")
-            field = ctx.db.try_claim_field(
-                FieldClaimStrategy.NEXT, ctx.db.claim_expiry_cutoff(), 0, max_range_size
+        fields = ctx.queue.claim_niceonly_many(count)
+        if len(fields) < count:
+            if not fields:
+                log.warning("niceonly queue exhausted; direct database claim")
+            fields += ctx.db._claim_batch(
+                FieldClaimStrategy.NEXT,
+                ctx.db.claim_expiry_cutoff(),
+                0,
+                max_range_size,
+                count - len(fields),
             )
-    elif claim_strategy == FieldClaimStrategy.THIN:
-        field = ctx.queue.claim_detailed_thin()
-
-    if field is None:
-        field = ctx.db.try_claim_field(
-            claim_strategy, ctx.db.claim_expiry_cutoff(), max_check_level, max_range_size
-        )
-    if field is None:
+    else:
+        if claim_strategy == FieldClaimStrategy.THIN:
+            fields = ctx.queue.claim_detailed_thin_many(count)
+        if len(fields) < count:
+            fields += ctx.db._claim_batch(
+                claim_strategy,
+                ctx.db.claim_expiry_cutoff(),
+                max_check_level,
+                max_range_size,
+                count - len(fields),
+            )
+    if not fields:
         # Everything is recently claimed: fall back to possibly-active fields
         # (reference api/src/main.rs:150-168).
         from nice_tpu.server.db import now_utc
 
-        field = ctx.db.try_claim_field(
-            FieldClaimStrategy.NEXT, now_utc(), max_check_level, max_range_size
+        fields = ctx.db._claim_batch(
+            FieldClaimStrategy.NEXT, now_utc(), max_check_level,
+            max_range_size, count,
         )
-    if field is None:
-        raise ApiError(
-            500,
-            f"Could not find any field with maximum check level {max_check_level}"
-            f" and maximum size {max_range_size}!",
-        )
+    return fields
 
-    claim = ctx.db.insert_claim(field.field_id, search_mode, user_ip)
+
+def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> DataToClient:
+    """Claim one field (the per-field compatibility path)."""
+    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
+        search_mode
+    )
+
+    def op():
+        fields = _claim_fields(
+            ctx, search_mode, claim_strategy, max_check_level, max_range_size, 1
+        )
+        if not fields:
+            raise ApiError(
+                500,
+                f"Could not find any field with maximum check level"
+                f" {max_check_level} and maximum size {max_range_size}!",
+            )
+        field = fields[0]
+        claim = ctx.db.insert_claim(field.field_id, search_mode, user_ip)
+        return field, claim
+
+    field, claim = ctx.write(op)
     log.info(
         "New Claim: mode=%s strategy=%s field=%d claim=%d",
         search_mode,
@@ -211,6 +312,67 @@ def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> Data
     )
 
 
+def handle_claim_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+    """POST /claim_block: N fields per round-trip under ONE block lease.
+
+    The strategy mix rolls once per block; every member claim row carries the
+    same block_id, so one /renew_claim {block_id} heartbeat re-arms all of
+    them and — because their last_claim_time is stamped and renewed together
+    — expiry releases the whole block at once. A partial block (fewer fields
+    than asked) is success, not an error."""
+    mode_arg = payload.get("mode") or payload.get("search_mode")
+    if mode_arg not in ("detailed", "niceonly"):
+        raise ApiError(400, f"mode must be detailed or niceonly, got {mode_arg!r}")
+    search_mode = (
+        SearchMode.DETAILED if mode_arg == "detailed" else SearchMode.NICEONLY
+    )
+    try:
+        count = int(payload.get("count", 8))
+    except (TypeError, ValueError):
+        raise ApiError(400, f"count must be an integer, got {payload.get('count')!r}")
+    count = max(1, min(count, _max_claim_block()))
+    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
+        search_mode
+    )
+
+    def op():
+        fields = _claim_fields(
+            ctx, search_mode, claim_strategy, max_check_level, max_range_size,
+            count,
+        )
+        if not fields:
+            raise ApiError(
+                500,
+                f"Could not find any field with maximum check level"
+                f" {max_check_level} and maximum size {max_range_size}!",
+            )
+        block_id = secrets.token_hex(12)
+        claims = ctx.db.insert_claims_block(
+            [f.field_id for f in fields], search_mode, user_ip, block_id
+        )
+        return block_id, fields, claims
+
+    block_id, fields, claims = ctx.write(op)
+    SERVER_BLOCK_LEASE_SIZE.observe(len(fields))
+    log.info(
+        "New Block Claim: mode=%s strategy=%s block=%s fields=%d",
+        search_mode, claim_strategy.value, block_id, len(fields),
+    )
+    return {
+        "block_id": block_id,
+        "fields": [
+            DataToClient(
+                claim_id=claim.claim_id,
+                base=field.base,
+                range_start=field.range_start,
+                range_end=field.range_end,
+                range_size=field.range_size,
+            ).to_json()
+            for claim, field in zip(claims, fields)
+        ],
+    }
+
+
 def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
     SERVER_DUPLICATE_SUBMITS.inc()
     log.info(
@@ -220,8 +382,11 @@ def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
     return {"status": "OK", "duplicate": True}
 
 
-def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
-    """Verify + persist a submission (reference api/src/main.rs:241-404).
+def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
+    """Read-side verification of one submission; returns
+    (data, claim, persist, elapsed_secs, mode_label) where persist is the
+    mutation closure to run through the writer (None = already accepted, the
+    exactly-once replay read-hit). Raises ApiError on rejection.
 
     Exactly-once: when the payload carries a submit_id (claim + content
     hash) that is already persisted, the reply is {"duplicate": true} and no
@@ -232,7 +397,7 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
     data = DataToServer.from_json(payload)
     if data.submit_id:
         if ctx.db.get_submission_by_submit_id(data.submit_id) is not None:
-            return _submit_duplicate_reply(ctx, data)
+            return data, None, None, 0.0, ""
     try:
         claim = ctx.db.get_claim_by_id(data.claim_id)
     except KeyError as e:
@@ -248,85 +413,90 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
 
     if claim.search_mode == SearchMode.NICEONLY:
         # Honor system: no verification (reference api/src/main.rs:278-300).
-        try:
+        def persist():
             ctx.db.insert_submission(
                 claim, data.username, data.client_version, user_ip, None,
                 numbers_expanded, elapsed_secs=elapsed_secs,
                 submit_id=data.submit_id,
             )
-        except sqlite3.IntegrityError:
-            return _submit_duplicate_reply(ctx, data)
-        if field.check_level == 0:
-            ctx.db.update_field_canon_and_cl(
-                field.field_id, field.canon_submission_id, 1
-            )
-    else:
-        if data.unique_distribution is None:
-            raise ApiError(
-                422, "Unique distribution must be present for detailed searches."
-            )
-        distribution = data.unique_distribution
-        distribution_expanded = distribution_stats.expand_distribution(
-            distribution, base
-        )
-        dist_total = sum(d.count for d in distribution)
-        if dist_total != field.range_size:
-            raise ApiError(
-                422,
-                f"Total distribution count is incorrect (submitted {dist_total},"
-                f" range was {field.range_size}).",
-            )
-        cutoff = number_stats.get_near_miss_cutoff(base)
-        for d in distribution_expanded:
-            if d.num_uniques > cutoff:
-                count_numbers = sum(
-                    1 for n in numbers_expanded if n.num_uniques == d.num_uniques
+            if field.check_level == 0:
+                ctx.db.update_field_canon_and_cl(
+                    field.field_id, field.canon_submission_id, 1
                 )
-                if count_numbers != d.count:
-                    raise ApiError(
-                        422,
-                        f"Count of nice numbers with {d.num_uniques} uniques does"
-                        f" not match distribution (submitted {count_numbers},"
-                        f" distribution claimed {d.count}).",
-                    )
-        above_cutoff = sum(d.count for d in distribution if d.num_uniques > cutoff)
-        if len(numbers_expanded) != above_cutoff:
-            raise ApiError(
-                422,
-                f"Count of nice numbers does not match distribution (submitted"
-                f" {len(numbers_expanded)}, distribution claimed {above_cutoff}).",
+
+        return data, claim, persist, elapsed_secs, "niceonly"
+
+    if data.unique_distribution is None:
+        raise ApiError(
+            422, "Unique distribution must be present for detailed searches."
+        )
+    distribution = data.unique_distribution
+    distribution_expanded = distribution_stats.expand_distribution(
+        distribution, base
+    )
+    dist_total = sum(d.count for d in distribution)
+    if dist_total != field.range_size:
+        raise ApiError(
+            422,
+            f"Total distribution count is incorrect (submitted {dist_total},"
+            f" range was {field.range_size}).",
+        )
+    cutoff = number_stats.get_near_miss_cutoff(base)
+    for d in distribution_expanded:
+        if d.num_uniques > cutoff:
+            count_numbers = sum(
+                1 for n in numbers_expanded if n.num_uniques == d.num_uniques
             )
-        # Server-side recomputation of every submitted number with the trusted
-        # engine (reference api/src/main.rs:350-359).
-        for n in numbers_expanded:
-            calculated = scalar.get_num_unique_digits(n.number, base)
-            if calculated != n.num_uniques:
+            if count_numbers != d.count:
                 raise ApiError(
                     422,
-                    f"Unique count for {n.number} is incorrect (submitted as"
-                    f" {n.num_uniques}, server calculated {calculated}).",
+                    f"Count of nice numbers with {d.num_uniques} uniques does"
+                    f" not match distribution (submitted {count_numbers},"
+                    f" distribution claimed {d.count}).",
                 )
-        try:
-            ctx.db.insert_submission(
-                claim,
-                data.username,
-                data.client_version,
-                user_ip,
-                distribution_expanded,
-                numbers_expanded,
-                elapsed_secs=elapsed_secs,
-                submit_id=data.submit_id,
+    above_cutoff = sum(d.count for d in distribution if d.num_uniques > cutoff)
+    if len(numbers_expanded) != above_cutoff:
+        raise ApiError(
+            422,
+            f"Count of nice numbers does not match distribution (submitted"
+            f" {len(numbers_expanded)}, distribution claimed {above_cutoff}).",
+        )
+    # Server-side recomputation of every submitted number with the trusted
+    # engine (reference api/src/main.rs:350-359).
+    for n in numbers_expanded:
+        calculated = scalar.get_num_unique_digits(n.number, base)
+        if calculated != n.num_uniques:
+            raise ApiError(
+                422,
+                f"Unique count for {n.number} is incorrect (submitted as"
+                f" {n.num_uniques}, server calculated {calculated}).",
             )
-        except sqlite3.IntegrityError:
-            return _submit_duplicate_reply(ctx, data)
+
+    def persist():
+        ctx.db.insert_submission(
+            claim,
+            data.username,
+            data.client_version,
+            user_ip,
+            distribution_expanded,
+            numbers_expanded,
+            elapsed_secs=elapsed_secs,
+            submit_id=data.submit_id,
+        )
         if field.check_level < 2:
             ctx.db.update_field_canon_and_cl(
                 field.field_id, field.canon_submission_id, 2
             )
 
-    mode_label = (
-        "niceonly" if claim.search_mode == SearchMode.NICEONLY else "detailed"
-    )
+    return data, claim, persist, elapsed_secs, "detailed"
+
+
+def _submit_accounting(
+    ctx: ApiContext, data: DataToServer, claim, mode_label: str,
+    elapsed_secs: float, user_ip: str,
+) -> None:
+    """Post-commit metrics / telemetry / flight-record for one accepted
+    submission (runs on the handler thread, never the writer)."""
     SERVER_FIELD_ELAPSED.labels(mode_label).observe(elapsed_secs)
     if data.telemetry is not None:
         # Piggybacked fleet snapshot: persisted after the submission so a
@@ -345,39 +515,141 @@ def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
         f" backend_downgrades={data.backend_downgrades}"
         if data.backend_downgrades else "",
     )
+
+
+def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+    """Verify + persist a submission (reference api/src/main.rs:241-404)."""
+    data, claim, persist, elapsed_secs, mode_label = _verify_submission(
+        ctx, payload, user_ip
+    )
+    if persist is None:
+        return _submit_duplicate_reply(ctx, data)
+    try:
+        ctx.write(persist)
+    except sqlite3.IntegrityError:
+        return _submit_duplicate_reply(ctx, data)
+    ctx.invalidate_status_cache()
+    _submit_accounting(ctx, data, claim, mode_label, elapsed_secs, user_ip)
     return {"status": "OK"}
+
+
+def handle_submit_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+    """POST /submit_block: batched results for a block claim.
+
+    Verification runs per item on the handler thread; all surviving persists
+    execute as ONE writer-actor operation, each under its own savepoint, so
+    a duplicate or failure in one item never rolls back its siblings
+    (exactly-once submit_id semantics hold per field inside the block). The
+    reply carries one result per submitted item, in order."""
+    subs = payload.get("submissions")
+    if not isinstance(subs, list) or not subs:
+        raise ApiError(400, "submissions must be a non-empty list")
+    if len(subs) > _max_claim_block():
+        raise ApiError(
+            400, f"too many submissions in one block (max {_max_claim_block()})"
+        )
+    prepared: list = []
+    for item in subs:
+        if not isinstance(item, dict):
+            prepared.append(ApiError(400, "each submission must be an object"))
+            continue
+        try:
+            prepared.append(_verify_submission(ctx, item, user_ip))
+        except ApiError as e:
+            prepared.append(e)
+
+    def batch_op():
+        outcomes = []
+        for prep in prepared:
+            if isinstance(prep, ApiError):
+                outcomes.append("rejected")
+                continue
+            persist = prep[2]
+            if persist is None:
+                outcomes.append("duplicate")
+                continue
+            try:
+                # Per-item savepoint: a duplicate replay (IntegrityError)
+                # rolls back this item only.
+                with ctx.db._lock, ctx.db._txn():
+                    persist()
+                outcomes.append("accepted")
+            except sqlite3.IntegrityError:
+                outcomes.append("duplicate")
+        return outcomes
+
+    outcomes = ctx.write(batch_op)
+    ctx.invalidate_status_cache()
+    results = []
+    counts = {"accepted": 0, "duplicates": 0, "rejected": 0}
+    for prep, outcome in zip(prepared, outcomes):
+        if isinstance(prep, ApiError):
+            counts["rejected"] += 1
+            results.append(
+                {"status": "error", "code": prep.status, "message": prep.message}
+            )
+            continue
+        data, claim, _persist, elapsed_secs, mode_label = prep
+        if outcome == "duplicate":
+            counts["duplicates"] += 1
+            results.append(_submit_duplicate_reply(ctx, data))
+        else:
+            counts["accepted"] += 1
+            _submit_accounting(
+                ctx, data, claim, mode_label, elapsed_secs, user_ip
+            )
+            results.append({"status": "OK"})
+    if isinstance(payload.get("telemetry"), dict):
+        # Block-level piggyback: one snapshot per block, not per field.
+        _persist_telemetry(ctx, payload["telemetry"], user_ip, "submission")
+    return {"status": "OK", "results": results, **counts}
 
 
 def handle_renew_claim(ctx: ApiContext, payload: dict) -> dict:
     """Claim-lease heartbeat: a client mid-scan re-arms its field's lease so
     the expiry predicate never hands the field to another client while this
     one is (provably) still alive. Submission elapsed time still measures
-    from the original claim (renewal touches only fields.last_claim_time)."""
+    from the original claim (renewal touches only fields.last_claim_time).
+
+    With {"block_id": ...} the heartbeat renews EVERY member of a block
+    claim in one statement."""
+    from nice_tpu.server.db import ts
+
+    block_id = payload.get("block_id")
+    if block_id is not None:
+        if not isinstance(block_id, str) or not block_id:
+            raise ApiError(400, "block_id must be a non-empty string")
+        renewed_at, count = ctx.write(ctx.db.renew_block, block_id)
+        if count == 0:
+            raise ApiError(404, f"Invalid block_id {block_id!r}")
+        return {
+            "status": "OK", "renewed_at": ts(renewed_at), "renewed": count,
+        }
     claim_id = payload.get("claim_id")
     if not isinstance(claim_id, int):
         raise ApiError(400, "claim_id must be an integer")
     try:
-        renewed_at = ctx.db.renew_claim(claim_id)
+        renewed_at = ctx.write(ctx.db.renew_claim, claim_id)
     except KeyError as e:
         raise ApiError(404, f"Invalid claim_id {claim_id}: {e}")
-    from nice_tpu.server.db import ts
-
     return {"status": "OK", "renewed_at": ts(renewed_at)}
 
 
 def _persist_telemetry(
     ctx: ApiContext, snap, user_ip: str, source: str
 ) -> bool:
-    """Upsert one client snapshot; False (never an error) when the snapshot
-    is unusable — telemetry is best-effort on both sides of the wire."""
+    """Upsert one client snapshot (through the writer actor); False (never
+    an error) when the snapshot is unusable — telemetry is best-effort on
+    both sides of the wire."""
     if not isinstance(snap, dict):
         return False
     try:
-        ctx.db.upsert_client_telemetry(snap, user_ip)
+        ctx.write(ctx.db.upsert_client_telemetry, snap, user_ip)
     except (ValueError, sqlite3.Error) as e:
         log.warning("discarding bad telemetry snapshot (%s): %s", source, e)
         return False
     SERVER_TELEMETRY_REPORTS.labels(source).inc()
+    ctx.invalidate_status_cache()
     return True
 
 
@@ -404,7 +676,9 @@ def fleet_active_secs() -> float:
 def build_fleet_block(ctx: ApiContext) -> dict:
     """The /status `fleet` block: claim health + per-client telemetry rolled
     up across the fleet. Side effect: refreshes the nice_fleet_* gauges so a
-    /metrics scrape right after /status agrees with it."""
+    /metrics scrape right after /status agrees with it. Served through
+    ctx.cached_fleet_block (short TTL + invalidation on submissions and
+    telemetry), so dashboard polling does not re-run these queries."""
     clients = ctx.db.get_client_telemetry(fleet_active_secs())
     claim_stats = ctx.db.get_fleet_claim_stats()
     elapsed = sorted(ctx.db.get_recent_field_elapsed())
@@ -485,12 +759,13 @@ def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
             raise ApiError(
                 400, f"Invalid submission_id {payload['submission_id']!r}"
             )
-        changed = ctx.db.disqualify_submission(submission_id)
+        changed = ctx.write(ctx.db.disqualify_submission, submission_id)
     elif "username" in payload:
-        changed = ctx.db.disqualify_user(str(payload["username"]))
+        changed = ctx.write(ctx.db.disqualify_user, str(payload["username"]))
     else:
         raise ApiError(400, "body must contain submission_id or username")
-    ctx.db.refresh_search_caches()
+    ctx.write(ctx.db.refresh_search_caches)
+    ctx.invalidate_status_cache()
     return {"status": "OK", "disqualified": changed}
 
 
@@ -503,68 +778,309 @@ NOT_FOUND_MESSAGE = (
 # "static" (file-like) or "other" so arbitrary 404 probes cannot mint
 # unbounded label values in the span-duration histogram.
 _SPAN_SEGS = frozenset(
-    {"claim", "submit", "renew_claim", "status", "metrics", "stats", "query",
-     "telemetry", "debug", "admin", "root"}
+    {"claim", "claim_block", "submit", "submit_block", "renew_claim",
+     "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
+     "root"}
 )
+
+_CORS_HEADERS = {
+    # CORS fairing parity (reference helpers.rs:95-126)
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type",
+}
+
+
+def _json_response(
+    status: int, body, content_type: str = "application/json",
+    extra_headers: dict | None = None,
+) -> Response:
+    raw = body.encode() if isinstance(body, str) else json.dumps(body).encode()
+    headers = {"Content-Type": content_type, **_CORS_HEADERS}
+    if extra_headers:
+        headers.update(extra_headers)
+    return Response(status=status, headers=headers, body=raw)
+
+
+def _error_response(status: int, message: str, extra_headers=None) -> Response:
+    return _json_response(
+        status, {"error": {"code": status, "message": message}},
+        extra_headers=extra_headers,
+    )
+
+
+def overload_response(ctx: ApiContext, endpoint: str) -> Response:
+    SERVER_OVERLOAD_RESPONSES.inc()
+    ctx.metrics.record(endpoint, 503, 0.0)
+    return _error_response(
+        503,
+        f"server overloaded (> {ctx.max_inflight} requests in flight);"
+        " retry later",
+        extra_headers={"Retry-After": str(ctx.retry_after_secs)},
+    )
+
+
+def _parse_json_body(request: Request) -> dict:
+    try:
+        return json.loads(request.body)
+    except json.JSONDecodeError as e:
+        raise ApiError(400, f"Invalid JSON body: {e}")
+
+
+def _static_response(path: str):
+    """Serve the analytics dashboard + browser search page from web/
+    (the reference hosts these as a separate static site; co-hosting
+    them keeps the single-binary deployment simple).
+
+    The web/ tree ships in checkouts, the sdist, and the docker
+    image, but NOT the wheel (it lives outside the package); a
+    wheel-installed server degrades to API-only with one logged
+    warning rather than silently 404ing."""
+    candidates = [
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            "web",
+        ),
+    ]
+    # A cwd-relative web/ is served ONLY when the operator opts in
+    # via NICE_WEB_ROOT (advisor r4: an implicit cwd fallback would
+    # publish whatever ./web happens to exist in the launch
+    # directory, with CORS *). NICE_WEB_ROOT also allows pointing at
+    # any custom static tree.
+    explicit = os.environ.get("NICE_WEB_ROOT")
+    if explicit:
+        candidates.insert(0, explicit)
+    web_root = next((c for c in candidates if os.path.isdir(c)), None)
+    if web_root is None:
+        if not getattr(_static_response, "_warned_no_web", False):
+            _static_response._warned_no_web = True
+            log.warning(
+                "no web/ directory found (wheel install?): dashboard "
+                "disabled, API-only — run from a checkout, the sdist, "
+                "or the docker image to serve the static site"
+            )
+        return None
+    rel = path.lstrip("/") or "index.html"
+    full = os.path.realpath(os.path.join(web_root, rel))
+    if os.path.isdir(full):
+        full = os.path.join(full, "index.html")
+    if not full.startswith(os.path.realpath(web_root) + os.sep):
+        return None
+    if not os.path.isfile(full):
+        return None
+    ctype = {
+        ".html": "text/html",
+        ".js": "application/javascript",
+        ".css": "text/css",
+        ".json": "application/json",
+    }.get(os.path.splitext(full)[1], "application/octet-stream")
+    with open(full, "rb") as f:
+        raw = f.read()
+    return Response(
+        200,
+        headers={"Content-Type": ctype, "Access-Control-Allow-Origin": "*"},
+        body=raw,
+    )
+
+
+def route_request(ctx: ApiContext, request: Request) -> Response:
+    """Transport-agnostic request router: the same function serves the async
+    core's worker pool and the legacy thread-per-connection handler."""
+    t0 = time.monotonic()
+    parsed = urlparse(request.target)
+    path = parsed.path.rstrip("/")
+    endpoint = path or "/"
+    method = request.method
+    status = 200
+    seg = (path.lstrip("/").split("/", 1)[0]) or "root"
+    # Distributed-trace continuation: a request stamped with a traceparent
+    # header (every api_client call inside a field's trace_context) gets its
+    # handler span joined to the client's trace — grep both JSON sinks for
+    # one trace_id and the whole claim -> scan -> submit lifecycle
+    # reconstructs.
+    span_seg = (
+        seg if seg in _SPAN_SEGS else ("static" if "." in seg else "other")
+    )
+    span_ctx = contextlib.ExitStack()
+    span_ctx.enter_context(
+        obs.trace_context(
+            obs.parse_traceparent(request.headers.get("traceparent"))
+        )
+    )
+    span_ctx.enter_context(obs.span(f"server.{span_seg}", method=method))
+    try:
+        # Chaos hook: server.<first path segment> (server.submit,
+        # server.claim, ...). Numeric actions inject that status before the
+        # real handler runs; "drop" closes the connection without a response
+        # (the client sees a mid-request crash).
+        act = faults.fire(f"server.{seg}", path=path, method=method)
+        if act is not None:
+            if act == "drop":
+                status = 0  # no response ever written
+                return Response(drop=True)
+            try:
+                code = int(act)
+            except ValueError:
+                code = 500
+            raise ApiError(code, f"injected fault: {act}")
+        user_ip = request.client_ip
+        if method == "OPTIONS":
+            return Response(204, headers=dict(_CORS_HEADERS))
+        if method == "GET" and path == "/claim/detailed":
+            return _json_response(
+                200, claim_helper(ctx, SearchMode.DETAILED, user_ip).to_json()
+            )
+        if method == "GET" and path == "/claim/niceonly":
+            return _json_response(
+                200, claim_helper(ctx, SearchMode.NICEONLY, user_ip).to_json()
+            )
+        if method == "GET" and path == "/claim/validate":
+            qs = parse_qs(parsed.query)
+            base_arg = qs.get("base", [None])[0]
+            try:
+                base_filter = int(base_arg) if base_arg else None
+            except ValueError:
+                raise ApiError(400, f"Invalid base {base_arg!r}")
+            try:
+                return _json_response(
+                    200, ctx.db.get_validation_field(base_filter).to_json()
+                )
+            except KeyError as e:
+                raise ApiError(404, f"No validation field available: {e}")
+        if method == "GET" and path == "/status":
+            return _json_response(
+                200,
+                {
+                    "status": "ok",
+                    "niceonly_queue_size": ctx.queue.niceonly_queue_size(),
+                    "detailed_thin_queue_size":
+                        ctx.queue.detailed_thin_queue_size(),
+                    "writer_queue_depth": ctx.writer.queue_depth(),
+                    "fleet": ctx.cached_fleet_block(),
+                },
+            )
+        if method == "GET" and path == "/debug/flight":
+            return _json_response(
+                200,
+                {
+                    "pid": os.getpid(),
+                    "capacity": obs.flight.RECORDER.capacity,
+                    "total_recorded": obs.flight.RECORDER.total_recorded(),
+                    "events": obs.flight.snapshot(),
+                },
+            )
+        if method == "GET" and path == "/metrics":
+            return _json_response(
+                200, ctx.metrics.render(), content_type="text/plain"
+            )
+        if method == "GET" and path == "/stats/bases":
+            return _json_response(200, ctx.db.get_base_stats())
+        if method == "GET" and path == "/stats/leaderboard":
+            qs = parse_qs(parsed.query)
+            return _json_response(
+                200, ctx.db.get_leaderboard(qs.get("mode", [None])[0])
+            )
+        if method == "GET" and path == "/stats/search_rate":
+            qs = parse_qs(parsed.query)
+            return _json_response(
+                200, ctx.db.get_search_rate(qs.get("mode", [None])[0])
+            )
+        if method in ("GET", "POST") and path == "/query":
+            # Public read-only ad-hoc SQL, the PostgREST-equivalent surface
+            # (reference schema/schema.sql:82-87 grants a web_anon role
+            # SELECT over the whole schema). GET takes ?sql=...; POST takes
+            # {"sql": ..., "params": [...]}. Hard-sandboxed in
+            # Db.public_query (read-only conn, authorizer, row/step caps).
+            if method == "GET":
+                qs = parse_qs(parsed.query)
+                sql = qs.get("sql", [None])[0]
+                qparams: list = []
+            else:
+                payload = _parse_json_body(request)
+                sql = payload.get("sql")
+                qparams = payload.get("params", [])
+                if not isinstance(qparams, list):
+                    raise ApiError(400, "params must be a list")
+            if not sql or not isinstance(sql, str):
+                raise ApiError(400, "missing sql")
+            try:
+                return _json_response(
+                    200, ctx.db.public_query(sql, tuple(qparams))
+                )
+            except sqlite3.Error as e:
+                raise ApiError(400, f"query rejected: {e}")
+        if method == "POST" and path == "/submit":
+            return _json_response(
+                200, handle_submit(ctx, _parse_json_body(request), user_ip)
+            )
+        if method == "POST" and path == "/claim_block":
+            return _json_response(
+                200,
+                handle_claim_block(ctx, _parse_json_body(request), user_ip),
+            )
+        if method == "POST" and path == "/submit_block":
+            return _json_response(
+                200,
+                handle_submit_block(ctx, _parse_json_body(request), user_ip),
+            )
+        if method == "POST" and path == "/telemetry":
+            return _json_response(
+                200, handle_telemetry(ctx, _parse_json_body(request), user_ip)
+            )
+        if method == "POST" and path == "/renew_claim":
+            return _json_response(
+                200, handle_renew_claim(ctx, _parse_json_body(request))
+            )
+        if method == "POST" and path == "/admin/disqualify":
+            return _json_response(
+                200,
+                handle_disqualify(
+                    ctx, _parse_json_body(request), request.headers
+                ),
+            )
+        if method == "GET":
+            static = _static_response(path)
+            if static is not None:
+                return static
+        status = 404
+        return _error_response(404, NOT_FOUND_MESSAGE)
+    except ApiError as e:
+        status = e.status
+        return _error_response(e.status, e.message)
+    except Exception as e:  # 500 with JSON body, never a stack dump
+        status = 500
+        log.exception("internal error handling %s %s", method, path)
+        return _error_response(500, f"Internal server error: {e}")
+    finally:
+        span_ctx.close()
+        ctx.metrics.record(endpoint, status, time.monotonic() - t0)
 
 
 def make_handler(ctx: ApiContext):
+    """Legacy thread-per-connection adapter over route_request (the
+    NICE_TPU_SERVER_CORE=thread escape hatch; shares every handler with the
+    async core)."""
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt, *args):  # route through logging
             log.debug("%s " + fmt, self.address_string(), *args)
 
-        def _send(self, status: int, body: dict | str,
-                  content_type="application/json", extra_headers=None):
-            raw = (
-                json.dumps(body).encode()
-                if not isinstance(body, str)
-                else body.encode()
-            )
-            self.send_response(status)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(raw)))
-            # CORS fairing parity (reference helpers.rs:95-126)
-            self.send_header("Access-Control-Allow-Origin", "*")
-            self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
-            self.send_header("Access-Control-Allow-Headers", "Content-Type")
-            for name, value in (extra_headers or {}).items():
-                self.send_header(name, value)
-            self.end_headers()
-            self.wfile.write(raw)
+        def _dispatch(self, method: str):
+            from nice_tpu.server.async_core import Headers
 
-        def _error(self, status: int, message: str, extra_headers=None):
-            self._send(
-                status, {"error": {"code": status, "message": message}},
-                extra_headers=extra_headers,
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            body = self.rfile.read(length) if length else b""
+            request = Request(
+                method=method,
+                target=self.path,
+                headers=Headers(self.headers.items()),
+                body=body,
+                client_ip=self.client_address[0],
             )
-
-        def _route(self, method: str):
-            t0 = time.monotonic()
             path = urlparse(self.path).path.rstrip("/")
-            endpoint = path or "/"
-            status = 200
             within_cap = ctx.enter_request()
-            seg = (path.lstrip("/").split("/", 1)[0]) or "root"
-            # Distributed-trace continuation: a request stamped with a
-            # traceparent header (every api_client call inside a field's
-            # trace_context) gets its handler span joined to the client's
-            # trace — grep both JSON sinks for one trace_id and the whole
-            # claim -> scan -> submit lifecycle reconstructs.
-            span_seg = (
-                seg if seg in _SPAN_SEGS
-                else ("static" if "." in seg else "other")
-            )
-            span_ctx = contextlib.ExitStack()
-            span_ctx.enter_context(
-                obs.trace_context(
-                    obs.parse_traceparent(self.headers.get("traceparent"))
-                )
-            )
-            span_ctx.enter_context(
-                obs.span(f"server.{span_seg}", method=method)
-            )
             try:
                 # Overload shed: past the in-flight cap, answer 503 with a
                 # Retry-After hint instead of queueing unboundedly. /metrics
@@ -574,255 +1090,68 @@ def make_handler(ctx: ApiContext):
                     and path != "/metrics"
                     and method != "OPTIONS"
                 ):
-                    SERVER_OVERLOAD_RESPONSES.inc()
-                    status = 503
-                    self._error(
-                        503,
-                        f"server overloaded (> {ctx.max_inflight} requests"
-                        " in flight); retry later",
-                        extra_headers={"Retry-After": str(ctx.retry_after_secs)},
-                    )
-                    return
-                # Chaos hook: server.<first path segment> (server.submit,
-                # server.claim, ...). Numeric actions inject that status
-                # before the real handler runs; "drop" closes the connection
-                # without a response (the client sees a mid-request crash).
-                act = faults.fire(f"server.{seg}", path=path, method=method)
-                if act is not None:
-                    if act == "drop":
-                        status = 0  # no response ever written
-                        self.close_connection = True
-                        return
-                    try:
-                        code = int(act)
-                    except ValueError:
-                        code = 500
-                    raise ApiError(code, f"injected fault: {act}")
-                user_ip = self.client_address[0]
-                if method == "OPTIONS":
-                    self.send_response(204)
-                    self.send_header("Access-Control-Allow-Origin", "*")
-                    self.send_header(
-                        "Access-Control-Allow-Methods", "GET, POST, OPTIONS"
-                    )
-                    self.send_header("Access-Control-Allow-Headers", "Content-Type")
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                if method == "GET" and path == "/claim/detailed":
-                    self._send(
-                        200, claim_helper(ctx, SearchMode.DETAILED, user_ip).to_json()
-                    )
-                elif method == "GET" and path == "/claim/niceonly":
-                    self._send(
-                        200, claim_helper(ctx, SearchMode.NICEONLY, user_ip).to_json()
-                    )
-                elif method == "GET" and path == "/claim/validate":
-                    qs = parse_qs(urlparse(self.path).query)
-                    base_arg = qs.get("base", [None])[0]
-                    try:
-                        base_filter = int(base_arg) if base_arg else None
-                    except ValueError:
-                        raise ApiError(400, f"Invalid base {base_arg!r}")
-                    try:
-                        self._send(
-                            200,
-                            ctx.db.get_validation_field(base_filter).to_json(),
-                        )
-                    except KeyError as e:
-                        raise ApiError(404, f"No validation field available: {e}")
-                elif method == "GET" and path == "/status":
-                    self._send(
-                        200,
-                        {
-                            "status": "ok",
-                            "niceonly_queue_size": ctx.queue.niceonly_queue_size(),
-                            "detailed_thin_queue_size": ctx.queue.detailed_thin_queue_size(),
-                            "fleet": build_fleet_block(ctx),
-                        },
-                    )
-                elif method == "GET" and path == "/debug/flight":
-                    self._send(
-                        200,
-                        {
-                            "pid": os.getpid(),
-                            "capacity": obs.flight.RECORDER.capacity,
-                            "total_recorded":
-                                obs.flight.RECORDER.total_recorded(),
-                            "events": obs.flight.snapshot(),
-                        },
-                    )
-                elif method == "GET" and path == "/metrics":
-                    self._send(
-                        200, ctx.metrics.render(), content_type="text/plain"
-                    )
-                elif method == "GET" and path == "/stats/bases":
-                    self._send(200, ctx.db.get_base_stats())
-                elif method == "GET" and path == "/stats/leaderboard":
-                    qs = parse_qs(urlparse(self.path).query)
-                    self._send(
-                        200, ctx.db.get_leaderboard(qs.get("mode", [None])[0])
-                    )
-                elif method == "GET" and path == "/stats/search_rate":
-                    qs = parse_qs(urlparse(self.path).query)
-                    self._send(
-                        200, ctx.db.get_search_rate(qs.get("mode", [None])[0])
-                    )
-                elif method in ("GET", "POST") and path == "/query":
-                    # Public read-only ad-hoc SQL, the PostgREST-equivalent
-                    # surface (reference schema/schema.sql:82-87 grants a
-                    # web_anon role SELECT over the whole schema). GET takes
-                    # ?sql=...; POST takes {"sql": ..., "params": [...]}.
-                    # Hard-sandboxed in Db.public_query (read-only conn,
-                    # authorizer, row/step caps).
-                    if method == "GET":
-                        qs = parse_qs(urlparse(self.path).query)
-                        sql = qs.get("sql", [None])[0]
-                        qparams: list = []
-                    else:
-                        length = int(self.headers.get("Content-Length", 0))
-                        try:
-                            payload = json.loads(self.rfile.read(length))
-                        except json.JSONDecodeError as e:
-                            raise ApiError(400, f"Invalid JSON body: {e}")
-                        sql = payload.get("sql")
-                        qparams = payload.get("params", [])
-                        if not isinstance(qparams, list):
-                            raise ApiError(400, "params must be a list")
-                    if not sql or not isinstance(sql, str):
-                        raise ApiError(400, "missing sql")
-                    try:
-                        self._send(
-                            200, ctx.db.public_query(sql, tuple(qparams))
-                        )
-                    except sqlite3.Error as e:
-                        raise ApiError(400, f"query rejected: {e}")
-                elif method == "GET" and self._try_static(path):
-                    pass  # served from web/
-                elif method == "POST" and path == "/submit":
-                    length = int(self.headers.get("Content-Length", 0))
-                    try:
-                        payload = json.loads(self.rfile.read(length))
-                    except json.JSONDecodeError as e:
-                        raise ApiError(400, f"Invalid JSON body: {e}")
-                    self._send(200, handle_submit(ctx, payload, user_ip))
-                elif method == "POST" and path == "/telemetry":
-                    length = int(self.headers.get("Content-Length", 0))
-                    try:
-                        payload = json.loads(self.rfile.read(length))
-                    except json.JSONDecodeError as e:
-                        raise ApiError(400, f"Invalid JSON body: {e}")
-                    self._send(200, handle_telemetry(ctx, payload, user_ip))
-                elif method == "POST" and path == "/renew_claim":
-                    length = int(self.headers.get("Content-Length", 0))
-                    try:
-                        payload = json.loads(self.rfile.read(length))
-                    except json.JSONDecodeError as e:
-                        raise ApiError(400, f"Invalid JSON body: {e}")
-                    self._send(200, handle_renew_claim(ctx, payload))
-                elif method == "POST" and path == "/admin/disqualify":
-                    length = int(self.headers.get("Content-Length", 0))
-                    try:
-                        payload = json.loads(self.rfile.read(length))
-                    except json.JSONDecodeError as e:
-                        raise ApiError(400, f"Invalid JSON body: {e}")
-                    self._send(200, handle_disqualify(ctx, payload, self.headers))
+                    resp = overload_response(ctx, path or "/")
                 else:
-                    status = 404
-                    self._error(404, NOT_FOUND_MESSAGE)
-            except ApiError as e:
-                status = e.status
-                self._error(e.status, e.message)
-            except Exception as e:  # 500 with JSON body, never a stack dump
-                status = 500
-                log.exception("internal error handling %s %s", method, path)
-                self._error(500, f"Internal server error: {e}")
+                    resp = route_request(ctx, request)
             finally:
-                span_ctx.close()
                 ctx.exit_request()
-                ctx.metrics.record(endpoint, status, time.monotonic() - t0)
-
-        def _try_static(self, path: str) -> bool:
-            """Serve the analytics dashboard + browser search page from web/
-            (the reference hosts these as a separate static site; co-hosting
-            them keeps the single-binary deployment simple).
-
-            The web/ tree ships in checkouts, the sdist, and the docker
-            image, but NOT the wheel (it lives outside the package); a
-            wheel-installed server degrades to API-only with one logged
-            warning rather than silently 404ing."""
-            import os
-
-            candidates = [
-                os.path.join(
-                    os.path.dirname(
-                        os.path.dirname(os.path.dirname(__file__))
-                    ),
-                    "web",
-                ),
-            ]
-            # A cwd-relative web/ is served ONLY when the operator opts in
-            # via NICE_WEB_ROOT (advisor r4: an implicit cwd fallback would
-            # publish whatever ./web happens to exist in the launch
-            # directory, with CORS *). NICE_WEB_ROOT also allows pointing at
-            # any custom static tree.
-            explicit = os.environ.get("NICE_WEB_ROOT")
-            if explicit:
-                candidates.insert(0, explicit)
-            web_root = next((c for c in candidates if os.path.isdir(c)), None)
-            if web_root is None:
-                if not getattr(make_handler, "_warned_no_web", False):
-                    make_handler._warned_no_web = True
-                    log.warning(
-                        "no web/ directory found (wheel install?): dashboard "
-                        "disabled, API-only — run from a checkout, the sdist, "
-                        "or the docker image to serve the static site"
-                    )
-                return False
-            rel = path.lstrip("/") or "index.html"
-            full = os.path.realpath(os.path.join(web_root, rel))
-            if os.path.isdir(full):
-                full = os.path.join(full, "index.html")
-            if not full.startswith(os.path.realpath(web_root) + os.sep):
-                return False
-            if not os.path.isfile(full):
-                return False
-            ctype = {
-                ".html": "text/html",
-                ".js": "application/javascript",
-                ".css": "text/css",
-                ".json": "application/json",
-            }.get(os.path.splitext(full)[1], "application/octet-stream")
-            with open(full, "rb") as f:
-                raw = f.read()
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(raw)))
-            self.send_header("Access-Control-Allow-Origin", "*")
+            if resp.drop:
+                self.close_connection = True
+                return
+            self.send_response(resp.status)
+            headers_out = dict(resp.headers)
+            headers_out.setdefault("Content-Type", "application/json")
+            headers_out["Content-Length"] = str(len(resp.body))
+            for name, value in headers_out.items():
+                self.send_header(name, value)
             self.end_headers()
-            self.wfile.write(raw)
-            return True
+            self.wfile.write(resp.body)
+            if resp.close:
+                self.close_connection = True
 
         def do_GET(self):
-            self._route("GET")
+            self._dispatch("GET")
 
         def do_POST(self):
-            self._route("POST")
+            self._dispatch("POST")
 
         def do_OPTIONS(self):
-            self._route("OPTIONS")
+            self._dispatch("OPTIONS")
 
     return Handler
 
 
 def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True):
+    """Build the server (async core by default; NICE_TPU_SERVER_CORE=thread
+    selects the legacy ThreadingHTTPServer). The returned object exposes
+    serve_forever() / shutdown() / server_address either way."""
     db = Db(db_path)
     ctx = ApiContext(db)
     if prefill:
         ctx.queue.refill_niceonly()
         ctx.queue.refill_detailed_thin()
-    server = ThreadingHTTPServer((host, port), make_handler(ctx))
-    log.info("nice-tpu API listening on %s:%d (db=%s)", host, port, db_path)
+    core = os.environ.get("NICE_TPU_SERVER_CORE", "async").lower()
+    if core == "thread":
+        server = ThreadingHTTPServer((host, port), make_handler(ctx))
+    else:
+        def _shed(request: Request):
+            p = urlparse(request.target).path.rstrip("/")
+            if p == "/metrics" or request.method == "OPTIONS":
+                return None
+            return overload_response(ctx, p or "/")
+
+        server = AsyncHTTPServer(
+            host,
+            port,
+            router=lambda req: route_request(ctx, req),
+            max_inflight=ctx.max_inflight,
+            shed=_shed,
+        )
+    server.context = ctx  # reachable for tests / debugging
+    log.info(
+        "nice-tpu API listening on %s:%d (db=%s, core=%s)",
+        host, server.server_address[1], db_path, core,
+    )
     return server
 
 

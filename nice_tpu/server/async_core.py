@@ -1,0 +1,278 @@
+"""Async request core: one event loop for I/O, a bounded worker pool for
+handlers.
+
+The stdlib ThreadingHTTPServer spends one OS thread per open CONNECTION,
+which caps the fleet at a few hundred clients. This core accepts and parses
+HTTP/1.1 keep-alive connections on a single asyncio event loop (10k open
+sockets are cheap there) and dispatches each complete request to a bounded
+ThreadPoolExecutor running the transport-agnostic router from
+nice_tpu.server.app — the selector-driven, bounded-worker shape of the
+reference's Rocket/tokio host loop. DB writes inside the handlers are
+further funneled through the single-writer actor (server/writer.py), so
+worker-thread count never multiplies SQLite writers.
+
+The public surface deliberately mimics socketserver: serve() returns an
+object with serve_forever(), shutdown(), and server_address, because every
+test fixture and smoke script drives the server exactly that way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from http.client import responses as _REASONS
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 256 * 1024 * 1024
+
+
+class Headers:
+    """Case-insensitive header view (the subset handlers actually use)."""
+
+    def __init__(self, pairs):
+        self._d = {}
+        for k, v in pairs:
+            self._d[k.lower()] = v
+
+    def get(self, key: str, default=None):
+        return self._d.get(key.lower(), default)
+
+    def items(self):
+        return self._d.items()
+
+
+@dataclass
+class Request:
+    method: str
+    target: str  # raw path + query, as received
+    headers: Headers
+    body: bytes
+    client_ip: str
+
+
+@dataclass
+class Response:
+    status: int = 200
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+    drop: bool = False  # close the connection without writing anything
+    close: bool = False  # write the response, then close
+
+
+Router = Callable[[Request], Response]
+
+
+class AsyncHTTPServer:
+    """Event-loop front end + bounded-worker dispatch.
+
+    router runs on a pool thread and must return a Response. shed, when
+    provided, is consulted on the LOOP thread once more than max_inflight
+    requests are dispatched-but-unfinished; returning a Response answers
+    immediately without touching the pool (the overload path must not queue
+    behind the very backlog it exists to shed), returning None lets the
+    request through regardless (exempt endpoints like /metrics)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        router: Router,
+        max_workers: Optional[int] = None,
+        max_inflight: Optional[int] = None,
+        shed: Optional[Callable[[Request], Optional[Response]]] = None,
+    ):
+        self.router = router
+        self.shed = shed
+        self.max_inflight = max_inflight or 0
+        self._sock = socket.create_server(
+            (host, port), backlog=1024, reuse_port=False
+        )
+        self._sock.setblocking(False)
+        self.server_address = self._sock.getsockname()[:2]
+        workers = max_workers or int(
+            os.environ.get("NICE_TPU_SERVER_WORKERS", 32)
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="nice-srv"
+        )
+        self._loop = asyncio.new_event_loop()
+        self._stop = asyncio.Event()
+        self._started = threading.Event()
+        self._done = threading.Event()
+        self._inflight = 0  # loop-thread only
+
+    # -- socketserver-compatible surface -----------------------------------
+
+    def serve_forever(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._started.set()
+        try:
+            self._loop.run_until_complete(self._main())
+            pending = asyncio.all_tasks(self._loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            with contextlib.suppress(Exception):
+                self._loop.close()
+            self._pool.shutdown(wait=False)
+            self._done.set()
+
+    def shutdown(self) -> None:
+        if not self._started.is_set():
+            # serve_forever never ran; just release the port.
+            with contextlib.suppress(OSError):
+                self._sock.close()
+            self._done.set()
+            return
+        with contextlib.suppress(RuntimeError):
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._done.wait(timeout=10)
+
+    def server_close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.close()
+
+    # -- event loop ---------------------------------------------------------
+
+    async def _main(self) -> None:
+        server = await asyncio.start_server(
+            self._handle_conn, sock=self._sock, limit=MAX_HEADER_BYTES
+        )
+        await self._stop.wait()
+        server.close()
+        with contextlib.suppress(Exception):
+            await asyncio.wait_for(server.wait_closed(), timeout=2)
+
+    async def _handle_conn(self, reader, writer) -> None:
+        peer = writer.get_extra_info("peername")
+        client_ip = peer[0] if isinstance(peer, tuple) and peer else ""
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (
+                    asyncio.IncompleteReadError,
+                    asyncio.LimitOverrunError,
+                    ConnectionError,
+                    OSError,
+                ):
+                    return
+                parsed = self._parse_head(head)
+                if parsed is None:
+                    await self._write_response(
+                        writer,
+                        Response(400, body=b'{"error":"malformed request"}'),
+                        keep_alive=False,
+                    )
+                    return
+                method, target, version, headers = parsed
+                try:
+                    length = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    length = -1
+                if length < 0 or length > MAX_BODY_BYTES:
+                    await self._write_response(
+                        writer,
+                        Response(400, body=b'{"error":"bad content-length"}'),
+                        keep_alive=False,
+                    )
+                    return
+                body = b""
+                if length:
+                    try:
+                        body = await reader.readexactly(length)
+                    except (asyncio.IncompleteReadError, ConnectionError):
+                        return
+                request = Request(method, target, headers, body, client_ip)
+                response = None
+                if (
+                    self.shed is not None
+                    and self.max_inflight
+                    and self._inflight >= self.max_inflight
+                ):
+                    response = self.shed(request)
+                if response is None:
+                    self._inflight += 1
+                    try:
+                        response = await loop.run_in_executor(
+                            self._pool, self._safe_route, request
+                        )
+                    finally:
+                        self._inflight -= 1
+                if response.drop:
+                    return  # chaos "drop": vanish without a response
+                keep = self._keep_alive(version, headers) and not response.close
+                await self._write_response(writer, response, keep)
+                if not keep:
+                    return
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    def _safe_route(self, request: Request) -> Response:
+        try:
+            return self.router(request)
+        except Exception as e:  # the router has its own 500 path; last resort
+            log.exception("router crashed on %s %s", request.method, request.target)
+            return Response(
+                500,
+                body=(
+                    b'{"error":{"code":500,"message":"Internal server error: '
+                    + str(e).encode(errors="replace")[:200]
+                    + b'"}}'
+                ),
+            )
+
+    @staticmethod
+    def _parse_head(head: bytes):
+        try:
+            text = head.decode("latin-1")
+            request_line, *header_lines = text.split("\r\n")
+            method, target, version = request_line.split(" ", 2)
+            pairs = []
+            for line in header_lines:
+                if not line:
+                    continue
+                name, _, value = line.partition(":")
+                pairs.append((name.strip(), value.strip()))
+            return method.upper(), target, version.strip(), Headers(pairs)
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _keep_alive(version: str, headers: Headers) -> bool:
+        conn = (headers.get("connection") or "").lower()
+        if version == "HTTP/1.1":
+            return conn != "close"
+        return conn == "keep-alive"
+
+    @staticmethod
+    async def _write_response(writer, response: Response, keep_alive: bool):
+        reason = _REASONS.get(response.status, "Unknown")
+        lines = [f"HTTP/1.1 {response.status} {reason}"]
+        headers = dict(response.headers)
+        headers.setdefault("Content-Type", "application/json")
+        headers["Content-Length"] = str(len(response.body))
+        headers["Connection"] = "keep-alive" if keep_alive else "close"
+        for name, value in headers.items():
+            lines.append(f"{name}: {value}")
+        head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+        try:
+            writer.write(head + response.body)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass

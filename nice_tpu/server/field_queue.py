@@ -3,18 +3,23 @@
 Serving claims from memory cuts claim latency from a DB round-trip to a deque
 pop (the reference measured 90-100ms -> 3-5ms, CHANGELOG.md:42). Queues refill
 by bulk-claiming when they drop to the threshold (reference
-api/src/field_queue.rs:16-23, 49-62).
+api/src/field_queue.rs:16-23, 49-62), and the refill thread also wakes on a
+low-water poll timer so inventory recovers even when no claim traffic trips
+the threshold signal — this is the continuously running field pre-generation
+pipeline feeding block claims.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from collections import deque
 from typing import Optional
 
 from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
 from nice_tpu.core.types import FieldRecord
+from nice_tpu.obs.series import SERVER_FIELD_QUEUE_REFILLS
 from nice_tpu.server.db import Db
 
 log = logging.getLogger(__name__)
@@ -27,6 +32,10 @@ DETAILED_REFILL_AMOUNT = 100
 U128_MAX = (1 << 128) - 1
 
 
+def _poll_secs() -> float:
+    return float(os.environ.get("NICE_TPU_QUEUE_POLL_SECS", 5.0))
+
+
 class FieldQueue:
     """Thread-safe niceonly + detailed-thin pre-claim queues.
 
@@ -35,10 +44,17 @@ class FieldQueue:
     bulk-claim latency (the whole point of the queues — the reference's
     90-100 ms -> 3-5 ms win, CHANGELOG.md:42 — which an inline refill would
     hand right back to whichever client drew the short straw). An EMPTY queue
-    returns None and the caller falls back to a direct DB claim."""
+    returns None (or a short list from the _many variants) and the caller
+    falls back to a direct DB claim.
 
-    def __init__(self, db: Db, start_thread: bool = True):
+    When constructed with a writer (the single-writer DB actor), refill
+    bulk-claims run through it, so their lease-stamp transactions coalesce
+    with the rest of the server's write traffic instead of competing for
+    BEGIN IMMEDIATE."""
+
+    def __init__(self, db: Db, start_thread: bool = True, writer=None):
         self.db = db
+        self.writer = writer
         self._niceonly: deque[FieldRecord] = deque()
         self._detailed_thin: deque[FieldRecord] = deque()
         self._lock = threading.Lock()
@@ -68,6 +84,9 @@ class FieldQueue:
         if not stranded:
             return
         try:
+            # Direct DB call on purpose: close() may run after (or during)
+            # writer shutdown, and the release must not depend on actor
+            # ordering.
             released = self.db.release_field_claims(stranded)
             log.info(
                 "released %d pre-claimed queue fields back to the DB", released
@@ -79,7 +98,10 @@ class FieldQueue:
 
     def _refill_loop(self) -> None:
         while not self._stop.is_set():
-            self._refill_wanted.wait()
+            # Event OR low-water poll: block claims can drain a queue between
+            # threshold signals, and an idle server should rebuild inventory
+            # without waiting for the next claimant.
+            self._refill_wanted.wait(timeout=_poll_secs())
             self._refill_wanted.clear()
             if self._stop.is_set():
                 return
@@ -100,38 +122,62 @@ class FieldQueue:
             return len(self._detailed_thin)
 
     def claim_niceonly(self) -> Optional[FieldRecord]:
+        got = self.claim_niceonly_many(1)
+        return got[0] if got else None
+
+    def claim_detailed_thin(self) -> Optional[FieldRecord]:
+        got = self.claim_detailed_thin_many(1)
+        return got[0] if got else None
+
+    def claim_niceonly_many(self, count: int) -> list[FieldRecord]:
+        """Pop up to count fields (block claims); short list when low."""
         with self._lock:
-            field = self._niceonly.popleft() if self._niceonly else None
+            fields = [
+                self._niceonly.popleft()
+                for _ in range(min(count, len(self._niceonly)))
+            ]
             low = len(self._niceonly) <= REFILL_THRESHOLD
         if low:
             self._refill_wanted.set()
-        return field
+        return fields
 
-    def claim_detailed_thin(self) -> Optional[FieldRecord]:
+    def claim_detailed_thin_many(self, count: int) -> list[FieldRecord]:
         with self._lock:
-            field = (
-                self._detailed_thin.popleft() if self._detailed_thin else None
-            )
+            fields = [
+                self._detailed_thin.popleft()
+                for _ in range(min(count, len(self._detailed_thin)))
+            ]
             low = len(self._detailed_thin) <= DETAILED_REFILL_THRESHOLD
         if low:
             self._refill_wanted.set()
-        return field
+        return fields
+
+    def _bulk_claim(self, fn, *args):
+        if self.writer is not None:
+            return self.writer.call(fn, *args)
+        return fn(*args)
 
     def refill_niceonly(self) -> None:
         try:
-            fields = self.db.bulk_claim_fields(
-                REFILL_AMOUNT, self.db.claim_expiry_cutoff(), 0, U128_MAX
+            fields = self._bulk_claim(
+                self.db.bulk_claim_fields,
+                REFILL_AMOUNT,
+                self.db.claim_expiry_cutoff(),
+                0,
+                U128_MAX,
             )
         except Exception:
             log.exception("niceonly queue refill failed")
             return
         with self._lock:
             self._niceonly.extend(fields)
+        SERVER_FIELD_QUEUE_REFILLS.labels("niceonly").inc()
         log.info("refilled niceonly queue with %d fields", len(fields))
 
     def refill_detailed_thin(self) -> None:
         try:
-            fields = self.db.bulk_claim_thin_fields(
+            fields = self._bulk_claim(
+                self.db.bulk_claim_thin_fields,
                 DETAILED_REFILL_AMOUNT,
                 self.db.claim_expiry_cutoff(),
                 1,
@@ -142,4 +188,5 @@ class FieldQueue:
             return
         with self._lock:
             self._detailed_thin.extend(fields)
+        SERVER_FIELD_QUEUE_REFILLS.labels("detailed_thin").inc()
         log.info("refilled detailed-thin queue with %d fields", len(fields))

@@ -1,0 +1,188 @@
+"""Single-writer DB actor: coalesces mutations into batched transactions.
+
+All server-side mutations (claims, submits, renewals, telemetry upserts) are
+enqueued to ONE writer thread, which drains the queue and wraps each drained
+batch in a single BEGIN IMMEDIATE transaction. Every operation inside the
+batch runs under its own SAVEPOINT (Db._Txn nests automatically), so a
+per-operation failure — a duplicate submit_id's IntegrityError is the
+important one — rolls back only that operation while the rest of the batch
+commits with one fsync. Under load this turns N fsync-bound transactions into
+one, which is where SQLite write throughput actually comes from; it is the
+SQLite analog of the reference's Postgres connection pool absorbing
+concurrent writers.
+
+Callers block on a Future for their result, so the API surface of the Db
+methods is unchanged — handle_submit still sees IntegrityError raised from
+insert_submission, just via the future.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from nice_tpu.obs.series import (
+    SERVER_WRITE_BATCH_SIZE,
+    SERVER_WRITER_QUEUE_DEPTH,
+)
+from nice_tpu.server.db import Db
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+class WriterClosed(RuntimeError):
+    pass
+
+
+class WriteActor:
+    """One writer thread draining a mutation queue into batched transactions.
+
+    max_batch bounds how many operations share one transaction;
+    coalesce_secs is how long the drain loop lingers for stragglers after the
+    queue momentarily empties (amortizing the fsync further under bursty
+    load without adding latency when idle — the first op in a batch never
+    waits).
+    """
+
+    def __init__(
+        self,
+        db: Db,
+        max_batch: int | None = None,
+        coalesce_secs: float | None = None,
+        start: bool = True,
+    ):
+        self.db = db
+        self.max_batch = max_batch or int(
+            os.environ.get("NICE_TPU_WRITER_MAX_BATCH", 64)
+        )
+        self.coalesce_secs = (
+            float(os.environ.get("NICE_TPU_WRITER_COALESCE_SECS", 0.002))
+            if coalesce_secs is None
+            else coalesce_secs
+        )
+        self._q: queue.Queue = queue.Queue()
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, name="db-writer", daemon=True
+            )
+            self._thread.start()
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        """Enqueue one mutation; the Future resolves to fn's return value
+        (or its exception) once the batch containing it has committed."""
+        if self._closed:
+            raise WriterClosed("writer actor is closed")
+        fut: Future = Future()
+        self._q.put((fut, fn, args, kwargs))
+        return fut
+
+    def call(self, fn: Callable, *args, **kwargs) -> Any:
+        """Enqueue and block for the result (the common handler-thread path)."""
+        return self.submit(fn, *args, **kwargs).result()
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def close(self) -> None:
+        """Stop accepting work, drain what's queued, and join the thread."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- writer thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        import time
+
+        stopping = False
+        while not stopping:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self.coalesce_secs
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    if (
+                        self.coalesce_secs <= 0
+                        or time.monotonic() >= deadline
+                    ):
+                        break
+                    time.sleep(min(0.0005, self.coalesce_secs))
+                    continue
+                if nxt is _STOP:
+                    stopping = True
+                    break
+                batch.append(nxt)
+            SERVER_WRITER_QUEUE_DEPTH.set(self._q.qsize())
+            SERVER_WRITE_BATCH_SIZE.observe(len(batch))
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: list) -> None:
+        # Futures resolve only AFTER the outer transaction commits: an
+        # operation that "succeeded" into a savepoint is not durable until
+        # then, and telling the caller OK before COMMIT would break the
+        # exactly-once story if the commit failed.
+        settled: list[tuple[Future, Any, BaseException | None]] = []
+        try:
+            with self.db._lock, self.db._txn():
+                for fut, fn, args, kwargs in batch:
+                    try:
+                        with self.db._txn():
+                            out = fn(*args, **kwargs)
+                        settled.append((fut, out, None))
+                    except BaseException as e:
+                        settled.append((fut, None, e))
+        except BaseException as outer:
+            log.exception("writer batch transaction failed (%d ops)", len(batch))
+            done = {id(f) for f, _, _ in settled}
+            for fut, _, err in settled:
+                fut.set_exception(err if err is not None else outer)
+            for fut, _fn, _a, _k in batch:
+                if id(fut) not in done:
+                    fut.set_exception(outer)
+            return
+        for fut, out, err in settled:
+            if err is None:
+                fut.set_result(out)
+            else:
+                fut.set_exception(err)
+
+
+class DirectWriter:
+    """Writer-shaped pass-through used when the actor is disabled
+    (NICE_TPU_WRITER=0) or in unit tests: same interface, no thread, each
+    call is its own ordinary transaction."""
+
+    def __init__(self, db: Db):
+        self.db = db
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        fut: Future = Future()
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:
+            fut.set_exception(e)
+        return fut
+
+    def call(self, fn: Callable, *args, **kwargs) -> Any:
+        return fn(*args, **kwargs)
+
+    def queue_depth(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass

@@ -4,11 +4,16 @@ Runs a real server subprocess and real client subprocesses under a
 deterministic fault schedule (NICE_TPU_FAULTS) and a genuine mid-run server
 SIGKILL + restart, then asserts the ledger came out exactly right anyway:
 
+  Clients run on the BLOCK-LEASE path (NICE_TPU_CLAIM_BLOCK=2): one
+  /claim_block hands each run two fields under one lease and one
+  /submit_block lands both results, so the chaos rides the batched
+  coordination tier, not the per-field compatibility path.
+
   fault schedule (seed pinned so every run injects the same faults):
-    * http.submit:drop_response@0.4 — the server processes the submit but
-      the client sees a network error and retries (seed 2 makes the FIRST
-      submit response of every client run drop), forcing the exactly-once
-      submit_id replay path;
+    * http.submit_block:drop_response@0.4 (plus http.submit for any spooled
+      per-field replays) — the server processes the submit but the client
+      sees a network error and retries, forcing the exactly-once submit_id
+      replay path for every member of the block;
     * engine.dispatch:raise@batch=2 — one injected dispatch failure per
       client run, forcing the jnp -> scalar mid-field backend fallback;
   plus: the server is SIGKILLed while client run 2 is processing its field
@@ -44,9 +49,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BASE = 22  # full valid range [234256, 656395)
-FIELD_SIZE = 150_000  # -> 3 fields over the base range
-FAULT_SPEC = "http.submit:drop_response@0.4,engine.dispatch:raise@batch=2"
-FAULT_SEED = "2"  # first submit response drops, a later attempt delivers
+FIELD_SIZE = 75_000  # -> 6 fields over the base range
+BLOCK = 2  # fields per claim_block lease -> 3 client runs cover the base
+FAULT_SPEC = (
+    "http.submit_block:drop_response@0.4,"
+    "http.submit:drop_response@0.4,"
+    "engine.dispatch:raise@batch=2"
+)
+FAULT_SEED = "2"  # pinned: same drops every run; a later attempt delivers
 RUN_TIMEOUT = 300
 OUTAGE_SECS = 2.5
 POLL_SECS = 0.05
@@ -129,6 +139,7 @@ def main() -> int:
         os.environ,
         NICE_TPU_FAULTS=FAULT_SPEC,
         NICE_TPU_FAULTS_SEED=FAULT_SEED,
+        NICE_TPU_CLAIM_BLOCK=str(BLOCK),
     )
     client_cmd = [
         sys.executable, "-m", "nice_tpu.client", "detailed",
@@ -151,7 +162,7 @@ def main() -> int:
             d.close()
 
     run_logs = []
-    for run in range(len(fields)):
+    for run in range(len(fields) // BLOCK):
         log_path = os.path.join(workdir, f"client-run{run + 1}.log")
         run_logs.append(log_path)
         with open(log_path, "wb") as logf:
@@ -160,11 +171,11 @@ def main() -> int:
                 env=client_env,
             )
             if run == 1:
-                # Mid-run chaos: once run 2's claim has landed (it is now
-                # processing), SIGKILL the server, hold a short outage, and
-                # restart on the same port + DB. The WAL ledger must survive
-                # the kill and the client's submit must ride the retries.
-                before = run  # one claim per completed run so far
+                # Mid-run chaos: once run 2's block claim has landed (it is
+                # now processing), SIGKILL the server, hold a short outage,
+                # and restart on the same port + DB. The WAL ledger must
+                # survive the kill and the block submit must ride the retries.
+                before = run * BLOCK  # claims minted per completed block run
                 deadline = time.monotonic() + 60
                 while time.monotonic() < deadline:
                     if claims_count() > before or proc.poll() is not None:
@@ -183,7 +194,7 @@ def main() -> int:
                         failures.append("server did not come back after kill")
                 else:
                     failures.append(
-                        "run 2 never claimed a field; kill drill skipped"
+                        "run 2 never claimed its block; kill drill skipped"
                     )
             try:
                 rc = proc.wait(timeout=RUN_TIMEOUT)
@@ -242,7 +253,10 @@ def main() -> int:
     line["dropped_responses"] = logs_text.count("response dropped")
     if line["dropped_responses"] < 1:
         failures.append("no submit response was dropped (fault never fired)")
-    if "was a duplicate" not in logs_text:
+    # Per-field replays log "was a duplicate"; block replays log
+    # "... were duplicates". Either proves the exactly-once path ran.
+    if ("was a duplicate" not in logs_text
+            and "were duplicates" not in logs_text):
         failures.append(
             "no duplicate-submit replay observed (exactly-once path unused)"
         )

@@ -1,0 +1,583 @@
+"""Fleet-scale load harness: thousands of simulated clients against a real
+server process.
+
+Each simulated client is a lightweight asyncio coroutine speaking
+hand-rolled HTTP/1.1 keep-alive — NOT a full engine client — running the
+niceonly honor-system loop (claim -> submit, no compute), so one harness
+process can drive 10k+ of them. The population mirrors the real fleet:
+~80% block-mode clients (one /claim_block + one /submit_block round-trip
+per --block-size fields), ~20% per-field compatibility clients
+(/claim/niceonly + /submit per field). Requests pass through the
+nice_tpu.faults injector at the same http.<endpoint> sites the real client
+uses, with a pinned seed, so every run injects the same drops and
+connection errors; dropped submit responses are replayed, exercising the
+exactly-once submit_id path at scale.
+
+Reported (JSON, one file): p50/p95/p99 claim and submit latency, request
+and field throughput, error and duplicate counts, fields-per-round-trip for
+block clients, a keep-alive vs fresh-connection RTT probe, and a post-run
+exactly-once audit straight from the ledger (zero lost owned submissions,
+zero double-canonicalized submit_ids).
+
+Usage:
+    python scripts/load_harness.py --clients 10000 --out LOAD_r01.json
+    python scripts/load_harness.py --clients 200 --rounds 1   # smoke scale
+
+Importable: tests call run_load(...) directly with a small population.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nice_tpu import CLIENT_VERSION, faults  # noqa: E402
+
+BASE = 30  # widest practical seeded range (~494M numbers)
+DEFAULT_FAULT_SPEC = (
+    "http.submit_block:drop_response@0.02,"
+    "http.submit:drop_response@0.02,"
+    "http.claim_block:conn_error@0.01,"
+    "http.claim:conn_error@0.01"
+)
+DEFAULT_FAULT_SEED = 1
+REQUEST_ATTEMPTS = 4
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _raise_nofile(target: int = 65536) -> None:
+    """10k keep-alive sockets (plus the server's side, which inherits the
+    limit through exec) need headroom over the usual 1024 soft cap."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(target, hard) if hard > 0 else target
+        if soft < want:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+class MiniConn:
+    """One persistent HTTP/1.1 keep-alive connection (asyncio streams).
+
+    A stale reused socket (server closed an idle connection) gets one
+    transparent reconnect, mirroring the real client transport."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.reader = None
+        self.writer = None
+
+    async def _connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self.reader = self.writer = None
+
+    async def request(self, method: str, target: str, body=None):
+        """Returns (status, parsed_json). Raises OSError on transport
+        failure (after the one stale-socket reconnect)."""
+        payload = b"" if body is None else json.dumps(body).encode()
+        head = (
+            f"{method} {target} HTTP/1.1\r\n"
+            f"Host: {self.host}\r\n"
+            "Accept: application/json\r\n"
+        )
+        if payload:
+            head += (
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+            )
+        head += "\r\n"
+        raw = head.encode() + payload
+        for fresh_retry in (False, True):
+            reused = self.writer is not None
+            if not reused:
+                await self._connect()
+            try:
+                self.writer.write(raw)
+                await self.writer.drain()
+                status_line = await self.reader.readline()
+                if not status_line:
+                    raise ConnectionResetError("empty response")
+                status = int(status_line.split()[1])
+                length = 0
+                close_after = False
+                while True:
+                    line = await self.reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode("latin-1").partition(":")
+                    lname = name.strip().lower()
+                    if lname == "content-length":
+                        length = int(value.strip())
+                    elif lname == "connection":
+                        close_after = value.strip().lower() == "close"
+                resp_body = (
+                    await self.reader.readexactly(length) if length else b""
+                )
+                if close_after:
+                    await self.close()
+                return status, (json.loads(resp_body) if resp_body else None)
+            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                await self.close()
+                if reused and not fresh_retry:
+                    continue
+                raise
+
+
+class Stats:
+    def __init__(self):
+        self.claim_lat: list[float] = []
+        self.submit_lat: list[float] = []
+        self.fields_claimed = 0
+        self.submissions_accepted = 0
+        self.duplicates = 0
+        self.http_errors = 0
+        self.transport_errors = 0
+        self.injected = 0
+        self.requests = 0
+        self.claim_rtts = 0  # block + per-field claim round-trips
+        self.block_fields = 0  # fields handed out by /claim_block alone
+        self.block_claim_rtts = 0
+        self.owned_submit_ids: list[str] = []
+
+
+def _submission(claim_id: int, username: str) -> dict:
+    """Honor-system niceonly payload with the real client's submit_id
+    derivation (claim id + content hash)."""
+    payload = {
+        "claim_id": claim_id,
+        "username": username,
+        "client_version": CLIENT_VERSION,
+        "unique_distribution": None,
+        "nice_numbers": [],
+    }
+    content = json.dumps(payload, sort_keys=True).encode()
+    payload["submit_id"] = (
+        f"{claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return payload
+
+
+async def _faulted_request(
+    conn: MiniConn, stats: Stats, endpoint: str, method: str, target: str,
+    body=None,
+):
+    """One logical request with fault injection + bounded replay, mirroring
+    retry_request: drop_response performs the request and discards the
+    reply; conn_error skips the wire entirely. Returns (status, json) or
+    None when every attempt failed."""
+    for _attempt in range(REQUEST_ATTEMPTS):
+        act = faults.fire(f"http.{endpoint}", target=target)
+        try:
+            if act == "drop_response":
+                stats.injected += 1
+                stats.requests += 1
+                await conn.request(method, target, body)
+                continue  # the reply vanished; replay
+            if act in ("conn_error", "raise"):
+                stats.injected += 1
+                continue
+            stats.requests += 1
+            status, resp = await conn.request(method, target, body)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            stats.transport_errors += 1
+            continue
+        if status >= 500:
+            stats.http_errors += 1
+            await asyncio.sleep(0.05)
+            continue
+        return status, resp
+    return None
+
+
+async def _settle_submission_reply(stats: Stats, items: list[dict], resp):
+    """Account one accepted /submit- or /submit_block-style reply."""
+    results = resp.get("results") if isinstance(resp, dict) else None
+    if results is None:
+        results = [resp] * len(items)
+    for item, result in zip(items, results):
+        if not isinstance(result, dict) or result.get("status") == "error":
+            stats.http_errors += 1
+            continue
+        if result.get("duplicate"):
+            stats.duplicates += 1
+        else:
+            stats.submissions_accepted += 1
+        stats.owned_submit_ids.append(item["submit_id"])
+
+
+async def _block_client(cfg, stats: Stats, sem: asyncio.Semaphore, idx: int):
+    async with sem:
+        conn = MiniConn(cfg["host"], cfg["port"])
+        try:
+            for _round in range(cfg["rounds"]):
+                t0 = time.monotonic()
+                got = await _faulted_request(
+                    conn, stats, "claim_block", "POST", "/claim_block",
+                    {
+                        "mode": "niceonly",
+                        "count": cfg["block_size"],
+                        "username": f"load-{idx}",
+                    },
+                )
+                stats.claim_lat.append(time.monotonic() - t0)
+                if got is None or got[0] != 200:
+                    stats.http_errors += got is not None
+                    continue
+                block = got[1]
+                fields = block["fields"]
+                stats.fields_claimed += len(fields)
+                stats.claim_rtts += 1
+                stats.block_fields += len(fields)
+                stats.block_claim_rtts += 1
+                subs = [
+                    _submission(f["claim_id"], f"load-{idx}") for f in fields
+                ]
+                t0 = time.monotonic()
+                got = await _faulted_request(
+                    conn, stats, "submit_block", "POST", "/submit_block",
+                    {"block_id": block["block_id"], "submissions": subs},
+                )
+                stats.submit_lat.append(time.monotonic() - t0)
+                if got is None or got[0] != 200:
+                    stats.http_errors += got is not None
+                    continue
+                await _settle_submission_reply(stats, subs, got[1])
+        finally:
+            await conn.close()
+
+
+async def _per_field_client(
+    cfg, stats: Stats, sem: asyncio.Semaphore, idx: int
+):
+    async with sem:
+        conn = MiniConn(cfg["host"], cfg["port"])
+        try:
+            for _round in range(cfg["rounds"]):
+                t0 = time.monotonic()
+                got = await _faulted_request(
+                    conn, stats, "claim", "GET",
+                    f"/claim/niceonly?username=load-{idx}",
+                )
+                stats.claim_lat.append(time.monotonic() - t0)
+                if got is None or got[0] != 200:
+                    stats.http_errors += got is not None
+                    continue
+                stats.fields_claimed += 1
+                stats.claim_rtts += 1
+                sub = _submission(got[1]["claim_id"], f"load-{idx}")
+                t0 = time.monotonic()
+                got = await _faulted_request(
+                    conn, stats, "submit", "POST", "/submit", sub
+                )
+                stats.submit_lat.append(time.monotonic() - t0)
+                if got is None or got[0] != 200:
+                    stats.http_errors += got is not None
+                    continue
+                await _settle_submission_reply(stats, [sub], got[1])
+        finally:
+            await conn.close()
+
+
+async def _keepalive_probe(host: str, port: int, n: int = 50) -> dict:
+    """Satellite measurement: mean /status RTT over one persistent
+    connection vs a fresh TCP connection per request."""
+    conn = MiniConn(host, port)
+    await conn.request("GET", "/status")  # warm the status cache + socket
+    t0 = time.monotonic()
+    for _ in range(n):
+        await conn.request("GET", "/status")
+    keepalive = (time.monotonic() - t0) / n
+    await conn.close()
+    t0 = time.monotonic()
+    for _ in range(n):
+        one = MiniConn(host, port)
+        await one.request("GET", "/status")
+        await one.close()
+    fresh = (time.monotonic() - t0) / n
+    return {
+        "keepalive_ms_mean": round(keepalive * 1e3, 3),
+        "fresh_conn_ms_mean": round(fresh * 1e3, 3),
+        "delta_ms": round((fresh - keepalive) * 1e3, 3),
+    }
+
+
+def _pctl(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, int(q * (len(s) - 1) + 0.5))
+    return round(s[idx] * 1e3, 3)  # ms
+
+
+def _seed_db(db_path: str, target_fields: int) -> int:
+    from nice_tpu.core.base_range import get_base_range
+    from nice_tpu.server.db import Db
+
+    lo, hi = get_base_range(BASE)
+    field_size = max(1, (hi - lo) // target_fields)
+    db = Db(db_path)
+    n = db.seed_base(BASE, field_size=field_size)
+    db.close()
+    return n
+
+
+def _verify_exactly_once(db_path: str, stats: Stats) -> dict:
+    """Post-run ledger audit: every owned submit_id persisted exactly once,
+    and NO submit_id anywhere has two rows (the dropped-response replays
+    must all have deduplicated)."""
+    import sqlite3
+
+    conn = sqlite3.connect(db_path)
+    try:
+        present = {
+            r[0]
+            for r in conn.execute(
+                "SELECT submit_id FROM submissions WHERE submit_id IS NOT NULL"
+            )
+        }
+        doubles = conn.execute(
+            "SELECT COUNT(*) FROM (SELECT submit_id FROM submissions"
+            " WHERE submit_id IS NOT NULL GROUP BY submit_id"
+            " HAVING COUNT(*) > 1)"
+        ).fetchone()[0]
+    finally:
+        conn.close()
+    owned = set(stats.owned_submit_ids)
+    lost = len(owned - present)
+    return {
+        "owned": len(owned),
+        "lost": lost,
+        "double_canonicalized": doubles,
+        "violations": lost + doubles,
+    }
+
+
+async def _drive(cfg, stats: Stats) -> None:
+    sem = asyncio.Semaphore(cfg["concurrency"])
+    n_block = int(cfg["clients"] * cfg["block_share"])
+    tasks = [
+        asyncio.create_task(_block_client(cfg, stats, sem, i))
+        for i in range(n_block)
+    ]
+    tasks += [
+        asyncio.create_task(_per_field_client(cfg, stats, sem, i))
+        for i in range(n_block, cfg["clients"])
+    ]
+    await asyncio.gather(*tasks)
+
+
+def run_load(
+    api_url: str | None = None,
+    *,
+    clients: int = 10_000,
+    block_share: float = 0.8,
+    block_size: int = 16,
+    rounds: int = 1,
+    concurrency: int = 500,
+    fault_spec: str | None = DEFAULT_FAULT_SPEC,
+    fault_seed: int = DEFAULT_FAULT_SEED,
+    db_path: str | None = None,
+    run_label: str = "r01",
+    keep_workdir: bool = False,
+) -> dict:
+    """Run the harness; returns the report dict. With api_url=None a server
+    subprocess is spawned on a freshly seeded ledger (db_path then names
+    where to put it; default a temp dir)."""
+    _raise_nofile()
+    faults.configure(fault_spec, seed=fault_seed)
+    workdir = None
+    server = None
+    logf = None
+    try:
+        if api_url is None:
+            workdir = tempfile.mkdtemp(prefix="load-harness-")
+            db_path = db_path or os.path.join(workdir, "load.db")
+            expected = int(
+                clients * rounds * (block_share * block_size
+                                    + (1 - block_share))
+            )
+            seeded = _seed_db(db_path, int(expected * 1.4) + 2_000)
+            port = _pick_port()
+            env = dict(
+                os.environ,
+                NICE_TPU_MAX_INFLIGHT="4096",
+                NICE_TPU_SERVER_WORKERS="32",
+                JAX_PLATFORMS="cpu",
+            )
+            env.pop("NICE_TPU_FAULTS", None)  # faults live client-side here
+            logf = open(os.path.join(workdir, "server.log"), "ab")
+            server = subprocess.Popen(
+                [
+                    sys.executable, "-m", "nice_tpu.server",
+                    "--db", db_path, "--host", "127.0.0.1",
+                    "--port", str(port),
+                ],
+                stdout=logf, stderr=subprocess.STDOUT, env=env,
+            )
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if server.poll() is not None:
+                    raise RuntimeError("server subprocess died on startup")
+                try:
+                    with socket.create_connection(("127.0.0.1", port), 1):
+                        break
+                except OSError:
+                    time.sleep(0.05)
+            else:
+                raise RuntimeError("server never started listening")
+            host = "127.0.0.1"
+        else:
+            from urllib.parse import urlsplit
+
+            parts = urlsplit(api_url)
+            host, port = parts.hostname, parts.port or 80
+            seeded = None
+
+        cfg = {
+            "host": host,
+            "port": port,
+            "clients": clients,
+            "block_share": block_share,
+            "block_size": block_size,
+            "rounds": rounds,
+            "concurrency": concurrency,
+        }
+        stats = Stats()
+        t0 = time.monotonic()
+        asyncio.run(_drive(cfg, stats))
+        duration = time.monotonic() - t0
+        probe = asyncio.run(_keepalive_probe(host, port))
+
+        n_block = int(clients * block_share)
+        report = {
+            "run": run_label,
+            "clients": clients,
+            "block_clients": n_block,
+            "per_field_clients": clients - n_block,
+            "block_size": block_size,
+            "rounds_per_client": rounds,
+            "concurrency": concurrency,
+            "fault_spec": fault_spec,
+            "fault_seed": fault_seed,
+            "seeded_fields": seeded,
+            "duration_secs": round(duration, 2),
+            "claim": {
+                "count": len(stats.claim_lat),
+                "p50_ms": _pctl(stats.claim_lat, 0.50),
+                "p95_ms": _pctl(stats.claim_lat, 0.95),
+                "p99_ms": _pctl(stats.claim_lat, 0.99),
+            },
+            "submit": {
+                "count": len(stats.submit_lat),
+                "p50_ms": _pctl(stats.submit_lat, 0.50),
+                "p95_ms": _pctl(stats.submit_lat, 0.95),
+                "p99_ms": _pctl(stats.submit_lat, 0.99),
+            },
+            "throughput": {
+                "requests": stats.requests,
+                "requests_per_sec": round(stats.requests / duration, 1),
+                "fields_claimed": stats.fields_claimed,
+                "fields_per_sec": round(stats.fields_claimed / duration, 1),
+                "submissions_accepted": stats.submissions_accepted,
+            },
+            "fields_per_claim_rtt": round(
+                stats.fields_claimed / max(1, stats.claim_rtts), 2
+            ),
+            "fields_per_rtt_block": round(
+                stats.block_fields / max(1, stats.block_claim_rtts), 2
+            ),
+            "errors": {
+                "http_errors": stats.http_errors,
+                "transport_errors": stats.transport_errors,
+                "injected_faults": stats.injected,
+            },
+            "duplicates": stats.duplicates,
+            "keepalive_probe": probe,
+        }
+        if db_path and os.path.exists(db_path):
+            # Give the writer actor a beat to flush its final batches.
+            time.sleep(0.3)
+            report["exactly_once"] = _verify_exactly_once(db_path, stats)
+        return report
+    finally:
+        faults.configure(None)
+        if server is not None:
+            server.terminate()
+            try:
+                server.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                server.kill()
+                server.wait()
+        if logf is not None:
+            logf.close()
+        if workdir and not keep_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="load_harness")
+    p.add_argument("--clients", type=int, default=10_000)
+    p.add_argument("--block-share", type=float, default=0.8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--concurrency", type=int, default=500)
+    p.add_argument("--fault-spec", default=DEFAULT_FAULT_SPEC)
+    p.add_argument("--fault-seed", type=int, default=DEFAULT_FAULT_SEED)
+    p.add_argument("--api-url", default=None,
+                   help="drive an existing server instead of spawning one")
+    p.add_argument("--run-label", default="r01")
+    p.add_argument("--out", default=None, help="write the JSON report here")
+    args = p.parse_args(argv)
+    report = run_load(
+        args.api_url,
+        clients=args.clients,
+        block_share=args.block_share,
+        block_size=args.block_size,
+        rounds=args.rounds,
+        concurrency=args.concurrency,
+        fault_spec=args.fault_spec,
+        fault_seed=args.fault_seed,
+        run_label=args.run_label,
+    )
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    violations = report.get("exactly_once", {}).get("violations", 0)
+    return 0 if violations == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

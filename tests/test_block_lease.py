@@ -1,0 +1,238 @@
+"""Block claim leases: N fields per round-trip under one lease.
+
+Covers the /claim_block + /submit_block + block-aware /renew_claim surface:
+partial submits, whole-block expiry and renewal, duplicate block replay
+(exactly-once submit_id semantics per field inside a block), and the
+client's block-mode loop end to end.
+"""
+
+import hashlib
+import json
+import sqlite3
+import threading
+from datetime import datetime, timezone
+
+import pytest
+
+from nice_tpu import CLIENT_VERSION
+from nice_tpu.client import api_client
+from nice_tpu.client import main as client_main
+from nice_tpu.core.types import DataToServer, FieldClaimStrategy, SearchMode
+from nice_tpu.server import app as server_app
+from nice_tpu.server.db import Db, ts
+from nice_tpu.server.field_queue import U128_MAX
+
+
+@pytest.fixture()
+def server(tmp_path):
+    db_path = str(tmp_path / "nice-block.db")
+    db = Db(db_path)
+    db.seed_base(10, field_size=5)  # [47,100) -> 11 fields
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base_url, db_path
+    srv.shutdown()
+    api_client.close_connections()
+
+
+def _niceonly_submission(data, username="blocky"):
+    payload = DataToServer(
+        claim_id=data.claim_id,
+        username=username,
+        client_version=CLIENT_VERSION,
+        unique_distribution=None,
+        nice_numbers=[],
+    )
+    content = json.dumps(payload.to_json(), sort_keys=True).encode()
+    payload.submit_id = (
+        f"{data.claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return payload
+
+
+def _query(db_path, sql, params=()):
+    conn = sqlite3.connect(db_path)
+    conn.row_factory = sqlite3.Row
+    try:
+        return conn.execute(sql, params).fetchall()
+    finally:
+        conn.close()
+
+
+def test_claim_block_hands_out_n_fields_under_one_lease(server):
+    base_url, db_path = server
+    block_id, fields = api_client.claim_block_from_server(
+        SearchMode.NICEONLY, base_url, "blocky", count=8, max_retries=0
+    )
+    # The acceptance bar for block mode: >= 8 fields per HTTP round-trip.
+    assert len(fields) == 8
+    assert len({f.claim_id for f in fields}) == 8
+    assert len({(f.range_start, f.range_end) for f in fields}) == 8
+    rows = _query(
+        db_path, "SELECT field_id FROM claims WHERE block_id = ?", (block_id,)
+    )
+    assert len(rows) == 8
+
+
+def test_partial_submit_then_rest_and_duplicate_replay(server):
+    base_url, db_path = server
+    block_id, fields = api_client.claim_block_from_server(
+        SearchMode.NICEONLY, base_url, "blocky", count=4, max_retries=0
+    )
+    subs = [_niceonly_submission(f) for f in fields]
+
+    # Partial submit: 2 of 4 members. The other two stay claimable work.
+    resp = api_client.submit_block_to_server(
+        base_url, block_id, subs[:2], max_retries=0
+    )
+    assert resp["accepted"] == 2
+    assert resp["duplicates"] == 0 and resp["rejected"] == 0
+
+    # The rest lands later under the same block.
+    resp = api_client.submit_block_to_server(
+        base_url, block_id, subs[2:], max_retries=0
+    )
+    assert resp["accepted"] == 2
+
+    # Whole-block replay (client never saw the 200s): every member answers
+    # duplicate, no new rows — exactly-once per field inside the block.
+    resp = api_client.submit_block_to_server(
+        base_url, block_id, subs, max_retries=0
+    )
+    assert resp["accepted"] == 0
+    assert resp["duplicates"] == 4
+    assert all(r.get("duplicate") for r in resp["results"])
+    rows = _query(
+        db_path,
+        "SELECT COUNT(*) AS n FROM submissions WHERE claim_id IN"
+        " (SELECT id FROM claims WHERE block_id = ?)",
+        (block_id,),
+    )
+    assert rows[0]["n"] == 4
+
+
+def test_block_mixed_submit_reports_per_item_results(server):
+    base_url, _ = server
+    block_id, fields = api_client.claim_block_from_server(
+        SearchMode.NICEONLY, base_url, "blocky", count=3, max_retries=0
+    )
+    subs = [_niceonly_submission(f) for f in fields]
+    bad = _niceonly_submission(fields[0])
+    bad.claim_id = 999_999  # unknown claim -> per-item rejection
+    bad.submit_id = None
+    resp = api_client.submit_block_to_server(
+        base_url, block_id, [subs[0], bad, subs[2]], max_retries=0
+    )
+    assert resp["accepted"] == 2
+    assert resp["rejected"] == 1
+    assert resp["results"][1]["status"] == "error"
+    assert resp["results"][1]["code"] == 400
+
+
+def test_renew_block_bumps_every_member(server):
+    base_url, db_path = server
+    block_id, fields = api_client.claim_block_from_server(
+        SearchMode.NICEONLY, base_url, "blocky", count=3, max_retries=0
+    )
+    api_client.renew_block(base_url, block_id, max_retries=0)
+    rows = _query(
+        db_path,
+        "SELECT f.last_claim_time AS t FROM fields f JOIN claims c"
+        " ON c.field_id = f.id WHERE c.block_id = ?",
+        (block_id,),
+    )
+    assert len(rows) == 3
+    # One heartbeat stamped every member with the SAME renewal time.
+    assert len({r["t"] for r in rows}) == 1
+    # The stamp moved past the claim-time stamp (renewal happened after).
+    claim_rows = _query(
+        db_path, "SELECT claim_time FROM claims WHERE block_id = ?", (block_id,)
+    )
+    assert all(r["t"] >= c["claim_time"] for r in rows for c in claim_rows)
+
+
+def test_renew_unknown_block_is_404(server):
+    base_url, _ = server
+    with pytest.raises(api_client.ApiError) as err:
+        api_client.renew_block(base_url, "no-such-block", max_retries=0)
+    assert err.value.status == 404
+
+
+def test_expiry_and_renewal_cover_the_whole_block(tmp_path):
+    """Db-level lease lifecycle: an active block is invisible to the claim
+    engine, renewal re-arms every member, expiry releases every member."""
+    db = Db(str(tmp_path / "lease.db"))
+    db.seed_base(10, field_size=5)
+    got = db._claim_batch(
+        FieldClaimStrategy.NEXT, db.claim_expiry_cutoff(), 0, U128_MAX, 3
+    )
+    assert len(got) == 3
+    member_ids = {f.field_id for f in got}
+    db.insert_claims_block(
+        sorted(member_ids), SearchMode.NICEONLY, "10.0.0.1", "blk-lease"
+    )
+
+    # Active lease: no member is re-claimable.
+    visible = db._claim_batch(
+        FieldClaimStrategy.NEXT, db.claim_expiry_cutoff(), 0, U128_MAX, 50
+    )
+    assert member_ids.isdisjoint({f.field_id for f in visible})
+
+    # Renewal bumps every member at once.
+    when, count = db.renew_block("blk-lease")
+    assert count == 3
+    with db._read_conn() as conn:
+        stamps = {
+            r[0]
+            for r in conn.execute(
+                "SELECT last_claim_time FROM fields WHERE id IN"
+                f" ({','.join('?' * len(member_ids))})",
+                sorted(member_ids),
+            )
+        }
+    assert stamps == {ts(when)}
+
+    # Expire the whole block: every member becomes claimable again together.
+    past = ts(datetime(2000, 1, 1, tzinfo=timezone.utc))
+    with db._lock, db._txn():
+        db._conn.executemany(
+            "UPDATE fields SET last_claim_time = ? WHERE id = ?",
+            [(past, fid) for fid in sorted(member_ids)],
+        )
+    reclaimed = db._claim_batch(
+        FieldClaimStrategy.NEXT, db.claim_expiry_cutoff(), 0, U128_MAX, 50
+    )
+    assert member_ids <= {f.field_id for f in reclaimed}
+    db.close()
+
+
+def test_client_block_iteration_end_to_end(server):
+    base_url, db_path = server
+    args = client_main.build_parser().parse_args(
+        [
+            "niceonly",
+            "--api-base", base_url,
+            "--username", "blockclient",
+            "--backend", "scalar",
+            "--claim-block", "3",
+            "--renew-secs", "0",
+            "--telemetry-secs", "0",
+            "--max-retries", "0",
+        ]
+    )
+    api = api_client.AsyncApi(base_url, "blockclient", max_retries=0)
+    try:
+        assert client_main.run_block_iteration(
+            args, api, SearchMode.NICEONLY
+        )
+    finally:
+        api.shutdown()
+    rows = _query(
+        db_path,
+        "SELECT COUNT(*) AS n FROM submissions WHERE username = ?",
+        ("blockclient",),
+    )
+    assert rows[0]["n"] == 3

@@ -1,0 +1,71 @@
+"""Load harness at test scale: a few hundred coroutine clients against a
+real server subprocess, faults pinned to a seed, exactly-once audited from
+the ledger afterwards. Full-fleet runs (10k+) produce LOAD_*.json via the
+CLI; this keeps the same code path honest inside tier-1 time."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+
+from load_harness import run_load  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def report():
+    return run_load(
+        clients=240,
+        block_share=0.8,
+        block_size=8,
+        rounds=1,
+        concurrency=120,
+        fault_spec=(
+            "http.submit_block:drop_response@0.05,"
+            "http.submit:drop_response@0.05,"
+            "http.claim_block:conn_error@0.02,"
+            "http.claim:conn_error@0.02"
+        ),
+        fault_seed=7,
+        run_label="test",
+    )
+
+
+def test_no_submission_lost_and_none_double_canonicalized(report):
+    audit = report["exactly_once"]
+    assert audit["owned"] > 0
+    assert audit["lost"] == 0
+    assert audit["double_canonicalized"] == 0
+    assert audit["violations"] == 0
+
+
+def test_faults_actually_fired_and_deduplicated(report):
+    # The pinned seed must inject at this population size, and the dropped
+    # submit responses must surface as duplicate replies — not new rows.
+    assert report["errors"]["injected_faults"] > 0
+    assert report["duplicates"] > 0
+
+
+def test_block_clients_amortize_the_round_trip(report):
+    # Acceptance bar: block-mode clients get >= 8 fields per claim RTT.
+    assert report["fields_per_rtt_block"] >= 8
+
+
+def test_latency_and_throughput_sane(report):
+    # Loose bound: local loopback p99 under 5s even with faults + retries.
+    assert 0 < report["claim"]["p99_ms"] < 5_000
+    assert 0 < report["submit"]["p99_ms"] < 5_000
+    assert report["throughput"]["fields_per_sec"] > 0
+    assert report["throughput"]["submissions_accepted"] > 0
+
+
+def test_keepalive_beats_fresh_connections(report):
+    probe = report["keepalive_probe"]
+    assert probe["keepalive_ms_mean"] > 0
+    assert probe["fresh_conn_ms_mean"] > 0
+    # Persistent connections skip the TCP handshake; on loopback the delta
+    # is small but should essentially never be negative.
+    assert probe["keepalive_ms_mean"] <= probe["fresh_conn_ms_mean"] * 1.5

@@ -60,6 +60,12 @@ def plan_signature(mode: SearchMode, base: int, backend: str,
         "backend": backend,
         "batch_size": batch_size,
         "runtime": runtime,
+        # State-contract version. 2 = per-slice "remaining" segment states
+        # (pod-sliced subfields): a v2 snapshot's cursor alone does NOT
+        # imply a covered prefix, so pre-slice consumers must reject it —
+        # and v1 snapshots (no "state" key) are rejected here symmetrically
+        # by plain signature inequality.
+        "state": 2,
     }
 
 
@@ -71,6 +77,14 @@ def _state_to_snapshot(state: dict) -> tuple[dict, dict[str, np.ndarray]]:
         ],
         "near_miss_count": len(state["nice_numbers"]),
     }
+    if state.get("remaining") is not None:
+        # Per-slice cursors: the uncovered [start, end) segments (decimal
+        # strings — candidates exceed u64 at bases 60+). "filtered" marks a
+        # niceonly remaining-set whose gaps are provably empty.
+        manifest["remaining"] = [
+            [str(int(s)), str(int(e))] for s, e in state["remaining"]
+        ]
+        manifest["filtered"] = bool(state.get("filtered"))
     arrays: dict[str, np.ndarray] = {}
     if state.get("hist") is not None:
         arrays["hist"] = np.asarray(state["hist"], dtype=np.int64)
@@ -78,13 +92,19 @@ def _state_to_snapshot(state: dict) -> tuple[dict, dict[str, np.ndarray]]:
 
 
 def _snapshot_to_state(manifest: dict, arrays: dict[str, np.ndarray]) -> dict:
-    return {
+    state = {
         "cursor": int(manifest["cursor"]),
         "hist": arrays.get("hist"),
         "nice_numbers": [
             (int(n), int(u)) for n, u in manifest["nice_numbers"]
         ],
     }
+    if manifest.get("remaining") is not None:
+        state["remaining"] = [
+            (int(s), int(e)) for s, e in manifest["remaining"]
+        ]
+        state["filtered"] = bool(manifest.get("filtered"))
+    return state
 
 
 class FieldCheckpointer:

@@ -298,6 +298,65 @@ def compile_results(
     return payload
 
 
+def _prefetch_enabled() -> bool:
+    return os.environ.get("NICE_TPU_PREFETCH", "1").strip().lower() not in (
+        "0", "false", "off"
+    )
+
+
+def _warm_field(data: DataToClient, mode: SearchMode, backend: str,
+                batch_size: int | None) -> None:
+    try:
+        if mode == SearchMode.DETAILED:
+            engine.warm_detailed(
+                data.base, batch_size=batch_size, backend=backend
+            )
+        else:
+            engine.warm_niceonly(
+                data.base, field_size=data.range_size,
+                field_start=data.range_start,
+            )
+    except Exception:
+        # Best-effort: the field dispatch compiles on demand anyway.
+        log.debug("prefetch warm failed for base %d", data.base, exc_info=True)
+
+
+def _prefetch_on_claim(future, mode: SearchMode, backend: str,
+                       batch_size: int | None) -> None:
+    """NICE_TPU_PREFETCH hook: when the next claim resolves — typically while
+    the current field is still on-device — AOT-warm the executables that
+    field will dispatch on a background thread, so a base change at the field
+    boundary costs a cache hit instead of a foreground compile."""
+    if not _prefetch_enabled():
+        return
+    import threading
+
+    def _cb(fut) -> None:
+        try:
+            resolved = fut.result()
+        except BaseException:
+            return  # the loop's own .result() owns the failure
+        # claim_async yields one field; claim_block_async (block_id, fields).
+        fields = resolved[1] if isinstance(resolved, tuple) else [resolved]
+        seen: set[tuple[int, int]] = set()
+        todo = []
+        for data in fields:
+            key = (data.base, data.range_size if mode != SearchMode.DETAILED else 0)
+            if key not in seen:
+                seen.add(key)
+                todo.append(data)
+
+        def _warm_all() -> None:
+            for data in todo:
+                _warm_field(data, mode, backend, batch_size)
+
+        threading.Thread(
+            target=_warm_all, name="nice-prefetch", daemon=True
+        ).start()
+
+    future.add_done_callback(_cb)
+
+
 def run_benchmark(args) -> int:
     mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
     bench = BenchmarkMode(args.benchmark)
@@ -635,6 +694,7 @@ def run_pipelined_loop(
             # moment to drain journaled submissions once the server is back.
             spool.replay(args.api_base)
         next_claim = api.claim_async(mode)  # overlap with processing
+        _prefetch_on_claim(next_claim, mode, args.backend, args.batch_size)
         with obs.trace_context(obs.claim_trace_id(data.claim_id)):
             obs.trace_event(
                 "client.claim", claim=data.claim_id, base=data.base,
@@ -805,6 +865,7 @@ def run_block_pipelined_loop(
             spool.replay(args.api_base)
         log.info("claimed block %s: %d fields", block_id, len(fields))
         next_block = api.claim_block_async(mode, args.claim_block)
+        _prefetch_on_claim(next_block, mode, args.backend, args.batch_size)
         submissions = _process_block(args, mode, block_id, fields, spool)
         if pending_submit is not None:
             _await_block_submit(*pending_submit, spool)

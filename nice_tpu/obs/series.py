@@ -77,6 +77,37 @@ MESH_DEVICES = metrics.gauge(
     "nice_mesh_devices",
     "Devices in the most recently constructed mesh.",
 )
+MESH_FEED_IDLE = metrics.histogram(
+    "nice_mesh_feed_idle_seconds",
+    "Host-side inter-dispatch gap in the device feed: time between one "
+    "sharded dispatch returning and the next being issued. The double-"
+    "buffered feed (NICE_TPU_FEED_DEPTH > 0) moves per-batch host "
+    "arithmetic off this path, so the gap is the direct measure of feed "
+    "overlap.",
+    labelnames=("mode",),
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0),
+)
+MESH_RESHARDS = metrics.counter(
+    "nice_mesh_reshard_events_total",
+    "Elastic mesh downshifts: mid-field rebuilds over surviving devices "
+    "after a device loss, by detection reason (device_lost = the dispatch "
+    "raised MeshDeviceLost; probe = a post-failure device probe found the "
+    "loss).",
+    labelnames=("reason",),
+)
+MESH_RESHARD_SECONDS = metrics.histogram(
+    "nice_mesh_reshard_seconds",
+    "Wall time of one elastic downshift: partial-accumulator flush, mesh "
+    "rebuild over survivors, re-slice of the remaining cursor range.",
+    buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+MESH_SLICE_CURSOR = metrics.gauge(
+    "nice_mesh_slice_cursor",
+    "Per-slice scan cursor of the in-flight field (float: precision-lossy "
+    "above 2^53, observability only — the checkpoint manifest carries the "
+    "exact cursors).",
+    labelnames=("slice",),
+)
 
 # --- compiled-executable cache (ops/compile_cache.py) --------------------
 COMPILE_CACHE_EVENTS = metrics.counter(
@@ -284,6 +315,14 @@ FLEET_SPOOL_DEPTH = metrics.gauge(
     "nice_fleet_spool_depth",
     "Submissions sitting in on-disk spools across all reporting clients.",
 )
+FLEET_MESH_DEVICES = metrics.gauge(
+    "nice_fleet_mesh_devices",
+    "Mesh devices summed across all reporting clients.",
+)
+FLEET_MESH_RESHARDS = metrics.gauge(
+    "nice_fleet_mesh_reshards",
+    "Elastic mesh downshift events across all reporting clients.",
+)
 FLEET_FIELD_LATENCY = metrics.gauge(
     "nice_fleet_field_seconds",
     "Recent server-observed field latency quantiles (claim->accepted "
@@ -348,8 +387,11 @@ for _reason in ("sliver", "host-route", "limbs"):
 for _mode in ("detailed", "niceonly"):
     ENGINE_NUMBERS.labels(_mode)
     MESH_DISPATCH_SECONDS.labels(_mode)
+    MESH_FEED_IDLE.labels(_mode)
     CLIENT_FIELDS.labels(_mode)
     CLIENT_FIELD_SECONDS.labels(_mode)
+for _reason in ("device_lost", "probe"):
+    MESH_RESHARDS.labels(_reason)
 for _kernel in ("detailed", "niceonly_dense", "niceonly_strided", "uniques",
                 "survivors"):
     PALLAS_DISPATCH_SECONDS.labels(_kernel)

@@ -73,6 +73,13 @@ def snapshot(
         for (frm, to), v in series.ENGINE_BACKEND_DOWNGRADES.values().items()
         if v
     }
+    idle = series.MESH_FEED_IDLE.label_sums()
+    mesh = {
+        "devices": int(series.MESH_DEVICES.value()),
+        "reshards": int(_sum(series.MESH_RESHARDS)),
+        "feed_idle_sum": round(sum(s for s, _ in idle.values()), 6),
+        "feed_idle_count": int(sum(c for _, c in idle.values())),
+    }
     return {
         "v": SNAPSHOT_VERSION,
         "client_id": client_id(username),
@@ -88,4 +95,5 @@ def snapshot(
         "restores": int(series.CKPT_RESTORES.value()),
         "faults": int(_sum(series.FAULTS_INJECTED)),
         "spool_depth": int(spool_depth),
+        "mesh": mesh,
     }

@@ -53,6 +53,10 @@ from nice_tpu.obs.series import (
     ENGINE_STATS_TRANSFERS,
     ENGINE_STRIDE_OCCUPANCY,
     ENGINE_SURVIVOR_OVERFLOW,
+    MESH_FEED_IDLE,
+    MESH_RESHARDS,
+    MESH_RESHARD_SECONDS,
+    MESH_SLICE_CURSOR,
 )
 
 log = logging.getLogger(__name__)
@@ -353,7 +357,12 @@ def _mesh_or_none():
 
     if os.environ.get("NICE_TPU_SHARD", "1") == "0":
         return None
-    devs = jax.devices()
+    from nice_tpu.parallel import mesh as pmesh
+
+    # Devices a downshift marked dead stay excluded until heal_devices(), so
+    # the fields AFTER a reshard also start on the survivor mesh instead of
+    # re-discovering the loss one dispatch failure at a time.
+    devs = pmesh.live_devices(jax.devices())
     if len(devs) < 2:
         return None
     return _cached_mesh(tuple(devs))
@@ -375,6 +384,263 @@ def _shard_inputs(plan, core_end: int, batch_start: int, valid: int,
         dtype=np.int32,
     )
     return starts, valids
+
+
+# --- double-buffered host->device feed + elastic downshift (pod layer) ----
+
+# Depth of the host->device feed queue: how many super-batches ahead the
+# producer thread precomputes per-slice (starts, valids) limb rows. 0 runs
+# the feed synchronously on the dispatch thread — the pre-pod baseline, kept
+# as a measurable A/B via NICE_TPU_FEED_DEPTH=0 for the scaling harness.
+FEED_DEPTH_DEFAULT = 2
+
+
+def _feed_depth() -> int:
+    try:
+        d = int(os.environ.get("NICE_TPU_FEED_DEPTH", FEED_DEPTH_DEFAULT))
+    except ValueError:
+        d = FEED_DEPTH_DEFAULT
+    return max(0, min(64, d))
+
+
+def _elastic_enabled() -> bool:
+    """Elastic mesh downshift (reshard onto survivors when a device drops
+    mid-field) is on by default; NICE_TPU_ELASTIC=0 restores the PR 4
+    behavior of degrading the whole field down the backend chain."""
+    return os.environ.get("NICE_TPU_ELASTIC", "1") != "0"
+
+
+# Feed/reshard stats of the most recent device dispatch loop, read by the
+# scaling harness and tests — Prometheus histograms expose only sum/count,
+# not the p50/p95 the MULTICHIP report needs.
+LAST_FEED_STATS: dict = {}
+
+
+def _record_feed_stats(mode, gaps, dispatches, n_dev_start, n_dev_end,
+                       reshards, reshard_secs, depth) -> None:
+    g = np.asarray(gaps, dtype=np.float64)
+    LAST_FEED_STATS.clear()
+    LAST_FEED_STATS.update({
+        "mode": mode,
+        "feed_depth": int(depth),
+        "dispatches": int(dispatches),
+        "gaps": int(g.size),
+        "idle_p50": float(np.percentile(g, 50)) if g.size else 0.0,
+        "idle_p95": float(np.percentile(g, 95)) if g.size else 0.0,
+        "idle_mean": float(g.mean()) if g.size else 0.0,
+        "idle_total": float(g.sum()) if g.size else 0.0,
+        "n_dev_start": int(n_dev_start),
+        "n_dev_end": int(n_dev_end),
+        "reshards": int(reshards),
+        "reshard_secs": float(reshard_secs),
+    })
+
+
+class _FeedItem(NamedTuple):
+    starts: np.ndarray  # u32[n_slices, limbs_n] per-slice start limb rows
+    valids: np.ndarray  # i32[n_slices] valid lanes per slice
+    segs: tuple         # ((start, valid), ...) as Python ints, per slice
+    markers: tuple      # ((seg_idx, cursor), ...) per slice, AFTER this batch
+    lanes: int          # total valid lanes in the super-batch
+
+
+class _SliceFeed:
+    """Double-buffered host->device feed over per-slice work queues.
+
+    queues[d] is slice d's list of ascending disjoint [start, end) segments
+    (one slice per mesh device; parallel/mesh.py partition_segments builds
+    them). Each get() yields one super-batch taking up to batch_size
+    candidates from every slice's queue head — a slice never spans a segment
+    boundary within one batch, because its device computes a contiguous run
+    from its start row. With depth > 0 a producer thread precomputes the
+    limb rows of the next items while the current batch runs on-device, so
+    dispatch never blocks on host arithmetic; depth == 0 computes inline
+    (the synchronous baseline the scaling harness A/Bs against).
+
+    markers are the resume vocabulary: item.markers[d] = (seg_idx, cursor)
+    AFTER taking the batch, so remaining(queues, markers-of-the-last-
+    SUCCESSFUL-item) is exactly the uncovered range, automatically including
+    a batch that failed in flight."""
+
+    def __init__(self, plan, queues, batch_size: int, core_end: int,
+                 depth: int):
+        self._iter = self._generate(plan, queues, batch_size, core_end)
+        self._depth = depth
+        if depth > 0:
+            import queue as queue_mod
+            import threading
+
+            self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+            self._err: list = [None]
+            self._stop = threading.Event()
+            self._t = threading.Thread(
+                target=self._fill, name="mesh-feed", daemon=True
+            )
+            self._t.start()
+
+    @staticmethod
+    def start_markers(queues) -> tuple:
+        return tuple((0, q[0][0] if q else 0) for q in queues)
+
+    @staticmethod
+    def _generate(plan, queues, batch_size, core_end):
+        pos = [[0, q[0][0] if q else 0] for q in queues]
+        while True:
+            segs, markers, lanes = [], [], 0
+            for d, q in enumerate(queues):
+                si, cur = pos[d]
+                if si >= len(q):
+                    # Exhausted slice: zero-lane row clamped inside the base
+                    # range (digit extraction still runs on masked lanes).
+                    segs.append((core_end, 0))
+                    markers.append((si, cur))
+                    continue
+                take = min(batch_size, q[si][1] - cur)
+                segs.append((cur, take))
+                lanes += take
+                cur += take
+                if cur >= q[si][1]:
+                    si += 1
+                    if si < len(q):
+                        cur = q[si][0]
+                pos[d] = [si, cur]
+                markers.append((si, cur))
+            if lanes == 0:
+                return
+            starts = ints_to_limbs([s for s, _ in segs], plan.limbs_n)
+            valids = np.asarray([v for _, v in segs], dtype=np.int32)
+            yield _FeedItem(starts, valids, tuple(segs), tuple(markers), lanes)
+
+    @staticmethod
+    def remaining(queues, markers) -> list[tuple[int, int]]:
+        """Uncovered [start, end) segments given the per-slice markers of
+        the last successfully dispatched item (sorted, merged)."""
+        rem = []
+        for q, (si, cur) in zip(queues, markers):
+            if si < len(q):
+                if cur < q[si][1]:
+                    rem.append((max(cur, q[si][0]), q[si][1]))
+                rem.extend((s, e) for s, e in q[si + 1:])
+        rem.sort()
+        merged: list[list[int]] = []
+        for s, e in rem:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        return [(s, e) for s, e in merged]
+
+    def _fill(self):
+        import queue as queue_mod
+
+        try:
+            for item in self._iter:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised by get()
+            self._err[0] = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(None, timeout=0.1)
+                    return
+                except queue_mod.Full:
+                    continue
+
+    def get(self):
+        """Next _FeedItem, or None once every slice queue is exhausted."""
+        if self._depth == 0:
+            return next(self._iter, None)
+        item = self._q.get()
+        if item is None and self._err[0] is not None:
+            raise self._err[0]
+        return item
+
+    def stop(self) -> None:
+        """Tear the producer down (idempotent; safe mid-stream — the queue
+        is drained until the producer thread exits, so no put() deadlocks)."""
+        if self._depth == 0:
+            self._iter.close()
+            return
+        import queue as queue_mod
+
+        self._stop.set()
+        while self._t.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                self._t.join(timeout=0.05)
+        self._t.join()
+
+
+def _fire_mesh_fault(n_batch: int, n_dev: int, batch_start: int) -> None:
+    """Chaos hook (mesh.dispatch): action "dead[:i[+j...]]" simulates losing
+    the mesh axis position(s) i... (default: the last device) by raising
+    MeshDeviceLost — the signal the elastic downshift reshard path consumes.
+    Any other action raises a plain RuntimeError, which exercises the PR 4
+    backend-fallback chain instead."""
+    act = faults.fire(
+        "mesh.dispatch", batch=n_batch, n_dev=n_dev, start=batch_start
+    )
+    if act is None:
+        return
+    if act == "dead" or act.startswith("dead:"):
+        from nice_tpu.parallel import mesh as pmesh
+
+        if ":" in act:
+            lost = [int(x) for x in act.split(":", 1)[1].split("+")]
+        else:
+            lost = [n_dev - 1]
+        lost = [i for i in lost if 0 <= i < n_dev]
+        if lost:
+            raise pmesh.MeshDeviceLost(lost)
+    raise RuntimeError(f"injected mesh.dispatch fault: {act}")
+
+
+def _diagnose_survivors(mesh, err):
+    """After a mesh dispatch failure: (survivor device list, reason) when
+    one or more devices actually dropped while at least one survives, else
+    (None, ""). MeshDeviceLost names the axis positions directly (and the
+    lost devices are registered with the liveness layer so subsequent fields
+    avoid them); any other failure probes every device."""
+    from nice_tpu.parallel import mesh as pmesh
+
+    devices = list(mesh.devices.flat)
+    if isinstance(err, pmesh.MeshDeviceLost):
+        lost_pos = set(i for i in err.lost if i < len(devices))
+        if lost_pos and len(lost_pos) < len(devices):
+            pmesh.simulate_device_loss(
+                int(devices[i].id) for i in lost_pos
+            )
+            return (
+                [d for i, d in enumerate(devices) if i not in lost_pos],
+                "device_lost",
+            )
+        return None, ""
+    alive, lost = pmesh.probe_devices(devices)
+    if lost and alive:
+        return alive, "probe"
+    return None, ""
+
+
+def _resume_segments(resume, start: int, end: int) -> list[tuple[int, int]]:
+    """Uncovered [start, end)-clamped segments encoded by a resume state:
+    the "remaining" list when present (per-slice state), else the legacy
+    prefix-cursor contract ([range.start, cursor) fully covered)."""
+    if resume.get("remaining") is not None:
+        segs = [
+            (max(start, int(s)), min(end, int(e)))
+            for s, e in resume["remaining"]
+        ]
+        return [(s, e) for s, e in segs if s < e]
+    pos = max(start, min(end, int(resume["cursor"])))
+    return [(pos, end)] if pos < end else []
 
 
 def _rare_scan_survivors(plan, batch_start: int, valid: int, batch_size: int,
@@ -475,10 +741,18 @@ def _chunked_host_scan(
     hist = np.zeros(base + 2, dtype=np.int64) if detailed else None
     nice: list[NiceNumberSimple] = []
     start, total = range_.start(), range_.size()
+    end = range_.end()
     chunk = max(1, chunk)
-    done = 0
+    segs = [(start, end)] if total else []
+    filtered = False
     if resume is not None:
-        done = min(total, max(0, int(resume["cursor"]) - start))
+        # A per-slice "remaining" state (from the pod dispatch loops) may
+        # leave several disjoint uncovered segments; a "filtered" niceonly
+        # state additionally guarantees the gaps BETWEEN them hold no nice
+        # numbers (MSD/stride-filtered), so scanning only the segments is
+        # still exact. Both degrade cleanly to the legacy prefix cursor.
+        segs = _resume_segments(resume, start, end)
+        filtered = bool(resume.get("filtered"))
         if detailed:
             if resume.get("hist") is None:
                 raise ValueError("detailed resume state is missing a histogram")
@@ -493,25 +767,28 @@ def _chunked_host_scan(
             for n, u in resume["nice_numbers"]
         ]
         CKPT_RESTORES.inc()
-        CKPT_BATCHES_SKIPPED.inc(done // chunk)
+        done0 = total - sum(e_ - s_ for s_, e_ in segs)
+        CKPT_BATCHES_SKIPPED.inc(done0 // chunk)
         log.info(
-            "%s scalar resume: cursor %d (%d of %d numbers already done)",
-            mode, start + done, done, total,
+            "%s scalar resume: %d segment(s) remaining (%d of %d numbers "
+            "already done)", mode, len(segs), done0, total,
         )
     ticker = (
         _CkptTicker(every_batches, every_secs) if checkpoint_cb else None
     )
     n_batch = 0
+    done = total - sum(e_ - s_ for s_, e_ in segs)
     with obs.span("engine.scalar", base=base, size=total, mode=mode,
                   backend="scalar"):
-        while done < total:
-            n = min(chunk, total - done)
+        while segs:
+            s, e = segs[0]
+            n = min(chunk, e - s)
             # End of the degradation chain: an injected (or real) scalar
             # failure propagates to the caller — there is nothing left to
             # fall back to.
-            _fire_dispatch_fault(n_batch, "scalar", start + done)
+            _fire_dispatch_fault(n_batch, "scalar", s)
             n_batch += 1
-            sub_range = FieldSize(start + done, start + done + n)
+            sub_range = FieldSize(s, s + n)
             if detailed:
                 sub = scalar.process_range_detailed(sub_range, base)
                 for d in sub.distribution:
@@ -522,15 +799,21 @@ def _chunked_host_scan(
                 )
             nice.extend(sub.nice_numbers)
             done += n
+            if s + n >= e:
+                segs.pop(0)
+            else:
+                segs[0] = (s + n, e)
             if progress is not None:
                 progress(done, total)
             if ticker is not None and ticker.tick():
                 checkpoint_cb({
-                    "cursor": start + done,
+                    "cursor": segs[0][0] if segs else end,
                     "hist": None if hist is None else hist.copy(),
                     "nice_numbers": [
                         (x.number, x.num_uniques) for x in nice
                     ],
+                    "remaining": [[s_, e_] for s_, e_ in segs],
+                    "filtered": filtered,
                 })
     nice.sort(key=lambda x: x.number)
     if not detailed:
@@ -997,12 +1280,11 @@ def warm_detailed(base: int, batch_size: int | None = None,
     if mesh is not None:
         from nice_tpu.parallel import mesh as pmesh
 
-        n_dev = mesh.devices.size
-        compile_cache.executable(
-            ("detailed-accum-sharded", backend, plan, batch_size, n_dev),
-            lambda: pmesh.make_sharded_stats_accum_step(
-                plan, batch_size, mesh, kernel=backend
-            ),
+        # parallel/mesh.py caches these per (kind, device ids, shape), so the
+        # warm IS the field's step — no second memo layer that would pin a
+        # stale Mesh across a downshift.
+        pmesh.make_sharded_stats_accum_step(
+            plan, batch_size, mesh, kernel=backend
         )
         pmesh.make_sharded_stats_fold(mesh)
     else:
@@ -1559,8 +1841,14 @@ def _process_range_detailed(
     # executes in order while the host keeps dispatching — the reference's
     # overlapped launch pipeline, client_process_gpu.rs:667-682). The window
     # bounds in-flight device buffers so arbitrarily large fields run in
-    # constant memory. With >1 device, each dispatch is a super-batch of
-    # batch_size lanes per device through the sharded step.
+    # constant memory.
+    #
+    # Pod layer on top: the core splits into one work queue per device
+    # (per-slice cursors), a _SliceFeed precomputes the next super-batch's
+    # limb rows on its own thread while batch k runs on-device
+    # (NICE_TPU_FEED_DEPTH), and a device loss mid-field reshards the
+    # REMAINING segments onto the survivor mesh instead of downgrading the
+    # whole field to jnp/scalar (NICE_TPU_ELASTIC=0 restores that).
     #
     # The histogram lives ON THE DEVICE across batches: each dispatch donates
     # the running accumulator back to the step (jit donate_argnums), so the
@@ -1570,54 +1858,59 @@ def _process_range_detailed(
     mesh = _mesh_or_none()
     if mesh is not None:
         from nice_tpu.parallel import mesh as pmesh
-
-        n_dev = mesh.devices.size
-        # backend is already resolved to exactly "pallas" or "jnp" here; pass
-        # it through so an explicit backend="jnp" is honored on TPU too.
-        step = compile_cache.executable(
-            ("detailed-accum-sharded", backend, plan, batch_size, n_dev),
-            lambda: pmesh.make_sharded_stats_accum_step(
-                plan, batch_size, mesh, kernel=backend
-            ),
-        )
-        fold_step = pmesh.make_sharded_stats_fold(mesh)
-        lanes = batch_size * n_dev
-
-        def new_acc():
-            return np.zeros((n_dev, plan.base + 2), dtype=np.int32)
-
-        def dispatch(acc, batch_start, valid):
-            starts, valids = _shard_inputs(
-                plan, core.end(), batch_start, valid, batch_size, n_dev
-            )
-            return step(acc, starts, valids)
-
-        fold_acc = fold_step  # ONE psum per field, on the collector thread
     else:
-        lanes = batch_size
-        # Tuned shape knobs apply on the single-device path; the sharded
-        # step above stays at module defaults (its per-device kernel shape
-        # is owned by parallel/mesh.py).
-        accum_exec = _detailed_accum_executable(
-            plan, batch_size, backend, block_rows, carry_interval
-        )
+        pmesh = None
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
 
-        def new_acc():
-            return np.zeros(plan.base + 2, dtype=np.int32)
+    def _bind(mesh_, n_dev_):
+        """(dispatch, new_acc, fold_np) for the current mesh layout —
+        rebuilt by the elastic downshift when the layout shrinks. backend is
+        already resolved to exactly "pallas" or "jnp" here; pass it through
+        so an explicit backend="jnp" is honored on TPU too."""
+        if mesh_ is not None:
+            step = pmesh.make_sharded_stats_accum_step(
+                plan, batch_size, mesh_, kernel=backend
+            )
+            fold = pmesh.make_sharded_stats_fold(mesh_)
 
-        def dispatch(acc, batch_start, valid):
-            start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-            return accum_exec(acc, start_limbs, np.int32(valid))
+            def disp(acc_, item):
+                return step(acc_, item.starts, item.valids)
 
-        def fold_acc(acc):
-            return acc
+            def mk_acc():
+                return np.zeros((n_dev_, plan.base + 2), dtype=np.int32)
+
+            def fold_np(acc_):
+                # ONE psum per field/flush, off the dispatch thread.
+                return np.asarray(fold(acc_), dtype=np.int64)[: plan.base + 2]
+        else:
+            # Tuned shape knobs apply on the single-device path; the sharded
+            # step above stays at module defaults (its per-device kernel
+            # shape is owned by parallel/mesh.py).
+            accum_exec = _detailed_accum_executable(
+                plan, batch_size, backend, block_rows, carry_interval
+            )
+
+            def disp(acc_, item):
+                return accum_exec(
+                    acc_, item.starts[0], np.int32(int(item.valids[0]))
+                )
+
+            def mk_acc():
+                return np.zeros(plan.base + 2, dtype=np.int32)
+
+            def fold_np(acc_):
+                return np.asarray(acc_, dtype=np.int64)[: plan.base + 2]
+
+        return disp, mk_acc, fold_np
+
+    dispatch, new_acc, fold_np = _bind(mesh, n_dev)
+    lanes = batch_size * n_dev
 
     start = core.start()
     total = core.size()
+    segments = [(start, core.end())] if total else []
 
-    done0 = 0
     if resume is not None:
-        pos = int(resume["cursor"])
         if resume.get("hist") is None:
             raise ValueError("detailed resume state is missing a histogram")
         h = np.asarray(resume["hist"], dtype=np.int64)
@@ -1630,48 +1923,62 @@ def _process_range_detailed(
             NiceNumberSimple(number=int(n), num_uniques=int(u))
             for n, u in resume["nice_numbers"]
         ]
-        done0 = min(total, max(0, pos - start))
+        segments = _resume_segments(resume, start, core.end())
+        done0 = total - sum(e - s for s, e in segments)
         CKPT_RESTORES.inc()
         CKPT_BATCHES_SKIPPED.inc(done0 // lanes)
         log.info(
-            "detailed resume: cursor %d (%d of %d numbers already done)",
-            pos, done0, total,
+            "detailed resume: %d segment(s) remaining (%d of %d numbers "
+            "already done)", len(segments), done0, total,
         )
+    else:
+        done0 = 0
 
     import time as _time
+
+    def _ckpt_state(rem):
+        return {
+            "cursor": rem[0][0] if rem else core.end(),
+            "hist": hist.copy(),
+            "nice_numbers": [
+                (n.number, n.num_uniques) for n in nice_numbers
+            ],
+            "remaining": [[s, e] for s, e in rem],
+        }
 
     def collect_item(kind, *payload):
         t0 = _time.monotonic()
         if kind == "nm":
-            batch_start, valid, nm = payload
+            segs, nm = payload
             ENGINE_READBACK_BYTES.labels("nm").inc(4)
             if int(np.asarray(nm)) > 0:
-                # Rare path: compacted survivor extraction over this batch.
-                for number, uniq in _rare_scan_survivors(
-                    plan, batch_start, valid, lanes, backend,
-                    plan.near_miss_cutoff,
-                ):
-                    nice_numbers.append(
-                        NiceNumberSimple(number=number, num_uniques=uniq)
-                    )
+                # Rare path: compacted survivor extraction, per slice seg.
+                for seg_start, seg_valid in segs:
+                    if seg_valid <= 0:
+                        continue
+                    for number, uniq in _rare_scan_survivors(
+                        plan, seg_start, seg_valid, batch_size, backend,
+                        plan.near_miss_cutoff,
+                    ):
+                        nice_numbers.append(
+                            NiceNumberSimple(number=number, num_uniques=uniq)
+                        )
         elif kind == "stats":  # device-resident accumulator, ~once per field
-            (acc,) = payload
-            h = np.asarray(fold_acc(acc), dtype=np.int64)[: plan.base + 2]
+            acc_, fold_fn = payload
+            h = fold_fn(acc_)
             ENGINE_READBACK_BYTES.labels("stats").inc(h.nbytes)
             ENGINE_STATS_TRANSFERS.labels("detailed").inc()
             # Bin 0 carries tail-padding lane counts; no consumer reads it
             # (distributions report bins 1..base), so no correction needed.
             np.add(hist, h, out=hist)
+        elif kind == "stats_host":  # already folded (downshift boundary)
+            (h,) = payload
+            ENGINE_STATS_TRANSFERS.labels("detailed").inc()
+            np.add(hist, h, out=hist)
         else:  # "ckpt": marker enqueued AFTER a stats flush — everything up
-            # to its cursor is already folded into hist/nice_numbers here.
-            (pos,) = payload
-            checkpoint_cb({
-                "cursor": pos,
-                "hist": hist.copy(),
-                "nice_numbers": [
-                    (n.number, n.num_uniques) for n in nice_numbers
-                ],
-            })
+            # to its remaining-set is already folded into hist/nice_numbers.
+            (rem,) = payload
+            checkpoint_cb(_ckpt_state(rem))
         ENGINE_BATCH_KERNEL_SECONDS.labels("detailed").observe(
             _time.monotonic() - t0
         )
@@ -1688,67 +1995,165 @@ def _process_range_detailed(
         _CkptTicker(checkpoint_batches, checkpoint_secs)
         if checkpoint_cb else None
     )
+    feed_depth = _feed_depth()
     acc = new_acc()
     since_flush = 0
-    dispatch_failure = None  # (exception, cursor of the failed batch)
+    done = done0
+    n_batch = 0
+    n_dev0 = n_dev
+    reshards = 0
+    reshard_secs = 0.0
+    idle_gaps: list[float] = []
+    err_final = None  # (exception, remaining segments or None)
     with _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.detailed", base=base, size=total,
                       backend=backend):
-            done = done0
-            n_batch = 0
-            while done < total:
+            while segments:
                 if collector.failed():
                     break
-                valid = min(lanes, total - done)
-                batch_start = start + done
+                queues = (
+                    pmesh.partition_segments(segments, n_dev, batch_size)
+                    if mesh is not None else [list(segments)]
+                )
+                feed = _SliceFeed(
+                    plan, queues, batch_size, core.end(), feed_depth
+                )
+                markers = _SliceFeed.start_markers(queues)
+                failure = None
+                t_prev = None
                 try:
-                    # The chaos hook precedes the real dispatch so an
-                    # injected failure leaves the donated accumulator alive
-                    # and the flush below folds a consistent prefix.
-                    _fire_dispatch_fault(n_batch, backend, batch_start)
-                    acc, nm = dispatch(acc, batch_start, valid)
-                except Exception as e:  # noqa: BLE001 — degradation boundary
-                    dispatch_failure = (e, batch_start)
+                    while True:
+                        if collector.failed():
+                            break
+                        item = feed.get()
+                        if item is None:
+                            segments = []
+                            break
+                        now = _time.monotonic()
+                        if t_prev is not None:
+                            gap = now - t_prev
+                            MESH_FEED_IDLE.labels("detailed").observe(gap)
+                            if len(idle_gaps) < 65536:
+                                idle_gaps.append(gap)
+                        try:
+                            # The chaos hooks precede the real dispatch so an
+                            # injected failure leaves the donated accumulator
+                            # alive and the flush below folds a consistent
+                            # prefix.
+                            _fire_dispatch_fault(
+                                n_batch, backend, item.segs[0][0]
+                            )
+                            if mesh is not None:
+                                _fire_mesh_fault(
+                                    n_batch, n_dev, item.segs[0][0]
+                                )
+                            acc, nm = dispatch(acc, item)
+                        except Exception as e:  # noqa: BLE001 — boundary
+                            failure = e
+                            break
+                        t_prev = _time.monotonic()
+                        markers = item.markers
+                        n_batch += 1
+                        since_flush += 1
+                        done += item.lanes
+                        collector.put(("nm", item.segs, nm))
+                        if mesh is not None:
+                            for d, (_si, cur) in enumerate(item.markers):
+                                MESH_SLICE_CURSOR.labels(str(d)).set(cur)
+                        if ticker is not None and ticker.tick():
+                            # Export the donated device accumulator ahead of
+                            # the marker: by the time "ckpt" reaches the
+                            # collector, every batch before it has been
+                            # folded host-side.
+                            collector.put(("stats", acc, fold_np))
+                            acc = new_acc()
+                            since_flush = 0
+                            collector.put(
+                                ("ckpt",
+                                 _SliceFeed.remaining(queues, markers))
+                            )
+                        elif since_flush >= flush_every:
+                            collector.put(("stats", acc, fold_np))
+                            acc = new_acc()
+                            since_flush = 0
+                        if progress is not None:
+                            progress(done, total)
+                finally:
+                    feed.stop()
+                if failure is None:
+                    continue  # exhausted (or collector failed) — loop exits
+                rem = _SliceFeed.remaining(queues, markers)
+                survivors = None
+                if mesh is not None and _elastic_enabled():
+                    survivors, reason = _diagnose_survivors(mesh, failure)
+                if not survivors:
+                    err_final = (failure, rem)
                     break
-                n_batch += 1
-                collector.put(("nm", batch_start, valid, nm))
-                since_flush += 1
-                done += valid
-                if ticker is not None and ticker.tick():
-                    # Export the donated device accumulator ahead of the
-                    # marker: by the time "ckpt" reaches the collector, every
-                    # batch before the cursor has been folded host-side.
-                    collector.put(("stats", acc))
-                    acc = new_acc()
-                    since_flush = 0
-                    collector.put(("ckpt", start + done))
-                elif since_flush >= flush_every:
-                    collector.put(("stats", acc))
-                    acc = new_acc()
-                    since_flush = 0
-                if progress is not None:
-                    progress(done, total)
+                # Elastic downshift: fold the partial per-device accumulator
+                # SYNCHRONOUSLY (the old layout's fold must run before the
+                # old mesh goes away), hand the host-side rows to the
+                # collector, rebuild the mesh over the survivors, and
+                # re-slice the remaining range. No whole-field downgrade.
+                t_r0 = _time.monotonic()
+                try:
+                    folded = fold_np(acc)
+                except Exception as fold_err:  # noqa: BLE001
+                    # The failure invalidated the donated accumulator: the
+                    # unflushed batches are unrecoverable, so no consistent
+                    # mid-field state exists — degrade like PR 4 would.
+                    log.warning(
+                        "downshift abandoned: partial accumulator fold "
+                        "failed: %r", fold_err,
+                    )
+                    err_final = (failure, None)
+                    break
+                collector.put(("stats_host", folded))
+                since_flush = 0
+                pmesh.clear_step_cache(pmesh.mesh_device_ids(mesh))
+                _cached_mesh.cache_clear()
+                mesh = _cached_mesh(tuple(survivors))
+                prev_n = n_dev
+                n_dev = len(survivors)
+                dispatch, new_acc, fold_np = _bind(mesh, n_dev)
+                acc = new_acc()
+                lanes = batch_size * n_dev
+                flush_every = max(1, ((1 << 31) - 1) // (2 * lanes))
+                segments = rem
+                reshards += 1
+                dt = _time.monotonic() - t_r0
+                reshard_secs += dt
+                MESH_RESHARDS.labels(reason).inc()
+                MESH_RESHARD_SECONDS.observe(dt)
+                obs.flight.record(
+                    "mesh_reshard", mode="detailed", base=base,
+                    reason=reason, n_dev=n_dev, lost=prev_n - n_dev,
+                )
+                obs.trace_event(
+                    "mesh.reshard", mode="detailed", base=base,
+                    reason=reason, n_dev=n_dev,
+                )
+                log.warning(
+                    "mesh downshift (detailed b%d): %d -> %d devices "
+                    "(%s, %r); re-sliced %d remaining segment(s)",
+                    base, prev_n, n_dev, reason, failure, len(rem),
+                )
             if since_flush:
                 # Best-effort on the failure path: a real device error may
                 # have invalidated the donated accumulator, in which case the
                 # collector's fold fails too and the state below degrades to
                 # a full restart.
-                collector.put(("stats", acc))
-    if dispatch_failure is not None:
-        err, fail_cursor = dispatch_failure
+                collector.put(("stats", acc, fold_np))
+    _record_feed_stats("detailed", idle_gaps, n_batch, n_dev0, n_dev,
+                       reshards, reshard_secs, feed_depth)
+    if err_final is not None:
+        err, rem = err_final
         # The collector has drained: hist/nice_numbers now cover every batch
         # dispatched before the failure — exactly the checkpoint contract
-        # with cursor = the failed batch's start.
+        # with the failed batch inside the remaining set.
         state = None
-        if not collector.failed():
-            state = {
-                "cursor": fail_cursor,
-                "hist": hist.copy(),
-                "nice_numbers": [
-                    (n.number, n.num_uniques) for n in nice_numbers
-                ],
-            }
+        if rem is not None and not collector.failed():
+            state = _ckpt_state(rem)
         raise BackendDispatchError(backend, state, err)
     collector.raise_if_failed()
     ENGINE_NUMBERS.labels("detailed").inc(range_.size())
@@ -1874,28 +2279,33 @@ def _process_range_niceonly(
     for sub in slivers:
         nice_numbers.extend(sub.nice_numbers)
 
+    resume_segments = None
+    resume_filtered = False
     if resume is not None:
-        resume_pos = int(resume["cursor"])
         nice_numbers[:] = [
             NiceNumberSimple(number=int(n), num_uniques=int(u))
             for n, u in resume["nice_numbers"]
         ]
-        covered = max(0, min(resume_pos, core.end()) - core.start())
+        resume_segments = _resume_segments(resume, core.start(), core.end())
+        # "filtered" marks a remaining-set whose gaps were already proven
+        # empty (MSD/stride) — the dense path scans the segments directly
+        # instead of re-deriving the filter.
+        resume_filtered = bool(resume.get("filtered"))
+        covered = core.size() - sum(e - s for s, e in resume_segments)
         CKPT_RESTORES.inc()
         CKPT_BATCHES_SKIPPED.inc(covered // max(1, batch_size))
         log.info(
-            "niceonly resume: watermark %d (%d of %d core numbers already "
-            "covered)", resume_pos, covered, core.size(),
+            "niceonly resume: %d segment(s) remaining (%d of %d core "
+            "numbers already covered)",
+            len(resume_segments), covered, core.size(),
         )
-        if resume_pos >= core.end():
+        if not resume_segments:
             # The snapshot already covers the whole core; assembly only.
             nice_numbers.sort(key=lambda n: n.number)
             ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
             return FieldResults(
                 distribution=(), nice_numbers=tuple(nice_numbers)
             )
-        if resume_pos > core.start():
-            core = FieldSize(resume_pos, core.end())
 
     plan = get_plan(base)
     requested = backend
@@ -1917,6 +2327,20 @@ def _process_range_niceonly(
         ENGINE_HOST_FALLBACK.labels("limbs").inc()
         backend = "jnp"
     if backend == "pallas":
+        if resume_segments is not None:
+            # The strided pipeline (and the native host route below) scans
+            # ONE contiguous core: collapse a per-slice remaining set to its
+            # minimum cursor, dropping restored numbers inside the rescanned
+            # span so the covered islands above it can't double-report.
+            # (Sliver/post-core numbers sit outside [pos, core.end()).)
+            pos = resume_segments[0][0]
+            core_end = core.end()
+            nice_numbers[:] = [
+                n for n in nice_numbers
+                if n.number < pos or n.number >= core_end
+            ]
+            if pos > core.start():
+                core = FieldSize(pos, core_end)
         if _host_route_niceonly(core, base):
             # Small-field fast path: one device dispatch costs a readback RTT
             # that dwarfs the compute for sub-3e7 fields — the native host
@@ -1985,26 +2409,35 @@ def _process_range_niceonly(
     mesh = _mesh_or_none()
     if mesh is not None:
         from nice_tpu.parallel import mesh as pmesh
-
-        n_dev = mesh.devices.size
-        # Only the jnp dense path reaches here (the pallas strided path
-        # returned above), so the per-device kernel is jnp by construction.
-        step = pmesh.make_sharded_stats_step(
-            plan, batch_size, mesh, "niceonly", kernel="jnp"
-        )
-        lanes = batch_size * n_dev
     else:
-        lanes = batch_size
-        count_exec = _niceonly_dense_executable(plan, batch_size, carry_interval)
+        pmesh = None
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
 
-    def dispatch(batch_start, valid, core_end):
-        if mesh is not None:
-            starts, valids = _shard_inputs(
-                plan, core_end, batch_start, valid, batch_size, n_dev
+    def _bind(mesh_, n_dev_):
+        """Dispatch closure for the current mesh layout — rebuilt by the
+        elastic downshift. Only the jnp dense path reaches here (the pallas
+        strided path returned above), so the per-device kernel is jnp by
+        construction."""
+        if mesh_ is not None:
+            step = pmesh.make_sharded_stats_step(
+                plan, batch_size, mesh_, "niceonly", kernel="jnp"
             )
-            return step(starts, valids)
-        start_limbs = int_to_limbs(batch_start, plan.limbs_n)
-        return count_exec(start_limbs, np.int32(valid))
+
+            def disp(item):
+                return step(item.starts, item.valids)
+        else:
+            count_exec = _niceonly_dense_executable(
+                plan, batch_size, carry_interval
+            )
+
+            def disp(item):
+                return count_exec(
+                    item.starts[0], np.int32(int(item.valids[0]))
+                )
+
+        return disp
+
+    dispatch = _bind(mesh, n_dev)
 
     import time
 
@@ -2013,28 +2446,40 @@ def _process_range_niceonly(
         if checkpoint_cb else None
     )
 
-    def collect_item(batch_start, valid, count):
+    def _ckpt_state(rem):
+        # The covered complement of `rem` is scanned OR filtered-empty
+        # (MSD gaps), hence "filtered": a resume scans only the segments.
+        return {
+            "cursor": rem[0][0] if rem else core.end(),
+            "hist": None,
+            "nice_numbers": [
+                (n.number, n.num_uniques) for n in nice_numbers
+            ],
+            "remaining": [[s, e] for s, e in rem],
+            "filtered": True,
+        }
+
+    def collect_item(kind, *payload):
         t0 = time.monotonic()
-        ENGINE_READBACK_BYTES.labels("count").inc(4)
-        if int(np.asarray(count)) > 0:
-            # uniques > base-1 <=> == base: compacted nice extraction.
-            for number, _uniq in _rare_scan_survivors(
-                plan, batch_start, valid, lanes, backend, base - 1
-            ):
-                nice_numbers.append(
-                    NiceNumberSimple(number=number, num_uniques=base)
-                )
-        if ticker is not None and ticker.tick():
-            # Batches collect in dispatch order over ascending sub_ranges;
-            # the MSD gaps between them hold no nice numbers, so everything
-            # below this batch's end is accounted for.
-            checkpoint_cb({
-                "cursor": batch_start + valid,
-                "hist": None,
-                "nice_numbers": [
-                    (n.number, n.num_uniques) for n in nice_numbers
-                ],
-            })
+        if kind == "count":
+            segs, count = payload
+            ENGINE_READBACK_BYTES.labels("count").inc(4)
+            if int(np.asarray(count)) > 0:
+                # uniques > base-1 <=> == base: compacted nice extraction,
+                # per slice seg.
+                for seg_start, seg_valid in segs:
+                    if seg_valid <= 0:
+                        continue
+                    for number, _uniq in _rare_scan_survivors(
+                        plan, seg_start, seg_valid, batch_size, backend,
+                        base - 1,
+                    ):
+                        nice_numbers.append(
+                            NiceNumberSimple(number=number, num_uniques=base)
+                        )
+        else:  # "ckpt": by now every batch before the marker is folded.
+            (rem,) = payload
+            checkpoint_cb(_ckpt_state(rem))
         ENGINE_BATCH_KERNEL_SECONDS.labels("dense").observe(
             time.monotonic() - t0
         )
@@ -2048,72 +2493,156 @@ def _process_range_niceonly(
     ctrl = adaptive_floor.get_floor_controller("dense")
     t_host0 = time.monotonic()
     floor_used = ctrl.current()
-    sub_ranges = msd_filter.get_valid_ranges(
-        core, base, min_range_size=floor_used,
-        max_depth=_msd_depth_for(core.size(), floor_used),
-    )
+    if resume_segments is not None and resume_filtered:
+        # Cut from an earlier run's post-filter set: the gaps are already
+        # proven empty, so scan the segments directly (per-slice resume).
+        ran_filter = False
+        segments = list(resume_segments)
+    else:
+        ran_filter = True
+        scan_from = (
+            resume_segments if resume_segments is not None
+            else [(core.start(), core.end())]
+        )
+        segments = []
+        for s, e in scan_from:
+            for r in msd_filter.get_valid_ranges(
+                FieldSize(s, e), base, min_range_size=floor_used,
+                max_depth=_msd_depth_for(e - s, floor_used),
+            ):
+                segments.append((r.start(), r.end()))
     host_secs = time.monotonic() - t_host0
     t_dev0 = time.monotonic()
-    grand_total = sum(r.size() for r in sub_ranges)
+    n_segments0 = len(segments)
+    grand_total = sum(e - s for s, e in segments)
     grand_done = 0
+    feed_depth = _feed_depth()
+    n_batch = 0
+    n_dev0 = n_dev
+    reshards = 0
+    reshard_secs = 0.0
+    idle_gaps: list[float] = []
     # The count readback (+ rare-path extraction behind a hit) runs on the
-    # shared _Collector like every other path — previously this loop paid
-    # the device->host RTT synchronously on the dispatch thread once its
-    # deque filled (verdict task #6). Only the collector touches
-    # nice_numbers.
-    dispatch_failure = None  # (exception, cursor of the failed batch)
+    # shared _Collector like every other path; only the collector touches
+    # nice_numbers. Pod layer: per-slice queues, threaded feed, elastic
+    # downshift — see _process_range_detailed for the shape.
+    err_final = None  # (exception, remaining segments)
     with _Collector(collect_item, DISPATCH_WINDOW, "dense-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
         with obs.span("engine.niceonly-dense", base=base, size=core.size(),
                       backend=backend):
-            n_batch = 0
-            for sub_range in sub_ranges:
-                if collector.failed() or dispatch_failure is not None:
+            while segments:
+                if collector.failed():
                     break
-                start = sub_range.start()
-                total = sub_range.size()
-                done = 0
-                while done < total:
-                    if collector.failed():
-                        break
-                    valid = min(lanes, total - done)
-                    batch_start = start + done
-                    try:
-                        _fire_dispatch_fault(n_batch, backend, batch_start)
-                        counts = dispatch(batch_start, valid, sub_range.end())
-                    except Exception as e:  # noqa: BLE001 — degradation boundary
-                        dispatch_failure = (e, batch_start)
-                        break
-                    n_batch += 1
-                    collector.put((batch_start, valid, counts))
-                    done += valid
-                    grand_done += valid
-                    if progress is not None:
-                        progress(grand_done, grand_total)
-    if dispatch_failure is not None:
-        err, fail_cursor = dispatch_failure
-        # Batches dispatch in ascending order over ascending sub_ranges, and
-        # the MSD gaps between them hold no nice numbers — so after the
-        # collector drains, nice_numbers holds everything below the failed
-        # batch's start: a valid watermark cursor.
+                queues = (
+                    pmesh.partition_segments(segments, n_dev, batch_size)
+                    if mesh is not None else [list(segments)]
+                )
+                feed = _SliceFeed(
+                    plan, queues, batch_size, core.end(), feed_depth
+                )
+                markers = _SliceFeed.start_markers(queues)
+                failure = None
+                t_prev = None
+                try:
+                    while True:
+                        if collector.failed():
+                            break
+                        item = feed.get()
+                        if item is None:
+                            segments = []
+                            break
+                        now = time.monotonic()
+                        if t_prev is not None:
+                            gap = now - t_prev
+                            MESH_FEED_IDLE.labels("niceonly").observe(gap)
+                            if len(idle_gaps) < 65536:
+                                idle_gaps.append(gap)
+                        try:
+                            _fire_dispatch_fault(
+                                n_batch, backend, item.segs[0][0]
+                            )
+                            if mesh is not None:
+                                _fire_mesh_fault(
+                                    n_batch, n_dev, item.segs[0][0]
+                                )
+                            counts = dispatch(item)
+                        except Exception as e:  # noqa: BLE001 — boundary
+                            failure = e
+                            break
+                        t_prev = time.monotonic()
+                        markers = item.markers
+                        n_batch += 1
+                        grand_done += item.lanes
+                        collector.put(("count", item.segs, counts))
+                        if mesh is not None:
+                            for d, (_si, cur) in enumerate(item.markers):
+                                MESH_SLICE_CURSOR.labels(str(d)).set(cur)
+                        if ticker is not None and ticker.tick():
+                            collector.put(
+                                ("ckpt",
+                                 _SliceFeed.remaining(queues, markers))
+                            )
+                        if progress is not None:
+                            progress(grand_done, grand_total)
+                finally:
+                    feed.stop()
+                if failure is None:
+                    continue  # exhausted (or collector failed) — loop exits
+                rem = _SliceFeed.remaining(queues, markers)
+                survivors = None
+                if mesh is not None and _elastic_enabled():
+                    survivors, reason = _diagnose_survivors(mesh, failure)
+                if not survivors:
+                    err_final = (failure, rem)
+                    break
+                # Elastic downshift: no accumulator to fold here — rebuild
+                # the mesh over the survivors and re-slice the remainder.
+                t_r0 = time.monotonic()
+                pmesh.clear_step_cache(pmesh.mesh_device_ids(mesh))
+                _cached_mesh.cache_clear()
+                mesh = _cached_mesh(tuple(survivors))
+                prev_n = n_dev
+                n_dev = len(survivors)
+                dispatch = _bind(mesh, n_dev)
+                segments = rem
+                reshards += 1
+                dt = time.monotonic() - t_r0
+                reshard_secs += dt
+                MESH_RESHARDS.labels(reason).inc()
+                MESH_RESHARD_SECONDS.observe(dt)
+                obs.flight.record(
+                    "mesh_reshard", mode="niceonly", base=base,
+                    reason=reason, n_dev=n_dev, lost=prev_n - n_dev,
+                )
+                obs.trace_event(
+                    "mesh.reshard", mode="niceonly", base=base,
+                    reason=reason, n_dev=n_dev,
+                )
+                log.warning(
+                    "mesh downshift (niceonly b%d): %d -> %d devices "
+                    "(%s, %r); re-sliced %d remaining segment(s)",
+                    base, prev_n, n_dev, reason, failure, len(rem),
+                )
+    _record_feed_stats("niceonly", idle_gaps, n_batch, n_dev0, n_dev,
+                       reshards, reshard_secs, feed_depth)
+    if err_final is not None:
+        err, rem = err_final
+        # The collector has drained: nice_numbers holds every hit outside
+        # the remaining set — a valid per-slice (filtered) resume state.
         state = None
         if not collector.failed():
-            state = {
-                "cursor": fail_cursor,
-                "hist": None,
-                "nice_numbers": [
-                    (n.number, n.num_uniques) for n in nice_numbers
-                ],
-            }
+            state = _ckpt_state(rem)
         raise BackendDispatchError(backend, state, err)
     collector.raise_if_failed()
     device_secs = time.monotonic() - t_dev0
-    ctrl.observe(host_secs, device_secs, core.size())
+    if ran_filter:
+        ctrl.observe(host_secs, device_secs, core.size())
     log.info(
-        "niceonly-dense b%d [%d, %d): msd %.3fs (floor %d, %d ranges) | "
+        "niceonly-dense b%d [%d, %d): msd %.3fs (floor %d, %d segments) | "
         "device %.3fs | %d nice",
         base, core.start(), core.end(), host_secs, floor_used,
-        len(sub_ranges), device_secs, len(nice_numbers),
+        n_segments0, device_secs, len(nice_numbers),
     )
     ENGINE_NUMBERS.labels("niceonly").inc(range_.size())
 

@@ -14,7 +14,8 @@ reference keeps its coordination on HTTP while compute scales on-device.
 
 from __future__ import annotations
 
-import functools
+import logging
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +26,156 @@ from nice_tpu.obs.series import MESH_DEVICES, MESH_DISPATCH_SECONDS
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.ops.limbs import BasePlan
 
+log = logging.getLogger(__name__)
+
 FIELD_AXIS = "field"
+
+
+class MeshDeviceLost(RuntimeError):
+    """A mesh dispatch failed because one or more devices dropped.
+
+    lost: positions along the mesh's field axis (NOT device ids) of the
+    devices believed dead. Raised by the chaos hook (faults site
+    mesh.dispatch, action dead[:i[+j...]]) and available for real device-loss
+    detection; ops/engine.py catches it at the elastic downshift boundary."""
+
+    def __init__(self, lost, cause: BaseException | None = None):
+        self.lost = tuple(sorted(set(int(i) for i in lost)))
+        self.cause = cause
+        super().__init__(f"mesh device(s) lost at axis position(s) {self.lost}")
+
+
+# --- device liveness (real probes + simulated loss for chaos tests) -------
+
+_dead_lock = threading.Lock()
+_simulated_dead: set[int] = set()
+
+
+def simulate_device_loss(device_ids) -> None:
+    """Mark device ids as dead for probe_devices/live_devices. Lets chaos
+    tests (and the fault injector's dead:<i> action) drive the elastic
+    downshift path on hardware that cannot actually lose a device."""
+    with _dead_lock:
+        _simulated_dead.update(int(i) for i in device_ids)
+
+
+def heal_devices() -> None:
+    """Clear every simulated device loss (test teardown)."""
+    with _dead_lock:
+        _simulated_dead.clear()
+
+
+def live_devices(devices) -> list:
+    """Filter out simulated-dead devices (cheap; no probe dispatch)."""
+    with _dead_lock:
+        dead = set(_simulated_dead)
+    return [d for d in devices if int(d.id) not in dead]
+
+
+def probe_devices(devices) -> tuple[list, list]:
+    """Partition devices into (alive, lost) by running a trivial transfer +
+    add on each. Simulated-dead devices always count as lost."""
+    with _dead_lock:
+        dead = set(_simulated_dead)
+    alive, lost = [], []
+    for d in devices:
+        if int(d.id) in dead:
+            lost.append(d)
+            continue
+        try:
+            x = jax.device_put(np.ones((), dtype=np.int32), d) + 1
+            if int(np.asarray(x)) != 2:
+                raise RuntimeError("device probe computed garbage")
+            alive.append(d)
+        except Exception:  # noqa: BLE001 — any failure means "not usable"
+            lost.append(d)
+    return alive, lost
+
+
+def mesh_device_ids(mesh: Mesh) -> tuple[int, ...]:
+    """The cache identity of a mesh: its device ids in axis order."""
+    return tuple(int(d.id) for d in mesh.devices.flat)
+
+
+# --- sharded-step cache ----------------------------------------------------
+# Jitted sharded steps are cached per (kind, device ids, shape key) instead
+# of per Mesh object: two Mesh objects over the same devices share entries,
+# and — the part lru_cache got wrong — entries for a mesh that lost a device
+# can be evicted on downshift instead of pinning the dead Mesh (and its
+# compiled executables) for the life of the process.
+
+_step_lock = threading.Lock()
+_STEP_CACHE: dict = {}
+
+
+def _step_cached(kind: str, mesh: Mesh, extra_key, build):
+    key = (kind, mesh_device_ids(mesh), extra_key)
+    with _step_lock:
+        step = _STEP_CACHE.get(key)
+    if step is not None:
+        return step
+    step = build()
+    with _step_lock:
+        return _STEP_CACHE.setdefault(key, step)
+
+
+def clear_step_cache(device_ids=None) -> int:
+    """Evict cached sharded steps. device_ids (an iterable of ids, order-
+    insensitive) evicts every entry whose mesh contains ANY of those devices
+    — the downshift calls this with the dead mesh's ids so no stale Mesh
+    stays reachable. None clears everything. Returns entries dropped."""
+    with _step_lock:
+        if device_ids is None:
+            n = len(_STEP_CACHE)
+            _STEP_CACHE.clear()
+            return n
+        ids = set(int(i) for i in device_ids)
+        doomed = [k for k in _STEP_CACHE if ids.intersection(k[1])]
+        for k in doomed:
+            del _STEP_CACHE[k]
+        return len(doomed)
+
+
+def partition_segments(segments, n_slices: int, batch_size: int) -> list[list]:
+    """Split ascending, disjoint [start, end) segments into n_slices work
+    queues (lists of segments) of near-equal total size, cut points aligned
+    to batch_size so every slice dispatches whole batches until its tail.
+
+    This is the pod-slicing primitive: a field's (remaining) cursor range
+    becomes one queue per device, and a downshift re-runs it over the
+    survivors' count — slices may span several segments after a reshard."""
+    segs = [(int(s), int(e)) for s, e in segments if int(e) > int(s)]
+    n_slices = max(1, int(n_slices))
+    if not segs:
+        return [[] for _ in range(n_slices)]
+    total = sum(e - s for s, e in segs)
+    per = -(-total // n_slices)
+    per = -(-per // batch_size) * batch_size
+    out: list[list] = []
+    cur: list = []
+    room = per
+    i = 0
+    while i < len(segs):
+        s, e = segs[i]
+        if len(out) >= n_slices - 1:
+            cur.append((s, e))
+            i += 1
+            continue
+        take = min(room, e - s)
+        cur.append((s, s + take))
+        room -= take
+        if take < e - s:
+            segs[i] = (s + take, e)
+        else:
+            i += 1
+        if room == 0:
+            out.append(cur)
+            cur = []
+            room = per
+    out.append(cur)
+    while len(out) < n_slices:
+        out.append([])
+    return out
 
 
 def _shard_map(f, mesh: Mesh, in_specs, out_specs):
@@ -45,6 +195,16 @@ def _shard_map(f, mesh: Mesh, in_specs, out_specs):
     )
 
 
+# Serializes the ENQUEUE of every sharded executable. Two threads dispatching
+# collective programs concurrently (the feed loop's step and the collector's
+# histogram fold) can enqueue them in a different order on different devices;
+# per-device queues then each wait on the other program's replicas — a
+# classic collective deadlock (observed on the 8-virtual-device CPU mesh).
+# Holding the lock across the jit call makes the cross-device enqueue order
+# consistent; execution itself stays async and overlapped.
+_DISPATCH_LOCK = threading.RLock()
+
+
 def _timed_step(fn, mode: str):
     """Wrap a jitted sharded step so each dispatch lands in
     nice_mesh_dispatch_seconds{mode=...} (async enqueue cost under jit)."""
@@ -56,7 +216,8 @@ def _timed_step(fn, mode: str):
     def timed(*args, **kwargs):
         t0 = _time.perf_counter()
         try:
-            return fn(*args, **kwargs)
+            with _DISPATCH_LOCK:
+                return fn(*args, **kwargs)
         finally:
             MESH_DISPATCH_SECONDS.labels(mode).observe(
                 _time.perf_counter() - t0
@@ -108,7 +269,6 @@ def make_sharded_detailed_step(plan: BasePlan, per_device_batch: int, mesh: Mesh
     return jax.jit(sharded)
 
 
-@functools.lru_cache(maxsize=None)
 def make_sharded_stats_step(
     plan: BasePlan,
     per_device_batch: int,
@@ -133,6 +293,13 @@ def make_sharded_stats_step(
       detailed -> (histogram i32[>=base+2], near_miss_count i32), replicated
       niceonly -> nice count i32, replicated
     """
+    return _step_cached(
+        "stats", mesh, (plan, per_device_batch, mode, kernel),
+        lambda: _build_stats_step(plan, per_device_batch, mesh, mode, kernel),
+    )
+
+
+def _build_stats_step(plan, per_device_batch, mesh, mode, kernel):
     from nice_tpu.ops import pallas_engine as pe
 
     kernel = _resolve_kernel(plan, per_device_batch, kernel)
@@ -180,7 +347,6 @@ def _resolve_kernel(plan: BasePlan, per_device_batch: int, kernel: str):
     )
 
 
-@functools.lru_cache(maxsize=None)
 def make_sharded_stats_accum_step(
     plan: BasePlan,
     per_device_batch: int,
@@ -200,6 +366,13 @@ def make_sharded_stats_accum_step(
                starts u32[n_dev, limbs_n], valids i32[n_dev])
       -> (new_hist_acc, sharded; near_miss_count i32, replicated)
     """
+    return _step_cached(
+        "stats-accum", mesh, (plan, per_device_batch, kernel),
+        lambda: _build_stats_accum_step(plan, per_device_batch, mesh, kernel),
+    )
+
+
+def _build_stats_accum_step(plan, per_device_batch, mesh, kernel):
     from nice_tpu.ops import pallas_engine as pe
 
     kernel = _resolve_kernel(plan, per_device_batch, kernel)
@@ -221,12 +394,15 @@ def make_sharded_stats_accum_step(
     return _timed_step(jax.jit(sharded, donate_argnums=(0,)), "detailed-accum")
 
 
-@functools.lru_cache(maxsize=None)
 def make_sharded_stats_fold(mesh: Mesh):
     """The field-end reduction paired with make_sharded_stats_accum_step:
     ONE psum of the per-device accumulator rows over ICI, returning the
     replicated full-field histogram."""
+    return _step_cached("stats-fold", mesh, None,
+                        lambda: _build_stats_fold(mesh))
 
+
+def _build_stats_fold(mesh):
     def device_fold(hist_row):
         return jax.lax.psum(hist_row[0], FIELD_AXIS)
 
@@ -236,7 +412,6 @@ def make_sharded_stats_fold(mesh: Mesh):
     return _timed_step(jax.jit(sharded), "stats-fold")
 
 
-@functools.lru_cache(maxsize=None)
 def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
                               periods: int, mesh: Mesh):
     """Multi-chip stride-compacted niceonly step: the descriptor table is
@@ -250,6 +425,13 @@ def make_sharded_strided_step(plan: BasePlan, spec, per_device_desc: int,
     [d * 8 + i // 128, i % 128]. n_real[d] is the count of real (non-padding)
     rows in device d's shard; padded rows skip all lane compute.
     """
+    return _step_cached(
+        "strided", mesh, (plan, spec, per_device_desc, periods),
+        lambda: _build_strided_step(plan, spec, per_device_desc, periods, mesh),
+    )
+
+
+def _build_strided_step(plan, spec, per_device_desc, periods, mesh):
     from nice_tpu.ops import pallas_engine as pe
 
     def device_step(desc, n_real):

@@ -46,6 +46,8 @@ from nice_tpu.obs.series import (
     FLEET_FAULTS,
     FLEET_FIELD_LATENCY,
     FLEET_FIELDS,
+    FLEET_MESH_DEVICES,
+    FLEET_MESH_RESHARDS,
     FLEET_NUMBERS,
     FLEET_RATE,
     FLEET_RESTORES,
@@ -689,6 +691,8 @@ def build_fleet_block(ctx: ApiContext) -> dict:
     fields_by_mode = {"detailed": 0, "niceonly": 0}
     numbers = 0
     rate = downgrades = restores = faults_total = spool_depth = 0
+    mesh_devices = mesh_reshards = mesh_idle_count = 0
+    mesh_idle_sum = 0.0
     for c in clients:
         if c["backend"]:
             backends[c["backend"]] = backends.get(c["backend"], 0) + 1
@@ -700,6 +704,10 @@ def build_fleet_block(ctx: ApiContext) -> dict:
         restores += c["restores"]
         faults_total += c["faults"]
         spool_depth += c["spool_depth"]
+        mesh_devices += c.get("mesh_devices", 0)
+        mesh_reshards += c.get("mesh_reshards", 0)
+        mesh_idle_sum += c.get("mesh_feed_idle_sum", 0.0)
+        mesh_idle_count += c.get("mesh_feed_idle_count", 0)
 
     FLEET_CLIENTS.set(len(clients))
     FLEET_FIELDS.labels("detailed").set(fields_by_mode["detailed"])
@@ -710,6 +718,8 @@ def build_fleet_block(ctx: ApiContext) -> dict:
     FLEET_RESTORES.set(restores)
     FLEET_FAULTS.set(faults_total)
     FLEET_SPOOL_DEPTH.set(spool_depth)
+    FLEET_MESH_DEVICES.set(mesh_devices)
+    FLEET_MESH_RESHARDS.set(mesh_reshards)
     FLEET_FIELD_LATENCY.labels("0.5").set(p50)
     FLEET_FIELD_LATENCY.labels("0.95").set(p95)
 
@@ -731,6 +741,11 @@ def build_fleet_block(ctx: ApiContext) -> dict:
         "checkpoint_restores": restores,
         "faults_injected": faults_total,
         "spool_depth": spool_depth,
+        "mesh_devices": mesh_devices,
+        "mesh_reshards": mesh_reshards,
+        "mesh_feed_idle_mean_ms": round(
+            1000.0 * mesh_idle_sum / mesh_idle_count, 3
+        ) if mesh_idle_count else 0.0,
         "field_seconds_p50": p50,
         "field_seconds_p95": p95,
         "requests": requests,

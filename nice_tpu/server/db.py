@@ -1107,6 +1107,28 @@ class Db:
             ).fetchall()
         out = []
         for r in rows:
+            # Mesh stats ride only in the JSON snapshot column (no schema
+            # migration for a sub-dict that older clients never send).
+            mesh = {}
+            try:
+                snap = json.loads(r["snapshot"] or "{}")
+                if isinstance(snap.get("mesh"), dict):
+                    mesh = snap["mesh"]
+            except (ValueError, TypeError):
+                pass
+
+            def _mi(key):
+                try:
+                    return int(mesh.get(key, 0) or 0)
+                except (TypeError, ValueError):
+                    return 0
+
+            def _mf(m):
+                try:
+                    return float(m.get("feed_idle_sum", 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    return 0.0
+
             out.append(
                 {
                     "client_id": r["client_id"],
@@ -1124,6 +1146,10 @@ class Db:
                     "restores": r["restores"],
                     "faults": r["faults"],
                     "spool_depth": r["spool_depth"],
+                    "mesh_devices": _mi("devices"),
+                    "mesh_reshards": _mi("reshards"),
+                    "mesh_feed_idle_sum": _mf(mesh),
+                    "mesh_feed_idle_count": _mi("feed_idle_count"),
                 }
             )
         return out

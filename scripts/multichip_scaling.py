@@ -1,0 +1,232 @@
+"""Multi-chip scaling harness: fields/sec per chip count + feed A/B + drill.
+
+Extends the MULTICHIP_r0*.json dryruns from {n_devices, rc, ok} to real
+numbers. For each requested chip count the harness re-execs itself in a
+clean subprocess with that many VIRTUAL CPU devices forced before any jax
+import (utils.platform.force_virtual_cpu — XLA latches the flag at init, so
+chip counts cannot share a process) and measures, on the flagship detailed
+pipeline (base 40):
+
+  * synchronous baseline: NICE_TPU_FEED_DEPTH=0 — per-batch host limb
+    arithmetic runs inline on the dispatch thread (the pre-pod feed);
+  * pipelined: NICE_TPU_FEED_DEPTH=2 — the double-buffered feed precomputes
+    batch k+1's per-slice (starts, valids) rows while batch k runs;
+  * both runs are differential-checked against the scalar oracle, and the
+    engine's LAST_FEED_STATS supplies the inter-dispatch idle gap p50/p95
+    that proves (or disproves) the overlap;
+  * at the highest chip count, a reshard drill: the fault injector kills a
+    mesh device mid-field (site mesh.dispatch), the engine must downshift
+    onto the survivors, and the result must stay byte-identical to the
+    oracle with NO whole-field jnp/scalar downgrade.
+
+Prints ONE JSON report line (prefixed MULTICHIP_SCALING) and optionally
+writes it to --out. Usage:
+
+    python scripts/multichip_scaling.py [--chips 1,2,4,8] [--out report.json]
+    python scripts/multichip_scaling.py --worker 8   # internal: one count
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 40  # the extra-large benchmark base (full u32x3 limb pipeline)
+FIELD_SIZE = 24_576
+BATCH_SIZE = 256  # per-device lanes; total lanes scale with the mesh
+WORKER_TIMEOUT = 900
+
+
+def _timed_field(rng, feed_depth: int) -> dict:
+    """One detailed scan of rng at the given feed depth -> result + stats."""
+    from nice_tpu.ops import engine
+
+    os.environ["NICE_TPU_FEED_DEPTH"] = str(feed_depth)
+    t0 = time.monotonic()
+    results = engine.process_range_detailed(
+        rng, BASE, backend="jax", batch_size=BATCH_SIZE
+    )
+    elapsed = time.monotonic() - t0
+    stats = dict(engine.LAST_FEED_STATS)
+    return {
+        "elapsed_secs": round(elapsed, 4),
+        "numbers_per_sec": round(rng.size() / elapsed, 1),
+        "fields_per_sec": round(1.0 / elapsed, 4),
+        "dispatches": stats.get("dispatches", 0),
+        "idle_p50_us": round(1e6 * stats.get("idle_p50", 0.0), 1),
+        "idle_p95_us": round(1e6 * stats.get("idle_p95", 0.0), 1),
+        "idle_total_secs": round(stats.get("idle_total", 0.0), 4),
+        "feed_depth": stats.get("feed_depth", feed_depth),
+        "_results": results,
+    }
+
+
+def _reshard_drill(rng, want) -> dict:
+    """Kill a device mid-field; the run must downshift and stay exact."""
+    from nice_tpu import faults
+    from nice_tpu.ops import engine
+    from nice_tpu.parallel import mesh as pmesh
+
+    os.environ["NICE_TPU_FEED_DEPTH"] = "2"
+    try:
+        faults.configure("mesh.dispatch:dead@3")
+        t0 = time.monotonic()
+        results = engine.process_range_detailed(
+            rng, BASE, backend="jax", batch_size=BATCH_SIZE
+        )
+        elapsed = time.monotonic() - t0
+    finally:
+        faults.reset()
+        pmesh.heal_devices()
+    stats = dict(engine.LAST_FEED_STATS)
+    return {
+        "elapsed_secs": round(elapsed, 4),
+        "reshards": stats.get("reshards", 0),
+        "reshard_secs": round(stats.get("reshard_secs", 0.0), 4),
+        "n_dev_start": stats.get("n_dev_start", 0),
+        "n_dev_end": stats.get("n_dev_end", 0),
+        "byte_identical": (
+            results.distribution == want.distribution
+            and results.nice_numbers == want.nice_numbers
+        ),
+        "downgrades": list(results.backend_downgrades),
+        "ok": (
+            results.distribution == want.distribution
+            and results.nice_numbers == want.nice_numbers
+            and not results.backend_downgrades
+            and stats.get("reshards", 0) >= 1
+        ),
+    }
+
+
+def measure(n_devices: int, drill: bool = True) -> dict:
+    """Measure one chip count in THIS process (n_devices must be visible)."""
+    import jax
+
+    from nice_tpu.core import base_range
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import engine, scalar
+
+    visible = len(jax.devices())
+    assert visible >= n_devices, f"need {n_devices} devices, have {visible}"
+    lo, hi = base_range.get_base_range(BASE)
+    rng = FieldSize(lo, min(lo + FIELD_SIZE, hi))
+    want = scalar.process_range_detailed(rng, BASE)
+
+    # Compile outside the timed windows; both depths share the executables.
+    # warm_detailed covers the per-batch steps, the untimed full pass the
+    # rest (fold, rare-scan survivors) — so the sync-vs-pipelined A/B
+    # measures feed overlap, not whoever-went-first paying Mosaic/XLA.
+    engine.warm_detailed(BASE, batch_size=BATCH_SIZE, backend="jax")
+    _timed_field(rng, feed_depth=0)
+
+    sync = _timed_field(rng, feed_depth=0)
+    pipelined = _timed_field(rng, feed_depth=2)
+    out = {
+        "n_devices": n_devices,
+        "base": BASE,
+        "field_size": rng.size(),
+        "batch_size": BATCH_SIZE,
+        "oracle_match": all(
+            r["_results"].distribution == want.distribution
+            and r["_results"].nice_numbers == want.nice_numbers
+            for r in (sync, pipelined)
+        ),
+    }
+    for r in (sync, pipelined):
+        del r["_results"]
+    out["sync"] = sync
+    out["pipelined"] = pipelined
+    # Only a real mesh (>1 device) has an inter-dispatch feed to drill.
+    if drill and n_devices > 1:
+        out["reshard_drill"] = _reshard_drill(rng, want)
+    return out
+
+
+def _run_worker(n: int) -> dict:
+    """Re-exec this script for one chip count under a forced virtual mesh."""
+    from nice_tpu.utils.platform import force_virtual_cpu
+
+    env = dict(os.environ)
+    force_virtual_cpu(env, max(n, 1))
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", str(n)],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=WORKER_TIMEOUT,
+    )
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("MULTICHIP_WORKER "):
+            return json.loads(line[len("MULTICHIP_WORKER "):])
+    return {
+        "n_devices": n,
+        "error": f"worker rc={proc.returncode}",
+        "tail": (proc.stdout + proc.stderr)[-2000:],
+        "oracle_match": False,
+    }
+
+
+def build_report(chips: list[int]) -> dict:
+    per_chip = [_run_worker(n) for n in chips]
+    ok = all(c.get("oracle_match") for c in per_chip)
+    baseline = next(
+        (c for c in per_chip if "error" not in c and c["n_devices"] == 1), None
+    )
+    for c in per_chip:
+        if "error" in c:
+            continue
+        if baseline is not None:
+            c["speedup_vs_1"] = round(
+                c["pipelined"]["numbers_per_sec"]
+                / baseline["pipelined"]["numbers_per_sec"], 3,
+            )
+        drill = c.get("reshard_drill")
+        if drill is not None and not drill["ok"]:
+            ok = False
+    return {
+        "harness": "multichip_scaling",
+        "base": BASE,
+        "field_size": FIELD_SIZE,
+        "batch_size": BATCH_SIZE,
+        "chips": per_chip,
+        "ok": ok,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--chips", default="1,2,4,8",
+                   help="comma-separated virtual chip counts")
+    p.add_argument("--out", default="", help="also write the report here")
+    p.add_argument("--worker", type=int, default=0,
+                   help=argparse.SUPPRESS)  # internal: measure one count
+    args = p.parse_args(argv)
+
+    if args.worker:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        data = measure(args.worker)
+        print("MULTICHIP_WORKER " + json.dumps(data))
+        return 0
+
+    chips = sorted({int(c) for c in args.chips.split(",") if c.strip()})
+    report = build_report(chips)
+    line = json.dumps(report, indent=2)
+    print("MULTICHIP_SCALING " + json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

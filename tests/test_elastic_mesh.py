@@ -1,0 +1,316 @@
+"""Elastic pod execution layer tests (8-virtual-device CPU mesh).
+
+Covers the double-buffered host->device feed (depth A/B equivalence + stats),
+elastic mesh downshift (a device killed mid-field via the fault injector must
+reshard onto survivors and stay byte-identical to the fault-free scalar
+oracle, with NO whole-field jnp/scalar downgrade), per-slice checkpoint
+cursors (remaining-segment states resume byte-identically and survive the
+manager's snapshot roundtrip), the mesh step cache's device-id keying, and
+partition_segments' slicing invariants.
+"""
+
+import json
+
+import jax
+import pytest
+
+from nice_tpu import ckpt, faults
+from nice_tpu.client.main import compile_results
+from nice_tpu.core import base_range
+from nice_tpu.core.types import DataToClient, FieldSize, SearchMode
+from nice_tpu.ops import engine, scalar
+from nice_tpu.parallel import mesh as pmesh
+
+BASE = 17
+RANGE = FieldSize(5541, 30941)  # full base-17 valid range: 25,400 candidates
+
+
+@pytest.fixture(autouse=True)
+def _mesh_and_cleanup():
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
+    assert engine._mesh_or_none() is not None
+    yield
+    # Every test that kills a device or configures faults must not leak the
+    # degraded mesh into its neighbors.
+    faults.reset()
+    pmesh.heal_devices()
+
+
+def _field(claim_id=1):
+    return DataToClient(
+        claim_id=claim_id,
+        base=BASE,
+        range_start=RANGE.start(),
+        range_end=RANGE.end(),
+        range_size=RANGE.size(),
+    )
+
+
+# -- elastic downshift -------------------------------------------------------
+
+
+def test_downshift_detailed_byte_identical_to_oracle():
+    """Kill the last mesh device on dispatch 3 of a detailed field: the
+    engine must rebuild the mesh over the 7 survivors, re-slice the remaining
+    range, fold the partial accumulators, and finish ON DEVICE — the result
+    byte-identical to the fault-free scalar oracle with no whole-field
+    jnp/scalar downgrade."""
+    faults.configure("mesh.dispatch:dead@3")
+    got = engine.process_range_detailed(RANGE, BASE, backend="jnp", batch_size=256)
+    want = scalar.process_range_detailed(RANGE, BASE)
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades == ()  # downshift, not fallback
+    stats = engine.LAST_FEED_STATS
+    assert stats["reshards"] == 1
+    assert stats["n_dev_start"] == 8
+    assert stats["n_dev_end"] == 7
+    assert stats["reshard_secs"] > 0
+
+
+def test_downshift_niceonly_byte_identical_to_oracle():
+    faults.configure("mesh.dispatch:dead:0@3")  # kill device 0, 3rd dispatch
+    got = engine.process_range_niceonly(RANGE, BASE, backend="jnp", batch_size=256)
+    want = scalar.process_range_niceonly(RANGE, BASE, None)
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades == ()
+    stats = engine.LAST_FEED_STATS
+    assert stats["mode"] == "niceonly"
+    assert stats["reshards"] == 1
+    assert stats["n_dev_end"] == 7
+
+
+def test_downshift_multi_device_loss():
+    """Losing several devices at once still reshards onto the remainder."""
+    faults.configure("mesh.dispatch:dead:1+5+6@2")
+    got = engine.process_range_detailed(RANGE, BASE, backend="jnp", batch_size=256)
+    want = scalar.process_range_detailed(RANGE, BASE)
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades == ()
+    assert engine.LAST_FEED_STATS["n_dev_end"] == 5
+
+
+def test_elastic_disabled_restores_fallback_chain(monkeypatch):
+    """NICE_TPU_ELASTIC=0 is the PR 4 behavior: the device loss degrades the
+    whole field down the backend chain (correct but downgraded) instead of
+    resharding."""
+    monkeypatch.setenv("NICE_TPU_ELASTIC", "0")
+    faults.configure("mesh.dispatch:dead@3")
+    got = engine.process_range_detailed(RANGE, BASE, backend="jnp", batch_size=256)
+    want = scalar.process_range_detailed(RANGE, BASE)
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades != ()  # the whole-field downgrade happened
+
+
+# -- double-buffered feed ----------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", ["0", "2", "8"])
+def test_feed_depth_ab_equivalence(monkeypatch, depth):
+    """Synchronous (depth 0) and pipelined feeds produce identical results;
+    LAST_FEED_STATS records the depth actually used and the idle-gap series
+    the scaling harness reads."""
+    monkeypatch.setenv("NICE_TPU_FEED_DEPTH", depth)
+    got = engine.process_range_detailed(RANGE, BASE, backend="jnp", batch_size=256)
+    want = scalar.process_range_detailed(RANGE, BASE)
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    stats = engine.LAST_FEED_STATS
+    assert stats["feed_depth"] == int(depth)
+    assert stats["dispatches"] > 0
+    # One inter-dispatch gap per consecutive pair.
+    assert 0 < stats["gaps"] <= stats["dispatches"]
+    assert stats["idle_p95"] >= stats["idle_p50"] >= 0
+
+
+# -- per-slice checkpoint cursors --------------------------------------------
+
+
+def test_per_slice_ckpt_resume_byte_identical(tmp_path):
+    """Mesh-path checkpoints carry per-slice remaining segments; resuming
+    from a mid-field snapshot yields a byte-identical submission."""
+    data = _field()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.DETAILED, "jnp", 256
+    )
+    states = []
+    uninterrupted = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        checkpoint_cb=states.append, checkpoint_batches=1, checkpoint_secs=0,
+    )
+    mids = [s for s in states if s.get("remaining") and len(s["remaining"]) > 1]
+    assert mids, "no mid-field multi-slice checkpoint fired"
+    mid = mids[len(mids) // 2]
+    # Every remaining segment is ascending, disjoint, and inside the field.
+    prev_end = RANGE.start()
+    for s, e in mid["remaining"]:
+        assert RANGE.start() <= s < e <= RANGE.end()
+        assert s >= prev_end
+        prev_end = e
+    ck.save(mid)
+    resume = ck.load()
+    assert resume is not None
+    assert resume["remaining"] == [tuple(s) for s in mid["remaining"]]
+    resumed = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256, resume=resume,
+    )
+    a = compile_results(data, uninterrupted, SearchMode.DETAILED, "t")
+    b = compile_results(data, resumed, SearchMode.DETAILED, "t")
+    assert json.dumps(a.to_json(), sort_keys=True) == json.dumps(
+        b.to_json(), sort_keys=True
+    )
+
+
+def test_niceonly_remaining_resume_equivalence():
+    states = []
+    full = engine.process_range_niceonly(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        checkpoint_cb=states.append, checkpoint_batches=1, checkpoint_secs=0,
+    )
+    mids = [s for s in states if s.get("remaining")]
+    assert mids, "no remaining-segment checkpoints fired"
+    resumed = engine.process_range_niceonly(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        resume=mids[len(mids) // 2],
+    )
+    assert resumed.nice_numbers == full.nice_numbers
+    ref = scalar.process_range_niceonly(RANGE, BASE, None)
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_downshift_checkpoint_resume(tmp_path):
+    """A field that downshifted mid-scan still checkpoints resumable states:
+    kill a device AND a later abort, then resume from the last snapshot."""
+    data = _field()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.DETAILED, "jnp", 256
+    )
+    states = []
+
+    def save_and_capture(state):
+        ck.save(state)
+        states.append(state)
+
+    faults.configure("mesh.dispatch:dead@2")
+    engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256,
+        checkpoint_cb=save_and_capture, checkpoint_batches=1,
+        checkpoint_secs=0,
+    )
+    assert engine.LAST_FEED_STATS["reshards"] == 1
+    assert states, "no checkpoints fired"
+    faults.reset()
+    pmesh.heal_devices()
+    # Resume from the LAST post-downshift snapshot on the healed 8-dev mesh.
+    resume = ck.load()
+    assert resume is not None
+    resumed = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=256, resume=resume,
+    )
+    ref = scalar.process_range_detailed(RANGE, BASE)
+    assert resumed.distribution == ref.distribution
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_manager_remaining_roundtrip(tmp_path):
+    """The v2 state contract (remaining segments + filtered flag) survives
+    the snapshot format, and the signature carries the state version."""
+    data = _field()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.NICEONLY, "jnp", 256
+    )
+    assert ck.signature["state"] == 2
+    state = {
+        "cursor": 6000,
+        "hist": None,
+        "nice_numbers": [(5541, 12)],
+        "remaining": [(6000, 7000), (9000, 30941)],
+        "filtered": True,
+    }
+    ck.save(state)
+    got = ck.load()
+    assert got["remaining"] == [(6000, 7000), (9000, 30941)]
+    assert got["filtered"] is True
+    assert got["cursor"] == 6000
+    assert got["nice_numbers"] == [(5541, 12)]
+
+
+# -- mesh step cache ---------------------------------------------------------
+
+
+def test_step_cache_keyed_on_device_ids():
+    from nice_tpu.ops.limbs import get_plan
+
+    pmesh.clear_step_cache()
+    devices = jax.devices()[:4]
+    plan = get_plan(BASE)
+    m1 = pmesh.make_mesh(devices)
+    m2 = pmesh.make_mesh(devices)  # distinct Mesh object, same devices
+    s1 = pmesh.make_sharded_stats_step(plan, 128, m1, "detailed")
+    s2 = pmesh.make_sharded_stats_step(plan, 128, m2, "detailed")
+    assert s1 is s2  # dead-Mesh leak fix: keyed on device ids, not identity
+    # Evicting an id used by the entry drops it; a rebuild recompiles.
+    ids = pmesh.mesh_device_ids(m1)
+    assert pmesh.clear_step_cache([ids[0]]) >= 1
+    s3 = pmesh.make_sharded_stats_step(plan, 128, m1, "detailed")
+    assert s3 is not s1
+    # Clearing an id the entry does NOT contain leaves it cached.
+    assert pmesh.clear_step_cache([10_000]) == 0
+    assert pmesh.make_sharded_stats_step(plan, 128, m1, "detailed") is s3
+    pmesh.clear_step_cache()
+
+
+# -- partition_segments ------------------------------------------------------
+
+
+def _covered(queues):
+    segs = sorted(s for q in queues for s in q)
+    for a, b in zip(segs, segs[1:]):
+        assert a[1] <= b[0], f"overlap: {a} {b}"
+    return sum(e - s for s, e in segs)
+
+
+def test_partition_segments_covers_exactly():
+    segs = [(0, 1000), (5000, 5300)]
+    queues = pmesh.partition_segments(segs, 4, 128)
+    assert len(queues) == 4
+    assert _covered(queues) == 1300
+    # Every slice's TOTAL is cut at a batch multiple (here ceil(1300/4)
+    # rounded up to 128 -> 384) so slices dispatch whole batches until the
+    # tail; a slice may span a segment boundary after a reshard.
+    for q in queues[:-1]:
+        assert sum(e - s for s, e in q) == 384
+    assert queues[2] == [(768, 1000), (5000, 5152)]
+
+
+def test_partition_segments_fewer_than_slices():
+    queues = pmesh.partition_segments([(10, 20)], 8, 256)
+    assert len(queues) == 8
+    assert _covered(queues) == 10
+
+
+def test_partition_segments_empty():
+    assert pmesh.partition_segments([], 4, 128) == [[], [], [], []]
+
+
+def test_partition_segments_single_slice():
+    segs = [(0, 999), (2000, 2001)]
+    assert pmesh.partition_segments(segs, 1, 128) == [[(0, 999), (2000, 2001)]]
+
+
+# -- device-loss simulation helpers ------------------------------------------
+
+
+def test_simulated_loss_filters_live_devices():
+    devs = jax.devices()
+    pmesh.simulate_device_loss([devs[2].id, devs[5].id])
+    live = pmesh.live_devices(devs)
+    assert len(live) == len(devs) - 2
+    assert devs[2] not in live and devs[5] not in live
+    # _mesh_or_none builds over the survivors until heal_devices().
+    mesh = engine._mesh_or_none()
+    assert mesh is not None and mesh.devices.size == len(live)
+    pmesh.heal_devices()
+    assert len(pmesh.live_devices(devs)) == len(devs)

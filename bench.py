@@ -265,48 +265,76 @@ def _span_delta(before: dict, after: dict) -> dict:
 
 
 def _init_jax(remaining):
-    """Import jax and force backend init, re-exec'ing on transient failure.
+    """Import jax and force backend init, retrying on transient failure.
 
     Two failure shapes are handled (both observed on the axon tunnel):
     an exception from backend init, and an indefinite HANG in jax.devices()
-    (a wedged chip lease) — so the probe runs in a watchdog thread. jax
-    caches a failed backend, so an in-process retry would see the same
-    error; exec gives every attempt a clean process (the analog of the
-    reference client's 10-retry exponential backoff around claim/submit,
-    ref README.md:82-86, applied to device acquisition).
+    (a wedged chip lease) — so the probe always runs under a watchdog.
+    NICE_BENCH_PROBE picks which one:
+
+    - "subprocess" (default): the probe child is SIGKILLed on timeout, so a
+      wedged init can never outlive its watchdog, and the parent stays
+      jax-clean — retries loop in-process, no re-exec.
+    - "thread": the legacy daemon-thread probe. A hung thread is unjoinable
+      and jax caches the failed backend, so each retry must re-exec the
+      whole process to get a clean slate (the analog of the reference
+      client's 10-retry exponential backoff around claim/submit, ref
+      README.md:82-86, applied to device acquisition).
 
     NICE_BENCH_PLATFORM forces a platform (e.g. "cpu") AFTER import via
     jax.config.update — the env var alone is not enough because the axon
     PJRT plugin overrides JAX_PLATFORMS at import time (see
     nice_tpu/utils/platform.py).
     """
-    from nice_tpu.utils.platform import probe_backend
-
-    attempt = int(os.environ.get("NICE_BENCH_ATTEMPT", "1"))
-    default_timeout = _INIT_TIMEOUTS[
-        min(attempt - 1, len(_INIT_TIMEOUTS) - 1)
-    ]
-    timeout = float(os.environ.get("NICE_BENCH_INIT_TIMEOUT", default_timeout))
-    # Leave enough budget after init for at least the headline mode.
-    timeout = max(15.0, min(timeout, remaining() - 90.0))
-    _phase("backend-init", "begin", attempt=attempt, timeout_s=timeout)
-    n_chips, exc = probe_backend(
-        timeout_s=timeout,
-        platform=os.environ.get("NICE_BENCH_PLATFORM"),
+    from nice_tpu.utils.platform import (
+        probe_backend,
+        probe_backend_subprocess,
     )
 
-    if exc is not None:
-        # probe_backend's TimeoutError message names the stalled init phase
-        # (import-jax / configure / devices) — carry it into the timeline so
-        # a wedged device lease is diagnosable from the phase lines alone.
+    probe_mode = os.environ.get("NICE_BENCH_PROBE", "subprocess")
+    probe = probe_backend if probe_mode == "thread" else (
+        probe_backend_subprocess
+    )
+    while True:
+        attempt = int(os.environ.get("NICE_BENCH_ATTEMPT", "1"))
+        default_timeout = _INIT_TIMEOUTS[
+            min(attempt - 1, len(_INIT_TIMEOUTS) - 1)
+        ]
+        timeout = float(
+            os.environ.get("NICE_BENCH_INIT_TIMEOUT", default_timeout)
+        )
+        # Leave enough budget after init for at least the headline mode.
+        timeout = max(15.0, min(timeout, remaining() - 90.0))
+        _phase(
+            "backend-init", "begin", attempt=attempt, timeout_s=timeout,
+            probe=probe_mode,
+        )
+        n_chips, exc = probe(
+            timeout_s=timeout,
+            platform=os.environ.get("NICE_BENCH_PLATFORM"),
+        )
+        if exc is None:
+            break
+
+        # The probe's TimeoutError message names where init stalled (the
+        # thread probe's phase, or the killed subprocess) — carry it into
+        # the timeline so a wedged device lease is diagnosable from the
+        # phase lines alone.
         _phase("backend-init", "error", attempt=attempt, error=repr(exc))
-        # No attempt cap: keep re-exec'ing (each attempt's timeout shrinks
+        # No attempt cap: keep retrying (each attempt's timeout shrinks
         # with the remaining budget) until there is no longer room for one
         # more attempt plus the headline mode.
         if remaining() > _INIT_RETRY_FLOOR:
             time.sleep(min(5 * attempt, 30))
-            env = dict(os.environ, NICE_BENCH_ATTEMPT=str(attempt + 1))
-            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+            os.environ["NICE_BENCH_ATTEMPT"] = str(attempt + 1)
+            if probe_mode == "thread":
+                # Hung watchdog thread + cached failed backend poison this
+                # process; only exec gives the next attempt a clean slate.
+                os.execve(
+                    sys.executable, [sys.executable] + sys.argv,
+                    dict(os.environ),
+                )
+            continue  # subprocess probe left this process jax-clean
         err = _error_line(
             "numbers/sec/chip (benchmark suite)",
             f"jax backend init failed after {attempt} attempts "
@@ -319,7 +347,7 @@ def _init_jax(remaining):
             # explicit) but is never left with nothing.
             err["stale_reference"] = stale
         print(json.dumps(err), flush=True)
-        os._exit(1)  # a hung init thread cannot be joined; exit hard
+        os._exit(1)  # a hung init thread (thread probe) cannot be joined
 
     _phase("backend-init", "end", attempt=attempt, n_chips=n_chips)
     import jax

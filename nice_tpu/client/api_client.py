@@ -211,9 +211,10 @@ def retry_request(
     timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
     endpoint: str = "other",
 ) -> Any:
-    """GET/POST with full-jitter exponential backoff on 5xx and network
-    errors: each retry sleeps uniform(0, min(2^attempt, cap)) seconds, unless
-    the response carried Retry-After (server overload shed), which wins.
+    """GET/POST with full-jitter exponential backoff on 5xx, 429, and
+    network errors: each retry sleeps uniform(0, min(2^attempt, cap))
+    seconds, unless the response carried Retry-After (server overload shed
+    or per-client rate limit), which wins.
 
     endpoint labels the per-attempt latency histogram and retry counter
     (claim / submit / validate / renew / other). Every attempt carries a
@@ -237,7 +238,10 @@ def retry_request(
             CLIENT_REQUEST_SECONDS.labels(endpoint).observe(
                 time.monotonic() - t0
             )
-            if e.code < 500:
+            if e.code < 500 and e.code != 429:
+                # 4xx = our request is wrong, retrying won't fix it — except
+                # 429 (per-client rate limit): that clears with time, so it
+                # backs off like a 5xx, honoring the server's Retry-After.
                 detail = ""
                 try:
                     detail = e.read().decode(errors="replace")
@@ -253,7 +257,13 @@ def retry_request(
             )
             err = e
         if attempt >= max_retries:
-            raise ApiError(f"request to {url} failed after {attempt} retries: {err}")
+            # Preserve the HTTP status when the last failure was a definite
+            # server answer (429/5xx), so callers can distinguish "rate
+            # limited until I slow down" from a dead transport.
+            raise ApiError(
+                f"request to {url} failed after {attempt} retries: {err}",
+                status=getattr(err, "code", None),
+            )
         CLIENT_RETRIES.labels(endpoint).inc()
         obs.flight.record("retry", endpoint=endpoint, attempt=attempt,
                           error=str(err)[:200])

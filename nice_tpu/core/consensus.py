@@ -4,11 +4,17 @@ Mirrors reference common/src/consensus.rs:13-73. Submissions are grouped by
 their (sorted distribution, sorted numbers) content; the largest group wins and
 its earliest submission becomes canon; check_level = group size + 1, capped at
 255. Zero submissions resets canon and caps check_level at 1.
+
+Untrusted-client extension: callers may pass the set of submission ids that
+came from below-trust-threshold clients. An untrusted submission can never
+carry a field to canon ALONE — it needs a second, independent submission whose
+content agrees (the agreeing group is its corroboration). With an empty
+untrusted set the behavior is byte-identical to the reference.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import FrozenSet, Optional
 
 from nice_tpu.core import distribution_stats, number_stats
 from nice_tpu.core.types import (
@@ -19,12 +25,18 @@ from nice_tpu.core.types import (
 
 
 def evaluate_consensus(
-    field: FieldRecord, submissions: list[SubmissionRecord]
+    field: FieldRecord,
+    submissions: list[SubmissionRecord],
+    untrusted_ids: FrozenSet[int] = frozenset(),
 ) -> tuple[Optional[SubmissionRecord], int]:
     """Return (canon submission or None, new check_level)."""
     if not submissions:
         return (None, min(field.check_level, 1))
     if len(submissions) == 1:
+        if submissions[0].submission_id in untrusted_ids:
+            # Needs consensus: hold at check_level 1 so the claim
+            # strategies re-issue the field to an independent client.
+            return (None, 1)
         return (submissions[0], 2)
 
     groups: dict[SubmissionCandidate, list[SubmissionRecord]] = {}
@@ -44,5 +56,11 @@ def evaluate_consensus(
 
     majority_group = max(groups.values(), key=len)
     first_submission = min(majority_group, key=lambda s: s.submit_time)
+    if len(majority_group) < 2 and all(
+        s.submission_id in untrusted_ids for s in majority_group
+    ):
+        # The winning content is vouched for by exactly one client, and an
+        # untrusted one: no corroboration, no canon.
+        return (None, 1)
     check_level = min(len(majority_group) + 1, 255)
     return (first_submission, check_level)

@@ -305,13 +305,21 @@ class FieldRecord:
 
 @dataclass
 class ClaimRecord:
-    """A claim log row (reference lib.rs:286-292)."""
+    """A claim log row (reference lib.rs:286-292).
+
+    client_token / lease_expiry / lease_secs are the untrusted-client
+    extensions: the trust identity the claim was issued to and its explicit
+    lease window (None on rows minted by pre-trust servers, which follow the
+    legacy global expiry cutoff only)."""
 
     claim_id: int
     field_id: int
     search_mode: SearchMode
     claim_time: datetime
     user_ip: str
+    client_token: Optional[str] = None
+    lease_expiry: Optional[datetime] = None
+    lease_secs: Optional[float] = None
 
 
 @dataclass
@@ -330,6 +338,7 @@ class SubmissionRecord:
     disqualified: bool
     distribution: Optional[list[UniquesDistribution]]
     numbers: list[NiceNumber]
+    client_token: Optional[str] = None
 
 
 @dataclass(frozen=True)

@@ -25,12 +25,45 @@ from nice_tpu.server.db import Db, pad
 log = logging.getLogger("nice_tpu.jobs")
 
 
+def _untrusted_submission_ids(
+    db: Db, submissions: list[SubmissionRecord], threshold: float,
+    _cache: dict,
+) -> frozenset:
+    """Submission ids from below-threshold clients (legacy rows with no
+    client_token count as trusted — they predate the trust ledger). The
+    per-run cache keeps this at one trust read per client, not per field."""
+    if threshold <= 0:
+        return frozenset()
+    out = set()
+    for sub in submissions:
+        token = sub.client_token
+        if token is None:
+            continue
+        if token not in _cache:
+            row = db.get_client_trust(token)
+            _cache[token] = bool(
+                row and not row["suspect"] and row["trust"] >= threshold
+            )
+        if not _cache[token]:
+            out.add(sub.submission_id)
+    return frozenset(out)
+
+
 def run_consensus_for_base(db: Db, base: int) -> int:
     """Returns the number of fields whose canon/check_level changed."""
+    import os
+
     changed = 0
+    threshold = float(os.environ.get("NICE_TPU_TRUST_THRESHOLD", 0))
+    trust_cache: dict = {}
     for field in db.get_fields_with_detailed_submissions(base):
         submissions = db.get_detailed_submissions_by_field(field.field_id)
-        canon, check_level = consensus.evaluate_consensus(field, submissions)
+        untrusted_ids = _untrusted_submission_ids(
+            db, submissions, threshold, trust_cache
+        )
+        canon, check_level = consensus.evaluate_consensus(
+            field, submissions, untrusted_ids
+        )
         if canon is None:
             if field.canon_submission_id is not None or field.check_level > 1:
                 log.warning(

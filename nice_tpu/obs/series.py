@@ -276,6 +276,39 @@ SERVER_STATUS_CACHE_EVENTS = metrics.counter(
     labelnames=("event",),
 )
 
+# --- untrusted-client hardening (server/trust.py, server/app.py) ---------
+SERVER_SPOT_CHECKS = metrics.counter(
+    "nice_server_spot_checks_total",
+    "Spot verifications of accepted submissions on the trusted scalar "
+    "engine, by verdict (pass / fail / skipped — skipped = the seeded "
+    "sampler elected not to verify this submission).",
+    labelnames=("verdict",),
+)
+SERVER_TRUST_SLASHES = metrics.counter(
+    "nice_server_trust_slashes_total",
+    "Trust scores slashed to zero after a failed spot check.",
+)
+SERVER_TRUST_CLIENTS = metrics.gauge(
+    "nice_server_trust_clients",
+    "Clients in the trust ledger, by tier (trusted / untrusted / suspect).",
+    labelnames=("tier",),
+)
+SERVER_RATE_LIMITED = metrics.counter(
+    "nice_server_rate_limited_total",
+    "Requests answered 429 + Retry-After by the per-client token-bucket "
+    "limiter (distinct from the global 503 overload shed).",
+)
+SERVER_LEASES_EXPIRED = metrics.counter(
+    "nice_server_leases_expired_total",
+    "Expired claim leases whose fields the background sweep released for "
+    "re-issue (abandoned micro-field claims).",
+)
+SERVER_CONSENSUS_HOLDS = metrics.counter(
+    "nice_server_consensus_holds_total",
+    "Detailed submissions from below-threshold clients held at "
+    "needs-consensus instead of promoting canon directly.",
+)
+
 # --- fleet telemetry aggregation (server/app.py, server/db.py) -----------
 # Re-exported from client_telemetry rows the server persists: each client
 # ships a compact registry snapshot with every submission and with the
@@ -409,6 +442,10 @@ for _source in ("heartbeat", "submission"):
     SERVER_TELEMETRY_REPORTS.labels(_source)
 for _event in ("hit", "miss"):
     SERVER_STATUS_CACHE_EVENTS.labels(_event)
+for _verdict in ("pass", "fail", "skipped"):
+    SERVER_SPOT_CHECKS.labels(_verdict)
+for _tier in ("trusted", "untrusted", "suspect"):
+    SERVER_TRUST_CLIENTS.labels(_tier)
 for _queue in ("niceonly", "detailed_thin"):
     SERVER_FIELD_QUEUE_REFILLS.labels(_queue)
 for _reason in ("corrupt", "signature", "version"):

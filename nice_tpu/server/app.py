@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import dataclasses
 import json
 import logging
 import os
@@ -32,8 +33,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from nice_tpu import faults, obs
-from nice_tpu.core import distribution_stats, number_stats
-from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
+from nice_tpu.core import consensus, distribution_stats, number_stats
+from nice_tpu.core.constants import (
+    CLAIM_DURATION_HOURS,
+    DETAILED_SEARCH_MAX_FIELD_SIZE,
+)
 from nice_tpu.core.types import (
     DataToClient,
     DataToServer,
@@ -53,14 +57,26 @@ from nice_tpu.obs.series import (
     FLEET_RESTORES,
     FLEET_SPOOL_DEPTH,
     SERVER_BLOCK_LEASE_SIZE,
+    SERVER_CONSENSUS_HOLDS,
     SERVER_DUPLICATE_SUBMITS,
     SERVER_FIELD_ELAPSED,
+    SERVER_LEASES_EXPIRED,
     SERVER_OVERLOAD_RESPONSES,
+    SERVER_RATE_LIMITED,
+    SERVER_SPOT_CHECKS,
     SERVER_STATUS_CACHE_EVENTS,
     SERVER_TELEMETRY_REPORTS,
+    SERVER_TRUST_CLIENTS,
+    SERVER_TRUST_SLASHES,
 )
 from nice_tpu.ops import scalar
-from nice_tpu.server.async_core import AsyncHTTPServer, Request, Response
+from nice_tpu.server import trust as trust_mod
+from nice_tpu.server.async_core import (
+    AsyncHTTPServer,
+    Request,
+    Response,
+    TokenBucketLimiter,
+)
 from nice_tpu.server.db import Db
 from nice_tpu.server.field_queue import U128_MAX, FieldQueue
 from nice_tpu.server.writer import DirectWriter, WriteActor
@@ -141,8 +157,37 @@ class ApiContext:
             self.writer = WriteActor(db)
         else:
             self.writer = DirectWriter(db)
+        # Crash counterpart of FieldQueue.close(): a SIGKILLed server's
+        # in-memory inventory left lease stamps with no claims rows; release
+        # them before this process's queue starts bulk-claiming.
+        orphaned = db.release_orphaned_inventory()
+        if orphaned:
+            log.info(
+                "released %d orphaned pre-claimed fields from a dead"
+                " server's queue inventory", orphaned,
+            )
         self.queue = FieldQueue(db, writer=self.writer)
         self.metrics = Metrics()
+        # Untrusted-client hardening: the trust ledger cache (spot-check
+        # sampling rates, claim profiles) and the per-client token-bucket
+        # rate limiter (429s, distinct from the global 503 shed). The
+        # limiter is opt-in via NICE_TPU_RATE_BUCKET="capacity:refill" —
+        # with no client token the fallback key is the client IP, which
+        # would throttle NAT'd fleets and the load harness if it were
+        # always on. The limiter's trust multiplier reads ONLY the
+        # in-memory cache — it is consulted on the event-loop thread.
+        self.trust = trust_mod.TrustStore(db)
+        self.limiter = None
+        if os.environ.get("NICE_TPU_RATE_BUCKET"):
+            self.limiter = TokenBucketLimiter(
+                multiplier=self._bucket_multiplier
+            )
+        # Lease-expiry sweep: abandoned micro-field claims are released on
+        # the writer thread so re-issue never waits out the global claim
+        # expiry cutoff. NICE_TPU_LEASE_SWEEP_SECS=0 disables.
+        sweep_secs = float(os.environ.get("NICE_TPU_LEASE_SWEEP_SECS", 5.0))
+        if sweep_secs > 0:
+            self.writer.add_periodic(self._sweep_leases, sweep_secs)
         # Overload shed: when more than max_inflight requests are being
         # handled at once, new ones (except /metrics) get 503 + Retry-After
         # instead of queueing unboundedly behind the worker pool. Clients
@@ -166,6 +211,18 @@ class ApiContext:
         """Run one mutation through the writer actor, blocking for its
         result (exceptions — notably IntegrityError — re-raise here)."""
         return self.writer.call(fn, *args, **kwargs)
+
+    def _bucket_multiplier(self, token: str) -> float:
+        """Trusted veterans earn bigger rate-limit buckets (up to 4x).
+        Cache-only read: this runs on the event-loop thread."""
+        row = self.trust.peek(token)
+        if not row or row.get("suspect"):
+            return 1.0
+        return 1.0 + min(3.0, float(row.get("trust", 0.0)) / 25.0)
+
+    def _sweep_leases(self) -> None:
+        if self.db.release_expired_leases():
+            self.invalidate_status_cache()
 
     def cached_fleet_block(self) -> dict:
         now = time.monotonic()
@@ -202,21 +259,75 @@ class ApiContext:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, headers: dict | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # Extra response headers (Retry-After on 429s); merged into the
+        # error response by route_request.
+        self.headers = headers or {}
 
 
 def _max_claim_block() -> int:
     return max(1, int(os.environ.get("NICE_TPU_MAX_CLAIM_BLOCK", 128)))
 
 
-def _roll_claim_strategy(search_mode: SearchMode):
+def _untrusted_lease_secs() -> float:
+    """Lease window for claims issued to below-threshold clients: short, so
+    an abandoner's fields recycle in seconds."""
+    return float(os.environ.get("NICE_TPU_UNTRUSTED_LEASE_SECS", 120))
+
+
+def _claim_lease_secs(untrusted: bool) -> float:
+    """Every new claim now carries an explicit lease window (the sweep only
+    touches claims that have one): trusted clients get the global claim
+    expiry window, untrusted ones the short micro-lease."""
+    if untrusted:
+        return _untrusted_lease_secs()
+    return float(
+        os.environ.get("NICE_TPU_CLAIM_EXPIRY_SECS", CLAIM_DURATION_HOURS * 3600)
+    )
+
+
+def _untrusted_max_field() -> int:
+    """Range-size cap for untrusted claims (micro-fields): a forged or
+    abandoned result costs at most this much honest recomputation."""
+    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_FIELD", 1_000_000))
+
+
+def _untrusted_max_claims() -> int:
+    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_CLAIMS", 16))
+
+
+def _enforce_claim_cap(ctx: ApiContext, client_token: str, requested: int) -> int:
+    """Cap outstanding (unexpired, unsubmitted) claims per untrusted client
+    so a hoarder cannot lock up the frontier. Returns how many of the
+    requested claims fit; raises 429 when none do."""
+    cap = _untrusted_max_claims()
+    open_claims = ctx.db.count_open_claims(client_token)
+    allowed = max(0, cap - open_claims)
+    if allowed == 0:
+        raise ApiError(
+            429,
+            f"too many outstanding claims ({open_claims} open, cap {cap});"
+            " submit results or let the leases expire",
+            headers={
+                "Retry-After": str(
+                    max(1, min(int(_untrusted_lease_secs()), 30))
+                )
+            },
+        )
+    return min(requested, allowed)
+
+
+def _roll_claim_strategy(search_mode: SearchMode, untrusted: bool = False):
     """The 80/15/4/1 detailed strategy mix (reference api/src/main.rs:66-229);
-    one roll covers a whole block."""
+    one roll covers a whole block. The untrusted profile keeps the mix but
+    clamps the field size to micro-fields — cheap to re-issue when the
+    short lease expires or a spot check disqualifies the result."""
     if search_mode == SearchMode.NICEONLY:
-        return FieldClaimStrategy.NEXT, 0, U128_MAX
+        max_range_size = _untrusted_max_field() if untrusted else U128_MAX
+        return FieldClaimStrategy.NEXT, 0, max_range_size
     roll = random.randint(1, 100)
     if roll <= 80:
         claim_strategy, max_check_level = FieldClaimStrategy.THIN, 1
@@ -226,7 +337,10 @@ def _roll_claim_strategy(search_mode: SearchMode):
         claim_strategy, max_check_level = FieldClaimStrategy.NEXT, 2
     else:
         claim_strategy, max_check_level = FieldClaimStrategy.RANDOM, 1
-    return claim_strategy, max_check_level, DETAILED_SEARCH_MAX_FIELD_SIZE
+    max_range_size = DETAILED_SEARCH_MAX_FIELD_SIZE
+    if untrusted:
+        max_range_size = min(max_range_size, _untrusted_max_field())
+    return claim_strategy, max_check_level, max_range_size
 
 
 def _claim_fields(
@@ -267,21 +381,34 @@ def _claim_fields(
             )
     if not fields:
         # Everything is recently claimed: fall back to possibly-active fields
-        # (reference api/src/main.rs:150-168).
+        # (reference api/src/main.rs:150-168). Prefer the least-checked,
+        # longest-abandoned field — re-issuing a dead client's stale cl-0
+        # lease beats a redundant re-check of a completed field.
         from nice_tpu.server.db import now_utc
 
         fields = ctx.db._claim_batch(
             FieldClaimStrategy.NEXT, now_utc(), max_check_level,
-            max_range_size, count,
+            max_range_size, count, order_by=ctx.db.PREFER_ABANDONED,
         )
     return fields
 
 
-def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> DataToClient:
+def claim_helper(
+    ctx: ApiContext,
+    search_mode: SearchMode,
+    user_ip: str,
+    client_token: str | None = None,
+) -> DataToClient:
     """Claim one field (the per-field compatibility path)."""
-    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
-        search_mode
+    untrusted = client_token is not None and not ctx.trust.is_trusted(
+        client_token
     )
+    if untrusted:
+        _enforce_claim_cap(ctx, client_token, 1)
+    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
+        search_mode, untrusted
+    )
+    lease_secs = _claim_lease_secs(untrusted)
 
     def op():
         fields = _claim_fields(
@@ -294,7 +421,10 @@ def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> Data
                 f" {max_check_level} and maximum size {max_range_size}!",
             )
         field = fields[0]
-        claim = ctx.db.insert_claim(field.field_id, search_mode, user_ip)
+        claim = ctx.db.insert_claim(
+            field.field_id, search_mode, user_ip,
+            client_token=client_token, lease_secs=lease_secs,
+        )
         return field, claim
 
     field, claim = ctx.write(op)
@@ -314,14 +444,18 @@ def claim_helper(ctx: ApiContext, search_mode: SearchMode, user_ip: str) -> Data
     )
 
 
-def handle_claim_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+def handle_claim_block(
+    ctx: ApiContext, payload: dict, user_ip: str, headers=None
+) -> dict:
     """POST /claim_block: N fields per round-trip under ONE block lease.
 
     The strategy mix rolls once per block; every member claim row carries the
     same block_id, so one /renew_claim {block_id} heartbeat re-arms all of
     them and — because their last_claim_time is stamped and renewed together
     — expiry releases the whole block at once. A partial block (fewer fields
-    than asked) is success, not an error."""
+    than asked) is success, not an error. Untrusted clients get the
+    micro-field profile: clamped field size, short lease, and a cap on
+    outstanding claims (429 once they hoard up to it)."""
     mode_arg = payload.get("mode") or payload.get("search_mode")
     if mode_arg not in ("detailed", "niceonly"):
         raise ApiError(400, f"mode must be detailed or niceonly, got {mode_arg!r}")
@@ -333,9 +467,16 @@ def handle_claim_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
     except (TypeError, ValueError):
         raise ApiError(400, f"count must be an integer, got {payload.get('count')!r}")
     count = max(1, min(count, _max_claim_block()))
-    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
-        search_mode
+    client_token = trust_mod.resolve_token(
+        payload, headers, str(payload.get("username") or ""), user_ip
     )
+    untrusted = not ctx.trust.is_trusted(client_token)
+    if untrusted:
+        count = _enforce_claim_cap(ctx, client_token, count)
+    claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
+        search_mode, untrusted
+    )
+    lease_secs = _claim_lease_secs(untrusted)
 
     def op():
         fields = _claim_fields(
@@ -350,7 +491,8 @@ def handle_claim_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
             )
         block_id = secrets.token_hex(12)
         claims = ctx.db.insert_claims_block(
-            [f.field_id for f in fields], search_mode, user_ip, block_id
+            [f.field_id for f in fields], search_mode, user_ip, block_id,
+            client_token=client_token, lease_secs=lease_secs,
         )
         return block_id, fields, claims
 
@@ -375,6 +517,26 @@ def handle_claim_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
     }
 
 
+@dataclasses.dataclass
+class PreparedSubmission:
+    """Everything _verify_submission learns about one submission, carried to
+    the persist step and the post-accept trust flow (spot check, trust
+    upsert, streaming consensus). persist is None for the exactly-once
+    replay read-hit; otherwise it returns the new submission id."""
+
+    data: DataToServer
+    claim: object = None
+    persist: object = None
+    elapsed_secs: float = 0.0
+    mode_label: str = ""
+    client_token: str = ""
+    trusted: bool = True
+    field: object = None
+    distribution_expanded: object = None
+    numbers_expanded: object = None
+    submit_key: str = ""
+
+
 def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
     SERVER_DUPLICATE_SUBMITS.inc()
     log.info(
@@ -384,11 +546,13 @@ def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
     return {"status": "OK", "duplicate": True}
 
 
-def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
-    """Read-side verification of one submission; returns
-    (data, claim, persist, elapsed_secs, mode_label) where persist is the
-    mutation closure to run through the writer (None = already accepted, the
-    exactly-once replay read-hit). Raises ApiError on rejection.
+def _verify_submission(
+    ctx: ApiContext, payload: dict, user_ip: str, headers=None
+) -> PreparedSubmission:
+    """Read-side verification of one submission; returns a
+    PreparedSubmission whose persist closure is the mutation to run through
+    the writer (None = already accepted, the exactly-once replay read-hit).
+    Raises ApiError on rejection.
 
     Exactly-once: when the payload carries a submit_id (claim + content
     hash) that is already persisted, the reply is {"duplicate": true} and no
@@ -399,7 +563,7 @@ def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
     data = DataToServer.from_json(payload)
     if data.submit_id:
         if ctx.db.get_submission_by_submit_id(data.submit_id) is not None:
-            return data, None, None, 0.0, ""
+            return PreparedSubmission(data=data)
     try:
         claim = ctx.db.get_claim_by_id(data.claim_id)
     except KeyError as e:
@@ -412,21 +576,52 @@ def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
     from nice_tpu.server.db import now_utc
 
     elapsed_secs = max(0.0, (now_utc() - claim.claim_time).total_seconds())
+    # Late-submit conflict: results on an expired lease whose field was
+    # already re-issued to another client are discarded (409) — the second
+    # lease owns the field now, and accepting both would double-count the
+    # range. A late submit with NO conflict is still accepted, preserving
+    # the legacy slow-but-honest path.
+    if (
+        claim.lease_expiry is not None
+        and now_utc() > claim.lease_expiry
+        and ctx.db.has_conflicting_claim(
+            claim.field_id, claim.claim_id, claim.lease_expiry
+        )
+    ):
+        raise ApiError(
+            409,
+            f"claim {claim.claim_id} lease expired and field"
+            f" {claim.field_id} was re-issued; results discarded",
+        )
+    client_token = trust_mod.resolve_token(
+        payload, headers, data.username, user_ip
+    )
+    trusted = ctx.trust.is_trusted(client_token)
+    submit_key = data.submit_id or f"claim-{data.claim_id}"
 
     if claim.search_mode == SearchMode.NICEONLY:
-        # Honor system: no verification (reference api/src/main.rs:278-300).
+        # Honor system at accept time (reference api/src/main.rs:278-300);
+        # the post-accept spot check is the only verification this mode
+        # ever gets.
         def persist():
-            ctx.db.insert_submission(
+            sid = ctx.db.insert_submission(
                 claim, data.username, data.client_version, user_ip, None,
                 numbers_expanded, elapsed_secs=elapsed_secs,
-                submit_id=data.submit_id,
+                submit_id=data.submit_id, client_token=client_token,
             )
             if field.check_level == 0:
                 ctx.db.update_field_canon_and_cl(
                     field.field_id, field.canon_submission_id, 1
                 )
+            return sid
 
-        return data, claim, persist, elapsed_secs, "niceonly"
+        return PreparedSubmission(
+            data=data, claim=claim, persist=persist,
+            elapsed_secs=elapsed_secs, mode_label="niceonly",
+            client_token=client_token, trusted=trusted, field=field,
+            distribution_expanded=None, numbers_expanded=numbers_expanded,
+            submit_key=submit_key,
+        )
 
     if data.unique_distribution is None:
         raise ApiError(
@@ -475,7 +670,7 @@ def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
             )
 
     def persist():
-        ctx.db.insert_submission(
+        sid = ctx.db.insert_submission(
             claim,
             data.username,
             data.client_version,
@@ -484,13 +679,33 @@ def _verify_submission(ctx: ApiContext, payload: dict, user_ip: str):
             numbers_expanded,
             elapsed_secs=elapsed_secs,
             submit_id=data.submit_id,
+            client_token=client_token,
         )
-        if field.check_level < 2:
-            ctx.db.update_field_canon_and_cl(
-                field.field_id, field.canon_submission_id, 2
-            )
+        if trusted:
+            if field.check_level < 2:
+                ctx.db.update_field_canon_and_cl(
+                    field.field_id, field.canon_submission_id, 2
+                )
+        else:
+            # Needs consensus: an untrusted client alone never makes canon.
+            # check_level 1 keeps the field below the detailed bar, and
+            # clearing the lease puts it straight back in the claim pool so
+            # an independent client picks it up; the post-accept streaming
+            # consensus promotes canon once two submissions agree.
+            if field.check_level == 0:
+                ctx.db.update_field_canon_and_cl(
+                    field.field_id, field.canon_submission_id, 1
+                )
+            if field.check_level <= 1:
+                ctx.db.release_field_claims([field.field_id])
+        return sid
 
-    return data, claim, persist, elapsed_secs, "detailed"
+    return PreparedSubmission(
+        data=data, claim=claim, persist=persist, elapsed_secs=elapsed_secs,
+        mode_label="detailed", client_token=client_token, trusted=trusted,
+        field=field, distribution_expanded=distribution_expanded,
+        numbers_expanded=numbers_expanded, submit_key=submit_key,
+    )
 
 
 def _submit_accounting(
@@ -519,23 +734,111 @@ def _submit_accounting(
     )
 
 
-def handle_submit(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
-    """Verify + persist a submission (reference api/src/main.rs:241-404)."""
-    data, claim, persist, elapsed_secs, mode_label = _verify_submission(
-        ctx, payload, user_ip
+def _streaming_consensus(ctx: ApiContext, field_id: int) -> None:
+    """Submit-path consensus for untrusted submissions: re-evaluate the
+    field immediately (reads committed state, one conditional write) so
+    agreement between two independent clients promotes canon without
+    waiting for the jobs runner. A hold — untrusted data still awaiting
+    corroboration — bumps nice_server_consensus_holds_total."""
+    field = ctx.db.get_field_by_id(field_id)
+    subs = ctx.db.get_detailed_submissions_by_field(field_id)
+    untrusted_ids = frozenset(
+        s.submission_id
+        for s in subs
+        if s.client_token is not None
+        and not ctx.trust.is_trusted(s.client_token)
     )
-    if persist is None:
-        return _submit_duplicate_reply(ctx, data)
+    canon, cl = consensus.evaluate_consensus(field, subs, untrusted_ids)
+    canon_id = canon.submission_id if canon is not None else None
+    if canon_id != field.canon_submission_id or cl != field.check_level:
+        ctx.write(
+            ctx.db.update_field_canon_and_cl, field_id, canon_id, cl
+        )
+        ctx.invalidate_status_cache()
+        log.info(
+            "streaming consensus: field=%d canon=%s cl=%d (%d submissions)",
+            field_id, canon_id, cl, len(subs),
+        )
+    else:
+        SERVER_CONSENSUS_HOLDS.inc()
+
+
+def _post_accept_trust(
+    ctx: ApiContext, prep: PreparedSubmission, submission_id: int
+) -> None:
+    """Spot verification + trust accounting for one ACCEPTED submission.
+
+    The check itself is pure compute on the handler thread (a seeded random
+    slice re-run on the trusted scalar engine). Pass/skip costs exactly one
+    DB write — the trust upsert through the writer actor. Fail is off the
+    hot path by definition: slash trust, mark suspect, disqualify the
+    submission, and requeue the field, all in one writer op."""
+    verdict, detail = trust_mod.run_spot_check(
+        ctx.trust, prep.client_token, prep.submit_key, prep.field.base,
+        prep.field.range_start, prep.field.range_end,
+        prep.distribution_expanded, prep.numbers_expanded,
+    )
+    if verdict == "fail":
+        SERVER_TRUST_SLASHES.inc()
+
+        def slash_op():
+            row = ctx.db.upsert_client_trust(
+                prep.client_token, accepted_delta=1, failed_delta=1,
+                slash=True, suspect=True,
+            )
+            ctx.db.disqualify_submission(submission_id)
+            ctx.db.requeue_disqualified_fields(
+                submission_ids=[submission_id]
+            )
+            return row
+
+        row = ctx.write(slash_op)
+        ctx.trust.update(row)
+        ctx.invalidate_status_cache()
+        obs.flight.record(
+            "spot_check_fail", client=prep.client_token,
+            submission=submission_id, field=prep.field.field_id,
+            detail=detail[:200],
+        )
+        log.warning(
+            "submission %d disqualified by spot check (client %s): %s",
+            submission_id, prep.client_token, detail,
+        )
+        return
+    row = ctx.write(
+        ctx.db.upsert_client_trust, prep.client_token,
+        trust_delta=1.0 if verdict == "pass" else 0.0,
+        accepted_delta=1,
+        passed_delta=1 if verdict == "pass" else 0,
+    )
+    ctx.trust.update(row)
+    if not prep.trusted and prep.mode_label == "detailed":
+        _streaming_consensus(ctx, prep.field.field_id)
+
+
+def handle_submit(
+    ctx: ApiContext, payload: dict, user_ip: str, headers=None
+) -> dict:
+    """Verify + persist a submission (reference api/src/main.rs:241-404)."""
+    prep = _verify_submission(ctx, payload, user_ip, headers)
+    if prep.persist is None:
+        return _submit_duplicate_reply(ctx, prep.data)
     try:
-        ctx.write(persist)
+        submission_id = ctx.write(prep.persist)
     except sqlite3.IntegrityError:
-        return _submit_duplicate_reply(ctx, data)
+        return _submit_duplicate_reply(ctx, prep.data)
     ctx.invalidate_status_cache()
-    _submit_accounting(ctx, data, claim, mode_label, elapsed_secs, user_ip)
+    _submit_accounting(
+        ctx, prep.data, prep.claim, prep.mode_label, prep.elapsed_secs,
+        user_ip,
+    )
+    _post_accept_trust(ctx, prep, submission_id)
     return {"status": "OK"}
 
 
-def handle_submit_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
+def handle_submit_block(
+    ctx: ApiContext, payload: dict, user_ip: str, headers=None
+) -> dict:
     """POST /submit_block: batched results for a block claim.
 
     Verification runs per item on the handler thread; all surviving persists
@@ -556,7 +859,7 @@ def handle_submit_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
             prepared.append(ApiError(400, "each submission must be an object"))
             continue
         try:
-            prepared.append(_verify_submission(ctx, item, user_ip))
+            prepared.append(_verify_submission(ctx, item, user_ip, headers))
         except ApiError as e:
             prepared.append(e)
 
@@ -564,42 +867,42 @@ def handle_submit_block(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
         outcomes = []
         for prep in prepared:
             if isinstance(prep, ApiError):
-                outcomes.append("rejected")
+                outcomes.append(("rejected", None))
                 continue
-            persist = prep[2]
-            if persist is None:
-                outcomes.append("duplicate")
+            if prep.persist is None:
+                outcomes.append(("duplicate", None))
                 continue
             try:
                 # Per-item savepoint: a duplicate replay (IntegrityError)
                 # rolls back this item only.
                 with ctx.db._lock, ctx.db._txn():
-                    persist()
-                outcomes.append("accepted")
+                    sid = prep.persist()
+                outcomes.append(("accepted", sid))
             except sqlite3.IntegrityError:
-                outcomes.append("duplicate")
+                outcomes.append(("duplicate", None))
         return outcomes
 
     outcomes = ctx.write(batch_op)
     ctx.invalidate_status_cache()
     results = []
     counts = {"accepted": 0, "duplicates": 0, "rejected": 0}
-    for prep, outcome in zip(prepared, outcomes):
+    for prep, (outcome, sid) in zip(prepared, outcomes):
         if isinstance(prep, ApiError):
             counts["rejected"] += 1
             results.append(
                 {"status": "error", "code": prep.status, "message": prep.message}
             )
             continue
-        data, claim, _persist, elapsed_secs, mode_label = prep
         if outcome == "duplicate":
             counts["duplicates"] += 1
-            results.append(_submit_duplicate_reply(ctx, data))
+            results.append(_submit_duplicate_reply(ctx, prep.data))
         else:
             counts["accepted"] += 1
             _submit_accounting(
-                ctx, data, claim, mode_label, elapsed_secs, user_ip
+                ctx, prep.data, prep.claim, prep.mode_label,
+                prep.elapsed_secs, user_ip,
             )
+            _post_accept_trust(ctx, prep, sid)
             results.append({"status": "OK"})
     if isinstance(payload.get("telemetry"), dict):
         # Block-level piggyback: one snapshot per block, not per field.
@@ -729,6 +1032,24 @@ def build_fleet_block(ctx: ApiContext) -> dict:
         requests[endpoint] = requests.get(endpoint, 0) + int(count)
         if status.startswith(("4", "5")):
             errors += int(count)
+
+    threshold = trust_mod.trust_threshold()
+    tiers = ctx.db.get_trust_summary(threshold)
+    for tier, n in tiers.items():
+        SERVER_TRUST_CLIENTS.labels(tier).set(n)
+    spot_checks = {
+        verdict: int(count)
+        for (verdict,), count in SERVER_SPOT_CHECKS.values().items()
+    }
+    trust_block = {
+        "threshold": threshold,
+        "tiers": tiers,
+        "spot_checks": spot_checks,
+        "trust_slashes": int(SERVER_TRUST_SLASHES.value()),
+        "consensus_holds": int(SERVER_CONSENSUS_HOLDS.value()),
+        "rate_limited": int(SERVER_RATE_LIMITED.value()),
+        "leases_expired": int(SERVER_LEASES_EXPIRED.value()),
+    }
     return {
         "active_secs": fleet_active_secs(),
         "clients": clients,
@@ -750,6 +1071,7 @@ def build_fleet_block(ctx: ApiContext) -> dict:
         "field_seconds_p95": p95,
         "requests": requests,
         "error_responses": errors,
+        "trust": trust_block,
         **claim_stats,
     }
 
@@ -774,14 +1096,32 @@ def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
             raise ApiError(
                 400, f"Invalid submission_id {payload['submission_id']!r}"
             )
-        changed = ctx.write(ctx.db.disqualify_submission, submission_id)
+
+        def op():
+            changed = ctx.db.disqualify_submission(submission_id)
+            requeued = ctx.db.requeue_disqualified_fields(
+                submission_ids=[submission_id]
+            )
+            return changed, requeued
+
     elif "username" in payload:
-        changed = ctx.write(ctx.db.disqualify_user, str(payload["username"]))
+        username = str(payload["username"])
+
+        def op():
+            changed = ctx.db.disqualify_user(username)
+            requeued = ctx.db.requeue_disqualified_fields(username=username)
+            return changed, requeued
+
     else:
         raise ApiError(400, "body must contain submission_id or username")
+    # Requeue rides in the same writer op as the disqualification: fields
+    # whose canon was just disqualified drop back to the claim pool instead
+    # of staying stranded at a check_level their live submissions no longer
+    # support.
+    changed, requeued = ctx.write(op)
     ctx.write(ctx.db.refresh_search_caches)
     ctx.invalidate_status_cache()
-    return {"status": "OK", "disqualified": changed}
+    return {"status": "OK", "disqualified": changed, "requeued": requeued}
 
 
 NOT_FOUND_MESSAGE = (
@@ -795,7 +1135,7 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root"}
+     "root", "token"}
 )
 
 _CORS_HEADERS = {
@@ -832,6 +1172,33 @@ def overload_response(ctx: ApiContext, endpoint: str) -> Response:
         f"server overloaded (> {ctx.max_inflight} requests in flight);"
         " retry later",
         extra_headers={"Retry-After": str(ctx.retry_after_secs)},
+    )
+
+
+def rate_limit_check(ctx: ApiContext, request: Request):
+    """Per-client token-bucket admission, consulted on EVERY request (loop
+    thread on the async core, handler thread on the legacy core): None =
+    pass, else the 429 + Retry-After response. Distinct from the global 503
+    shed — a single flooder exhausts only its own buckets. /metrics and CORS
+    preflights are exempt, mirroring the shed. No-op unless the operator
+    enabled limiting with NICE_TPU_RATE_BUCKET."""
+    if ctx.limiter is None:
+        return None
+    path = urlparse(request.target).path.rstrip("/")
+    if path == "/metrics" or request.method == "OPTIONS":
+        return None
+    token = (
+        request.headers.get("X-Client-Token") or request.client_ip or "anon"
+    )
+    allowed, retry_after = ctx.limiter.allow(token, path)
+    if allowed:
+        return None
+    SERVER_RATE_LIMITED.inc()
+    ctx.metrics.record(path or "/", 429, 0.0)
+    return _error_response(
+        429,
+        "rate limit exceeded for this client; slow down",
+        extra_headers={"Retry-After": str(max(1, int(retry_after + 0.999)))},
     )
 
 
@@ -941,13 +1308,17 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
         user_ip = request.client_ip
         if method == "OPTIONS":
             return Response(204, headers=dict(_CORS_HEADERS))
-        if method == "GET" and path == "/claim/detailed":
-            return _json_response(
-                200, claim_helper(ctx, SearchMode.DETAILED, user_ip).to_json()
+        if method == "GET" and path in ("/claim/detailed", "/claim/niceonly"):
+            mode = (
+                SearchMode.DETAILED
+                if path == "/claim/detailed"
+                else SearchMode.NICEONLY
             )
-        if method == "GET" and path == "/claim/niceonly":
+            client_token = trust_mod.resolve_token(
+                {}, request.headers, "", user_ip
+            )
             return _json_response(
-                200, claim_helper(ctx, SearchMode.NICEONLY, user_ip).to_json()
+                200, claim_helper(ctx, mode, user_ip, client_token).to_json()
             )
         if method == "GET" and path == "/claim/validate":
             qs = parse_qs(parsed.query)
@@ -1026,17 +1397,32 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                 raise ApiError(400, f"query rejected: {e}")
         if method == "POST" and path == "/submit":
             return _json_response(
-                200, handle_submit(ctx, _parse_json_body(request), user_ip)
+                200,
+                handle_submit(
+                    ctx, _parse_json_body(request), user_ip, request.headers
+                ),
             )
         if method == "POST" and path == "/claim_block":
             return _json_response(
                 200,
-                handle_claim_block(ctx, _parse_json_body(request), user_ip),
+                handle_claim_block(
+                    ctx, _parse_json_body(request), user_ip, request.headers
+                ),
             )
         if method == "POST" and path == "/submit_block":
             return _json_response(
                 200,
-                handle_submit_block(ctx, _parse_json_body(request), user_ip),
+                handle_submit_block(
+                    ctx, _parse_json_body(request), user_ip, request.headers
+                ),
+            )
+        if method == "POST" and path == "/token":
+            # Anonymous trust identity for browser/WASM clients with no
+            # telemetry client_id: the token is a bearer credential the
+            # client sends back as X-Client-Token; its trust row is created
+            # lazily on the first accepted submission.
+            return _json_response(
+                200, {"client_token": "anon-" + secrets.token_hex(16)}
             )
         if method == "POST" and path == "/telemetry":
             return _json_response(
@@ -1061,7 +1447,9 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
         return _error_response(404, NOT_FOUND_MESSAGE)
     except ApiError as e:
         status = e.status
-        return _error_response(e.status, e.message)
+        return _error_response(
+            e.status, e.message, extra_headers=e.headers or None
+        )
     except Exception as e:  # 500 with JSON body, never a stack dump
         status = 500
         log.exception("internal error handling %s %s", method, path)
@@ -1097,10 +1485,14 @@ def make_handler(ctx: ApiContext):
             path = urlparse(self.path).path.rstrip("/")
             within_cap = ctx.enter_request()
             try:
-                # Overload shed: past the in-flight cap, answer 503 with a
+                # Per-client rate limit first, then the global overload
+                # shed: past the in-flight cap, answer 503 with a
                 # Retry-After hint instead of queueing unboundedly. /metrics
                 # stays exempt — overload is exactly when scrapes matter.
-                if (
+                limited = rate_limit_check(ctx, request)
+                if limited is not None:
+                    resp = limited
+                elif (
                     not within_cap
                     and path != "/metrics"
                     and method != "OPTIONS"
@@ -1161,6 +1553,7 @@ def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True):
             router=lambda req: route_request(ctx, req),
             max_inflight=ctx.max_inflight,
             shed=_shed,
+            limiter=lambda req: rate_limit_check(ctx, req),
         )
     server.context = ctx  # reachable for tests / debugging
     log.info(

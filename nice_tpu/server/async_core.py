@@ -24,6 +24,8 @@ import logging
 import os
 import socket
 import threading
+import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.client import responses as _REASONS
@@ -33,6 +35,85 @@ log = logging.getLogger(__name__)
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 256 * 1024 * 1024
+
+
+class TokenBucketLimiter:
+    """Dependency-free per-client token buckets, keyed by (client token,
+    endpoint class).
+
+    This is the per-CLIENT admission control (429 + Retry-After) as opposed
+    to the global overload shed (503): a single flooding client drains only
+    its own buckets while honest clients keep their latency. allow() is a
+    couple of dict operations under an uncontended lock, cheap enough for the
+    event-loop thread, and the bucket table is LRU-bounded so an attacker
+    minting fresh tokens cannot grow it without bound.
+
+    NICE_TPU_RATE_BUCKET="capacity:refill_per_sec" sizes the claim/submit
+    buckets (reads get 4x). Limiting is opt-in: the server only constructs
+    a limiter when that env var is set, because the fallback bucket key is
+    the client IP and an always-on limiter would throttle NAT'd fleets.
+    multiplier, when provided, maps a token to a bucket-size factor (trusted
+    clients earn bigger buckets); it MUST be loop-thread safe — an in-memory
+    lookup, never a database read."""
+
+    def __init__(
+        self,
+        capacity: Optional[float] = None,
+        refill_per_sec: Optional[float] = None,
+        max_keys: int = 10_000,
+        multiplier: Optional[Callable[[str], float]] = None,
+    ):
+        spec = os.environ.get("NICE_TPU_RATE_BUCKET", "300:100")
+        cap_s, _, refill_s = spec.partition(":")
+        self.capacity = float(capacity if capacity is not None else cap_s or 300)
+        self.refill = float(
+            refill_per_sec if refill_per_sec is not None else refill_s or 100
+        )
+        self.max_keys = max_keys
+        self.multiplier = multiplier
+        self._buckets: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def classify(path: str) -> str:
+        """Per-endpoint budgets by class: claim-side, submit-side, reads."""
+        seg = path.lstrip("/").split("/", 1)[0]
+        if seg in ("claim", "claim_block", "renew_claim", "token"):
+            return "claim"
+        if seg in ("submit", "submit_block", "telemetry"):
+            return "submit"
+        return "read"
+
+    def allow(
+        self, token: str, path: str, cost: float = 1.0,
+        now: Optional[float] = None,
+    ) -> tuple[bool, float]:
+        """(allowed, retry_after_secs). retry_after is 0 when allowed."""
+        if now is None:
+            now = time.monotonic()
+        mult = 1.0
+        if self.multiplier is not None:
+            try:
+                mult = max(1.0, float(self.multiplier(token)))
+            except Exception:
+                mult = 1.0
+        klass = self.classify(path)
+        cap = self.capacity * mult * (4.0 if klass == "read" else 1.0)
+        refill = self.refill * mult * (4.0 if klass == "read" else 1.0)
+        key = (token, klass)
+        with self._lock:
+            bucket = self._buckets.pop(key, None)
+            if bucket is None:
+                tokens = cap
+                if len(self._buckets) >= self.max_keys:
+                    self._buckets.popitem(last=False)
+            else:
+                tokens = min(cap, bucket[0] + (now - bucket[1]) * refill)
+            if tokens >= cost:
+                self._buckets[key] = [tokens - cost, now]
+                return True, 0.0
+            self._buckets[key] = [tokens, now]
+            return False, (cost - tokens) / refill if refill > 0 else 1.0
 
 
 class Headers:
@@ -79,7 +160,10 @@ class AsyncHTTPServer:
     requests are dispatched-but-unfinished; returning a Response answers
     immediately without touching the pool (the overload path must not queue
     behind the very backlog it exists to shed), returning None lets the
-    request through regardless (exempt endpoints like /metrics)."""
+    request through regardless (exempt endpoints like /metrics). limiter has
+    the same shape but is consulted on EVERY request (per-client rate
+    limiting must fire before a flooder ever reaches the pool); like shed it
+    runs on the loop thread and must never block."""
 
     def __init__(
         self,
@@ -89,9 +173,11 @@ class AsyncHTTPServer:
         max_workers: Optional[int] = None,
         max_inflight: Optional[int] = None,
         shed: Optional[Callable[[Request], Optional[Response]]] = None,
+        limiter: Optional[Callable[[Request], Optional[Response]]] = None,
     ):
         self.router = router
         self.shed = shed
+        self.limiter = limiter
         self.max_inflight = max_inflight or 0
         self._sock = socket.create_server(
             (host, port), backlog=1024, reuse_port=False
@@ -199,7 +285,9 @@ class AsyncHTTPServer:
                         return
                 request = Request(method, target, headers, body, client_ip)
                 response = None
-                if (
+                if self.limiter is not None:
+                    response = self.limiter(request)
+                if response is None and (
                     self.shed is not None
                     and self.max_inflight
                     and self._inflight >= self.max_inflight

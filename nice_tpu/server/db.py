@@ -32,6 +32,7 @@ from nice_tpu.obs.series import (
     SERVER_CLAIM_EXPIRY,
     SERVER_CLAIM_RENEWALS,
     SERVER_FIELDS_RELEASED,
+    SERVER_LEASES_EXPIRED,
     SERVER_SQLITE_BUSY_RETRIES,
 )
 from nice_tpu.core.types import (
@@ -245,6 +246,32 @@ class Db:
                     "CREATE INDEX IF NOT EXISTS idx_claims_block_id"
                     " ON claims(block_id) WHERE block_id IS NOT NULL"
                 )
+                # Untrusted-client hardening: claims carry the client's trust
+                # token plus an explicit lease window (NULL on rows minted by
+                # pre-trust servers — those stay outside the lease sweep and
+                # keep the legacy claim_expiry_cutoff behavior); submissions
+                # carry the token so consensus can weigh trust.
+                for col, decl in (
+                    ("client_token", "TEXT"),
+                    ("lease_expiry", "TEXT"),
+                    ("lease_secs", "REAL"),
+                ):
+                    if col not in claim_cols:
+                        self._conn.execute(
+                            f"ALTER TABLE claims ADD COLUMN {col} {decl}"
+                        )
+                if "client_token" not in cols:
+                    self._conn.execute(
+                        "ALTER TABLE submissions ADD COLUMN client_token TEXT"
+                    )
+                self._conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_claims_lease_expiry"
+                    " ON claims(lease_expiry) WHERE lease_expiry IS NOT NULL"
+                )
+                self._conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_claims_client_token"
+                    " ON claims(client_token) WHERE client_token IS NOT NULL"
+                )
 
     def close(self) -> None:
         with self._lock, self._pool_lock:
@@ -440,17 +467,23 @@ class Db:
             return "check_level = 0", []
         return "check_level <= ?", [maximum_check_level]
 
+    # The possibly-active fallback's ordering: hand out the least-checked,
+    # longest-abandoned field first, so a dead client's stale cl-0 lease is
+    # re-issued before a completed field gets a redundant re-check.
+    PREFER_ABANDONED = "check_level ASC, COALESCE(last_claim_time, '') ASC, id ASC"
+
     def _claim_rows(
         self,
         where: str,
         params: list,
         count: int,
         claim_time: datetime,
+        order_by: str = "id ASC",
     ) -> list[FieldRecord]:
         """Single-transaction SELECT..LIMIT + UPDATE last_claim_time."""
         with self._lock, self._txn():
             rows = self._conn.execute(
-                f"SELECT * FROM fields WHERE {where} ORDER BY id ASC LIMIT ?",
+                f"SELECT * FROM fields WHERE {where} ORDER BY {order_by} LIMIT ?",
                 (*params, count),
             ).fetchall()
             if rows:
@@ -480,6 +513,7 @@ class Db:
         maximum_check_level: int,
         maximum_size: int,
         count: int,
+        order_by: str = "id ASC",
     ) -> list[FieldRecord]:
         now = now_utc()
         cl_sql, cl_params = self._cl_predicate(maximum_check_level)
@@ -489,7 +523,9 @@ class Db:
         base_params = [ts(maximum_timestamp), *cl_params, pad(maximum_size)]
 
         if claim_strategy == FieldClaimStrategy.NEXT:
-            return self._claim_rows(base_where, base_params, count, now)
+            return self._claim_rows(
+                base_where, base_params, count, now, order_by=order_by
+            )
 
         if claim_strategy == FieldClaimStrategy.RANDOM:
             max_id = self._max_field_id()
@@ -640,6 +676,106 @@ class Db:
         SERVER_FIELDS_RELEASED.inc(released)
         return released
 
+    def release_expired_leases(self) -> int:
+        """Background sweep (writer-actor periodic): clear the field lease
+        behind every claim whose explicit lease_expiry has passed without a
+        submission, so abandoned micro-field claims re-enter the claim pool
+        in seconds instead of waiting out the global expiry cutoff. A field
+        is left alone while ANY unexpired unsubmitted claim still covers it
+        (a re-issued field's second lease must not be swept by the first
+        client's corpse). Returns fields released; legacy NULL-expiry claims
+        are never touched."""
+        now = ts(now_utc())
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                """
+                UPDATE fields SET last_claim_time = NULL
+                WHERE last_claim_time IS NOT NULL AND id IN (
+                  SELECT c.field_id FROM claims c
+                  WHERE c.lease_expiry IS NOT NULL AND c.lease_expiry < :now
+                    AND NOT EXISTS (SELECT 1 FROM submissions s
+                                    WHERE s.claim_id = c.id)
+                    AND NOT EXISTS (
+                      SELECT 1 FROM claims c2
+                      WHERE c2.field_id = c.field_id
+                        AND c2.lease_expiry >= :now
+                        AND NOT EXISTS (SELECT 1 FROM submissions s2
+                                        WHERE s2.claim_id = c2.id)))
+                """,
+                {"now": now},
+            )
+            released = cur.rowcount
+        if released:
+            SERVER_LEASES_EXPIRED.inc(released)
+        return released
+
+    def release_orphaned_inventory(self) -> int:
+        """Startup sweep: release lease stamps left by a DEAD server's
+        in-memory queue inventory. The refiller bulk-claims fields (stamping
+        fields.last_claim_time) without minting claims rows — claims are
+        minted at pop time — so a SIGKILL strands up to a full refill batch
+        of stamped-but-never-issued fields until the global expiry cutoff
+        (FieldQueue.close() handles graceful shutdown; this is the crash
+        counterpart). A field actually issued to a client always has a
+        claims row minted in the same writer operation as its stamp, so the
+        orphan test is: no claims row within 2s at-or-after the stamp (the
+        stamp and claim-row clocks are read milliseconds apart, in either
+        order). A renewed claim re-stamps the field while claim_time stays
+        at the original claim, so a live unsubmitted lease also keeps its
+        field. Must run before this process's own FieldQueue starts
+        refilling."""
+        now = ts(now_utc())
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                """
+                UPDATE fields SET last_claim_time = NULL
+                WHERE last_claim_time IS NOT NULL
+                  AND NOT EXISTS (
+                    SELECT 1 FROM claims c
+                    WHERE c.field_id = fields.id
+                      AND (julianday(c.claim_time)
+                             >= julianday(fields.last_claim_time) - 2.0 / 86400.0
+                           OR (c.lease_expiry IS NOT NULL
+                               AND c.lease_expiry >= :now
+                               AND NOT EXISTS (SELECT 1 FROM submissions s
+                                               WHERE s.claim_id = c.id))))
+                """,
+                {"now": now},
+            )
+            released = cur.rowcount
+        if released:
+            SERVER_FIELDS_RELEASED.inc(released)
+        return released
+
+    def count_open_claims(self, client_token: str) -> int:
+        """Outstanding unexpired, unsubmitted claims held by one client
+        (the per-client outstanding-claims cap for untrusted profiles)."""
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT COUNT(*) AS n FROM claims c"
+                " WHERE c.client_token = ? AND c.lease_expiry >= ?"
+                " AND NOT EXISTS (SELECT 1 FROM submissions s"
+                "                 WHERE s.claim_id = c.id)",
+                (client_token, ts(now_utc())),
+            ).fetchone()
+        return int(row["n"])
+
+    def has_conflicting_claim(
+        self, field_id: int, claim_id: int, since: datetime
+    ) -> bool:
+        """True when the field was re-issued (a different claim minted) at or
+        after `since` — the conflict test behind the late-submit rejection:
+        results arriving on a lease that expired AND whose field went to
+        another client are discarded; a late submit with no conflict is still
+        accepted (legacy behavior for slow-but-honest clients)."""
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT 1 FROM claims WHERE field_id = ? AND id != ?"
+                " AND claim_time >= ? LIMIT 1",
+                (field_id, claim_id, ts(since)),
+            ).fetchone()
+        return row is not None
+
     # -- claims ------------------------------------------------------------
 
     def renew_claim(self, claim_id: int) -> datetime:
@@ -647,7 +783,9 @@ class Db:
         heartbeat): bumps fields.last_claim_time to now so a long-running
         scan is not re-claimed out from under the client. claims.claim_time
         is untouched — submission elapsed accounting still measures from the
-        original claim. Raises KeyError on an unknown claim."""
+        original claim. Claims minted with an explicit lease window also get
+        lease_expiry pushed out by the same window the claim was issued
+        with. Raises KeyError on an unknown claim."""
         when = now_utc()
         claim = self.get_claim_by_id(claim_id)
         with self._lock, self._txn():
@@ -655,19 +793,36 @@ class Db:
                 "UPDATE fields SET last_claim_time = ? WHERE id = ?",
                 (ts(when), claim.field_id),
             )
+            if claim.lease_secs:
+                self._conn.execute(
+                    "UPDATE claims SET lease_expiry = ? WHERE id = ?",
+                    (ts(when + timedelta(seconds=claim.lease_secs)), claim_id),
+                )
         SERVER_CLAIM_RENEWALS.inc()
         return when
 
     def insert_claim(
-        self, field_id: int, search_mode: SearchMode, user_ip: str
+        self,
+        field_id: int,
+        search_mode: SearchMode,
+        user_ip: str,
+        client_token: Optional[str] = None,
+        lease_secs: Optional[float] = None,
     ) -> ClaimRecord:
         when = now_utc()
         mode = "detailed" if search_mode == SearchMode.DETAILED else "niceonly"
+        expiry = (
+            when + timedelta(seconds=lease_secs) if lease_secs else None
+        )
         with self._lock, self._txn():
             cur = self._conn.execute(
-                "INSERT INTO claims (field_id, search_mode, claim_time, user_ip)"
-                " VALUES (?, ?, ?, ?)",
-                (field_id, mode, ts(when), user_ip),
+                "INSERT INTO claims (field_id, search_mode, claim_time,"
+                " user_ip, client_token, lease_expiry, lease_secs)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (
+                    field_id, mode, ts(when), user_ip, client_token,
+                    None if expiry is None else ts(expiry), lease_secs,
+                ),
             )
             claim_id = cur.lastrowid
         return ClaimRecord(
@@ -676,6 +831,9 @@ class Db:
             search_mode=search_mode,
             claim_time=when,
             user_ip=user_ip,
+            client_token=client_token,
+            lease_expiry=expiry,
+            lease_secs=lease_secs,
         )
 
     # -- block claim leases (one lease covering N fields; /claim_block) -----
@@ -686,6 +844,8 @@ class Db:
         search_mode: SearchMode,
         user_ip: str,
         block_id: str,
+        client_token: Optional[str] = None,
+        lease_secs: Optional[float] = None,
     ) -> list[ClaimRecord]:
         """Mint one claim row per field, all stamped with block_id, in one
         transaction. The per-field last_claim_time was already stamped by the
@@ -694,13 +854,20 @@ class Db:
         the ordinary expiry predicate — expires together."""
         when = now_utc()
         mode = "detailed" if search_mode == SearchMode.DETAILED else "niceonly"
+        expiry = (
+            when + timedelta(seconds=lease_secs) if lease_secs else None
+        )
         out = []
         with self._lock, self._txn():
             for fid in field_ids:
                 cur = self._conn.execute(
                     "INSERT INTO claims (field_id, search_mode, claim_time,"
-                    " user_ip, block_id) VALUES (?, ?, ?, ?, ?)",
-                    (fid, mode, ts(when), user_ip, block_id),
+                    " user_ip, block_id, client_token, lease_expiry,"
+                    " lease_secs) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                    (
+                        fid, mode, ts(when), user_ip, block_id, client_token,
+                        None if expiry is None else ts(expiry), lease_secs,
+                    ),
                 )
                 out.append(
                     ClaimRecord(
@@ -709,9 +876,29 @@ class Db:
                         search_mode=search_mode,
                         claim_time=when,
                         user_ip=user_ip,
+                        client_token=client_token,
+                        lease_expiry=expiry,
+                        lease_secs=lease_secs,
                     )
                 )
         return out
+
+    def _row_to_claim(self, row: sqlite3.Row) -> ClaimRecord:
+        keys = row.keys()
+        return ClaimRecord(
+            claim_id=row["id"],
+            field_id=row["field_id"],
+            search_mode=SearchMode.DETAILED
+            if row["search_mode"] == "detailed"
+            else SearchMode.NICEONLY,
+            claim_time=parse_ts(row["claim_time"]),
+            user_ip=row["user_ip"],
+            client_token=row["client_token"] if "client_token" in keys else None,
+            lease_expiry=parse_ts(row["lease_expiry"])
+            if "lease_expiry" in keys
+            else None,
+            lease_secs=row["lease_secs"] if "lease_secs" in keys else None,
+        )
 
     def get_block_claims(self, block_id: str) -> list[ClaimRecord]:
         with self._read_conn() as conn:
@@ -719,18 +906,7 @@ class Db:
                 "SELECT * FROM claims WHERE block_id = ? ORDER BY id ASC",
                 (block_id,),
             ).fetchall()
-        return [
-            ClaimRecord(
-                claim_id=r["id"],
-                field_id=r["field_id"],
-                search_mode=SearchMode.DETAILED
-                if r["search_mode"] == "detailed"
-                else SearchMode.NICEONLY,
-                claim_time=parse_ts(r["claim_time"]),
-                user_ip=r["user_ip"],
-            )
-            for r in rows
-        ]
+        return [self._row_to_claim(r) for r in rows]
 
     def renew_block(self, block_id: str) -> tuple[datetime, int]:
         """Re-arm the lease on EVERY field behind a block claim (one client
@@ -743,6 +919,15 @@ class Db:
                 (ts(when), block_id),
             )
             count = cur.rowcount
+            for r in self._conn.execute(
+                "SELECT id, lease_secs FROM claims WHERE block_id = ?"
+                " AND lease_secs IS NOT NULL",
+                (block_id,),
+            ).fetchall():
+                self._conn.execute(
+                    "UPDATE claims SET lease_expiry = ? WHERE id = ?",
+                    (ts(when + timedelta(seconds=r["lease_secs"])), r["id"]),
+                )
         if count:
             SERVER_CLAIM_RENEWALS.inc(count)
         return when, count
@@ -754,15 +939,7 @@ class Db:
             ).fetchone()
         if row is None:
             raise KeyError(f"no claim {claim_id}")
-        return ClaimRecord(
-            claim_id=row["id"],
-            field_id=row["field_id"],
-            search_mode=SearchMode.DETAILED
-            if row["search_mode"] == "detailed"
-            else SearchMode.NICEONLY,
-            claim_time=parse_ts(row["claim_time"]),
-            user_ip=row["user_ip"],
-        )
+        return self._row_to_claim(row)
 
     # -- submissions -------------------------------------------------------
 
@@ -776,6 +953,7 @@ class Db:
         numbers: list[NiceNumber],
         elapsed_secs: float = 0.0,
         submit_id: Optional[str] = None,
+        client_token: Optional[str] = None,
     ) -> int:
         """Insert one submission row. A duplicate submit_id raises
         sqlite3.IntegrityError (the partial unique index) — callers treat
@@ -786,8 +964,8 @@ class Db:
             cur = self._conn.execute(
                 "INSERT INTO submissions (claim_id, field_id, search_mode,"
                 " submit_time, elapsed_secs, username, user_ip, client_version,"
-                " disqualified, distribution, numbers, submit_id)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 0, ?, ?, ?)",
+                " disqualified, distribution, numbers, submit_id, client_token)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, 0, ?, ?, ?, ?)",
                 (
                     claim.claim_id,
                     claim.field_id,
@@ -800,6 +978,7 @@ class Db:
                     _dist_to_json(distribution),
                     _numbers_to_json(numbers),
                     submit_id,
+                    client_token if client_token is not None else claim.client_token,
                 ),
             )
             return cur.lastrowid
@@ -831,6 +1010,9 @@ class Db:
             disqualified=bool(row["disqualified"]),
             distribution=_dist_from_json(row["distribution"]),
             numbers=_numbers_from_json(row["numbers"]),
+            client_token=row["client_token"]
+            if "client_token" in row.keys()
+            else None,
         )
 
     def get_submission_by_id(self, submission_id: int) -> SubmissionRecord:
@@ -980,7 +1162,10 @@ class Db:
         Raises sqlite3 errors for invalid/unauthorized SQL (mapped to 400 by
         the API layer).
         """
-        deny_cols = {"user_ip"}
+        # client_token is a bearer credential (trust identity): like user_ip
+        # it reads as NULL for third parties. client_trust itself stays out
+        # of PUBLIC_QUERY_TABLES entirely.
+        deny_cols = {"user_ip", "client_token"}
 
         def authorize(action, arg1, arg2, dbname, trigger):
             if action == sqlite3.SQLITE_SELECT:
@@ -1363,3 +1548,144 @@ class Db:
                 (username,),
             )
             return cur.rowcount
+
+    def requeue_disqualified_fields(
+        self,
+        submission_ids: Optional[list[int]] = None,
+        username: Optional[str] = None,
+    ) -> int:
+        """Reset fields stranded by disqualification so the claim strategies
+        pick them back up: for every field touched by the named disqualified
+        submissions (or all of a user's), if its canon submission is gone or
+        disqualified, clear canon, drop check_level to 1 when a live detailed
+        submission remains (else 0), and release the lease. Returns fields
+        requeued."""
+        if submission_ids is None and username is None:
+            return 0
+        with self._lock, self._txn():
+            if username is not None:
+                rows = self._conn.execute(
+                    "SELECT DISTINCT field_id FROM submissions"
+                    " WHERE username = ? AND disqualified = 1",
+                    (username,),
+                ).fetchall()
+            else:
+                if not submission_ids:
+                    return 0
+                marks = ",".join("?" * len(submission_ids))
+                rows = self._conn.execute(
+                    f"SELECT DISTINCT field_id FROM submissions"
+                    f" WHERE id IN ({marks}) AND disqualified = 1",
+                    submission_ids,
+                ).fetchall()
+            requeued = 0
+            for r in rows:
+                fid = r["field_id"]
+                field = self._conn.execute(
+                    "SELECT canon_submission_id, check_level FROM fields"
+                    " WHERE id = ?",
+                    (fid,),
+                ).fetchone()
+                if field is None:
+                    continue
+                canon = field["canon_submission_id"]
+                if canon is not None:
+                    live = self._conn.execute(
+                        "SELECT 1 FROM submissions WHERE id = ?"
+                        " AND disqualified = 0",
+                        (canon,),
+                    ).fetchone()
+                    if live is not None:
+                        continue  # canon survives; nothing to requeue
+                remaining = self._conn.execute(
+                    "SELECT 1 FROM submissions WHERE field_id = ?"
+                    " AND search_mode = 'detailed' AND disqualified = 0"
+                    " LIMIT 1",
+                    (fid,),
+                ).fetchone()
+                new_cl = 1 if remaining is not None else 0
+                self._conn.execute(
+                    "UPDATE fields SET canon_submission_id = NULL,"
+                    " check_level = ?, last_claim_time = NULL WHERE id = ?",
+                    (new_cl, fid),
+                )
+                requeued += 1
+            return requeued
+
+    # -- client trust ledger (server/trust.py reads through a cache;
+    # mutations run through the writer actor) ------------------------------
+
+    def get_client_trust(self, client_token: str) -> Optional[dict]:
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT * FROM client_trust WHERE client_token = ?",
+                (client_token,),
+            ).fetchone()
+        return None if row is None else dict(row)
+
+    def upsert_client_trust(
+        self,
+        client_token: str,
+        trust_delta: float = 0.0,
+        accepted_delta: int = 0,
+        passed_delta: int = 0,
+        failed_delta: int = 0,
+        slash: bool = False,
+        suspect: Optional[bool] = None,
+    ) -> dict:
+        """The ONE trust write on the hot accept path: accumulate counters
+        and the trust delta in a single upsert (first_seen preserved, the
+        upsert_client_telemetry idiom). slash=True zeroes the score instead
+        of adding the delta. Returns the updated row."""
+        when = ts(now_utc())
+        with self._lock, self._txn():
+            self._conn.execute(
+                "INSERT INTO client_trust (client_token, trust,"
+                " submissions_accepted, spot_checks_passed,"
+                " spot_checks_failed, suspect, first_seen, last_seen)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+                " ON CONFLICT(client_token) DO UPDATE SET"
+                " trust = CASE WHEN ? THEN 0 ELSE trust + ? END,"
+                " submissions_accepted = submissions_accepted + ?,"
+                " spot_checks_passed = spot_checks_passed + ?,"
+                " spot_checks_failed = spot_checks_failed + ?,"
+                " suspect = COALESCE(?, suspect),"
+                " last_seen = excluded.last_seen",
+                (
+                    client_token,
+                    0.0 if slash else trust_delta,
+                    accepted_delta,
+                    passed_delta,
+                    failed_delta,
+                    1 if suspect else 0,
+                    when,
+                    when,
+                    slash,
+                    trust_delta,
+                    accepted_delta,
+                    passed_delta,
+                    failed_delta,
+                    None if suspect is None else (1 if suspect else 0),
+                ),
+            )
+            row = self._conn.execute(
+                "SELECT * FROM client_trust WHERE client_token = ?",
+                (client_token,),
+            ).fetchone()
+        return dict(row)
+
+    def get_trust_summary(self, threshold: float) -> dict:
+        """Tier counts for the fleet block / nice_server_trust_clients."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT trust, suspect FROM client_trust"
+            ).fetchall()
+        tiers = {"trusted": 0, "untrusted": 0, "suspect": 0}
+        for r in rows:
+            if r["suspect"]:
+                tiers["suspect"] += 1
+            elif threshold > 0 and r["trust"] < threshold:
+                tiers["untrusted"] += 1
+            else:
+                tiers["trusted"] += 1
+        return tiers

@@ -52,7 +52,14 @@ CREATE TABLE IF NOT EXISTS claims (
     field_id        INTEGER NOT NULL REFERENCES fields(id),
     search_mode     TEXT NOT NULL,                 -- 'detailed' | 'niceonly'
     claim_time      TEXT NOT NULL,
-    user_ip         TEXT NOT NULL
+    user_ip         TEXT NOT NULL,
+    block_id        TEXT,                          -- /claim_block lease group
+    client_token    TEXT,                          -- trust identity (NULL =
+                                                   -- legacy/anonymous-by-ip)
+    lease_expiry    TEXT,                          -- ISO-8601 UTC; NULL =
+                                                   -- legacy open-ended claim
+    lease_secs      REAL                           -- window the expiry was
+                                                   -- minted/renewed with
 );
 
 CREATE TABLE IF NOT EXISTS submissions (
@@ -68,10 +75,12 @@ CREATE TABLE IF NOT EXISTS submissions (
     disqualified    INTEGER NOT NULL DEFAULT 0,
     distribution    TEXT,                          -- JSON or NULL (niceonly)
     numbers         TEXT NOT NULL DEFAULT '[]',    -- JSON
-    submit_id       TEXT                           -- exactly-once idempotency
+    submit_id       TEXT,                          -- exactly-once idempotency
                                                    -- key (claim + content
                                                    -- hash); NULL from legacy
                                                    -- clients
+    client_token    TEXT                           -- trust identity the
+                                                   -- submission arrived under
 );
 -- The partial unique index behind the submit_id dedup lives in
 -- Db.init_schema (Python), after the legacy-DB ALTER TABLE migration —
@@ -140,3 +149,20 @@ CREATE TABLE IF NOT EXISTS client_telemetry (
 
 CREATE INDEX IF NOT EXISTS idx_client_telemetry_last_seen
     ON client_telemetry(last_seen);
+
+-- Untrusted-client trust ledger: one row per client identity (telemetry
+-- client_id, a server-issued anonymous token, or username@ip). Spot-check
+-- outcomes move the score; the score drives the spot-sampling rate, the
+-- claim profile (micro-fields + short leases below NICE_TPU_TRUST_THRESHOLD)
+-- and the rate-limit bucket multiplier. NOT exposed via /query — tokens act
+-- as bearer credentials.
+CREATE TABLE IF NOT EXISTS client_trust (
+    client_token    TEXT PRIMARY KEY,
+    trust           REAL NOT NULL DEFAULT 0,
+    submissions_accepted INTEGER NOT NULL DEFAULT 0,
+    spot_checks_passed   INTEGER NOT NULL DEFAULT 0,
+    spot_checks_failed   INTEGER NOT NULL DEFAULT 0,
+    suspect         INTEGER NOT NULL DEFAULT 0,
+    first_seen      TEXT NOT NULL,                 -- ISO-8601 UTC
+    last_seen       TEXT NOT NULL                  -- ISO-8601 UTC
+);

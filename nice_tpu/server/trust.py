@@ -1,0 +1,242 @@
+"""Client trust tiers + seeded spot verification.
+
+Every client carries a persisted trust score (server/db.py client_trust
+table), keyed by its trust token: the telemetry client_id for CLI clients,
+a server-issued anonymous token for browser clients (POST /token), or
+username@ip as the legacy fallback. On each accepted submission the server
+re-runs a random sample of the claimed range on the trusted scalar engine;
+the sampling rate scales inversely with trust (~100% for brand-new clients
+down to the NICE_TPU_SPOT_RATE floor for veterans), and the RNG is seeded
+per submission (NICE_TPU_SPOT_SEED + the submit key) so the decision and
+the sampled slice are deterministic regardless of thread interleaving.
+
+A passed check adds +1 trust through ONE writer-actor upsert (the only DB
+write spot verification adds to the hot accept path). A failed check
+slashes trust to zero, marks the client suspect, disqualifies the
+submission, and requeues the field — all off the accept path.
+
+Trust feeds check_level: with NICE_TPU_TRUST_THRESHOLD > 0, submissions
+from below-threshold clients never promote canon directly; the field is
+held at "needs consensus" (check_level 1) until an independent client
+agrees (app.py hooks the per-field streaming consensus on the submit
+path). The threshold defaults to 0 — gating OFF — so trusted-fleet
+deployments keep the original single-submission promotion semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import Optional
+
+from nice_tpu.core import number_stats
+from nice_tpu.core.types import NiceNumber, UniquesDistribution
+from nice_tpu.obs.series import SERVER_SPOT_CHECKS
+from nice_tpu.ops import scalar
+from nice_tpu.server.db import Db
+
+log = logging.getLogger("nice_tpu.server.trust")
+
+
+def trust_threshold() -> float:
+    """Trust score below which a client is untrusted (0 disables gating)."""
+    return float(os.environ.get("NICE_TPU_TRUST_THRESHOLD", 0))
+
+
+def spot_rate_floor() -> float:
+    """Veteran-client sampling-rate floor (~1% by default)."""
+    return min(1.0, max(0.0, float(os.environ.get("NICE_TPU_SPOT_RATE", 0.01))))
+
+
+def spot_seed() -> str:
+    return os.environ.get("NICE_TPU_SPOT_SEED", "0")
+
+
+def spot_slice_len() -> int:
+    """Numbers re-scanned per sampled submission (0 disables spot checks)."""
+    return int(os.environ.get("NICE_TPU_SPOT_SLICE", 256))
+
+
+def sample_rate(trust: float) -> float:
+    """Inverse-trust sampling: trust 0 -> 1.0, trust 99 -> ~0.01, floored
+    at spot_rate_floor so veterans stay spot-checked forever."""
+    return max(spot_rate_floor(), min(1.0, 1.0 / (1.0 + max(0.0, trust))))
+
+
+def submission_rng(submit_key: str) -> random.Random:
+    """Deterministic per-submission RNG: seeded from the global spot seed
+    plus the submission's idempotency key, so tests (and replays) see the
+    same sample decision and slice regardless of scheduling."""
+    return random.Random(f"{spot_seed()}:{submit_key}")
+
+
+def resolve_token(payload: dict, headers, username: str, user_ip: str) -> str:
+    """The client's trust identity, most-specific first: an explicit
+    X-Client-Token header (server-issued anonymous tokens), the telemetry
+    client_id piggybacked on the payload, then username@ip."""
+    token = headers.get("X-Client-Token") if headers is not None else None
+    if token:
+        return str(token)[:256]
+    tel = payload.get("telemetry") if isinstance(payload, dict) else None
+    if isinstance(tel, dict) and tel.get("client_id"):
+        return str(tel["client_id"])[:256]
+    return f"{username or 'anon'}@{user_ip or 'unknown'}"[:256]
+
+
+class TrustStore:
+    """Read-through in-memory view of the client_trust table.
+
+    Reads (claim profile selection, limiter bucket sizing, sampling rate)
+    hit the cache — the rate limiter peeks at trust ON THE EVENT LOOP
+    thread, where sqlite is forbidden. Writes go through the writer actor
+    (ctx.write) and refresh the cache from the returned row."""
+
+    def __init__(self, db: Db):
+        self.db = db
+        self._cache: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def get(self, client_token: str) -> dict:
+        with self._lock:
+            row = self._cache.get(client_token)
+        if row is not None:
+            return row
+        row = self.db.get_client_trust(client_token) or {
+            "client_token": client_token,
+            "trust": 0.0,
+            "suspect": 0,
+        }
+        with self._lock:
+            self._cache[client_token] = row
+        return row
+
+    def peek(self, client_token: str) -> Optional[dict]:
+        """Cache-only read (event-loop safe; None = not yet cached)."""
+        with self._lock:
+            return self._cache.get(client_token)
+
+    def update(self, row: dict) -> None:
+        with self._lock:
+            self._cache[row["client_token"]] = row
+
+    def trust(self, client_token: str) -> float:
+        return float(self.get(client_token).get("trust", 0.0))
+
+    def is_trusted(self, client_token: str) -> bool:
+        threshold = trust_threshold()
+        if threshold <= 0:
+            return True
+        row = self.get(client_token)
+        return not row.get("suspect") and float(row.get("trust", 0.0)) >= threshold
+
+    def should_sample(self, client_token: str, rng: random.Random) -> bool:
+        if spot_slice_len() <= 0:
+            return False
+        return rng.random() < sample_rate(self.trust(client_token))
+
+
+def spot_check(
+    base: int,
+    range_start: int,
+    range_end: int,
+    distribution: Optional[list[UniquesDistribution]],
+    numbers: list[NiceNumber],
+    rng: random.Random,
+) -> tuple[bool, str]:
+    """Re-run a random slice of the claimed range on the trusted scalar
+    engine and cross-check it against the claimed results. Returns
+    (ok, detail). Runs on the handler thread; pure compute, no DB access.
+
+    Checks, cheapest first:
+      1. every CLAIMED nice number lies in the range and recomputes to its
+         claimed num_uniques (nice numbers are rare, so this is cheap; it is
+         the only verification niceonly submissions ever get);
+      2. a seeded random slice of the range is rescanned — any slice number
+         above the near-miss cutoff (detailed) or fully nice (niceonly) must
+         appear in the claimed numbers, and per-bucket slice counts must not
+         exceed the claimed distribution (detailed).
+    """
+    for n in numbers:
+        if not (range_start <= n.number < range_end):
+            return False, f"claimed number {n.number} outside range"
+        calculated = scalar.get_num_unique_digits(n.number, base)
+        if calculated != n.num_uniques:
+            return (
+                False,
+                f"claimed number {n.number} has {calculated} uniques,"
+                f" not {n.num_uniques}",
+            )
+
+    slice_len = min(spot_slice_len(), range_end - range_start)
+    if slice_len <= 0:
+        return True, "empty slice"
+    start = range_start + rng.randrange(
+        max(1, (range_end - range_start) - slice_len + 1)
+    )
+    claimed_numbers = {n.number: n.num_uniques for n in numbers}
+    claimed_counts = (
+        {d.num_uniques: d.count for d in distribution}
+        if distribution is not None
+        else None
+    )
+    cutoff = number_stats.get_near_miss_cutoff(base)
+    slice_counts: dict[int, int] = {}
+    for x in range(start, start + slice_len):
+        uniques = scalar.get_num_unique_digits(x, base)
+        slice_counts[uniques] = slice_counts.get(uniques, 0) + 1
+        if claimed_counts is not None:
+            # Detailed: everything above the cutoff must be in the claimed
+            # numbers list (the distribution cross-check below bounds the
+            # rest).
+            if uniques > cutoff and claimed_numbers.get(x) != uniques:
+                return (
+                    False,
+                    f"{x} has {uniques} uniques but is missing from the"
+                    f" claimed nice numbers",
+                )
+        else:
+            # Niceonly: only 100% nice numbers are reportable.
+            if uniques == base and x not in claimed_numbers:
+                return False, f"nice number {x} missing from claimed numbers"
+    if claimed_counts is not None:
+        for uniques, count in slice_counts.items():
+            if count > claimed_counts.get(uniques, 0):
+                return (
+                    False,
+                    f"slice holds {count} numbers with {uniques} uniques;"
+                    f" claimed distribution has {claimed_counts.get(uniques, 0)}",
+                )
+    return True, f"slice [{start}, {start + slice_len}) ok"
+
+
+def run_spot_check(
+    store: TrustStore,
+    client_token: str,
+    submit_key: str,
+    base: int,
+    range_start: int,
+    range_end: int,
+    distribution: Optional[list[UniquesDistribution]],
+    numbers: list[NiceNumber],
+) -> tuple[str, str]:
+    """Sampling decision + verification for one accepted submission.
+    Returns (verdict, detail) with verdict in pass/fail/skipped and bumps
+    nice_server_spot_checks_total. No DB writes happen here — the caller
+    routes the consequences (trust upsert / slash) through the writer."""
+    rng = submission_rng(submit_key)
+    if not store.should_sample(client_token, rng):
+        SERVER_SPOT_CHECKS.labels("skipped").inc()
+        return "skipped", "not sampled"
+    ok, detail = spot_check(
+        base, range_start, range_end, distribution, numbers, rng
+    )
+    verdict = "pass" if ok else "fail"
+    SERVER_SPOT_CHECKS.labels(verdict).inc()
+    if not ok:
+        log.warning(
+            "spot check FAILED for client %s (%s): %s",
+            client_token, submit_key, detail,
+        )
+    return verdict, detail

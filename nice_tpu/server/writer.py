@@ -22,6 +22,7 @@ import logging
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
@@ -68,6 +69,7 @@ class WriteActor:
         )
         self._q: queue.Queue = queue.Queue()
         self._closed = False
+        self._periodics: list[dict] = []
         self._thread: threading.Thread | None = None
         if start:
             self._thread = threading.Thread(
@@ -88,6 +90,21 @@ class WriteActor:
         """Enqueue and block for the result (the common handler-thread path)."""
         return self.submit(fn, *args, **kwargs).result()
 
+    def add_periodic(self, fn: Callable[[], Any], interval_secs: float) -> None:
+        """Run fn() on the writer thread roughly every interval_secs (the
+        lease-expiry sweep lives here so background maintenance shares the
+        single-writer discipline instead of adding a second mutating thread).
+        fn runs BETWEEN batches, owns its own transaction, and its exceptions
+        are logged, never fatal to the writer. Best-effort cadence: a long
+        batch delays the next tick."""
+        self._periodics.append(
+            {
+                "fn": fn,
+                "interval": float(interval_secs),
+                "next": time.monotonic() + float(interval_secs),
+            }
+        )
+
     def queue_depth(self) -> int:
         return self._q.qsize()
 
@@ -102,12 +119,32 @@ class WriteActor:
 
     # -- writer thread ------------------------------------------------------
 
-    def _run(self) -> None:
-        import time
+    def _next_periodic_delay(self) -> float | None:
+        """Seconds until the earliest periodic is due (None = no periodics,
+        block indefinitely on the queue as before)."""
+        if not self._periodics:
+            return None
+        return max(0.0, min(p["next"] for p in self._periodics) - time.monotonic())
 
+    def _run_periodics(self) -> None:
+        now = time.monotonic()
+        for p in self._periodics:
+            if now < p["next"]:
+                continue
+            try:
+                p["fn"]()
+            except Exception:
+                log.exception("writer periodic %r failed", p["fn"])
+            p["next"] = time.monotonic() + p["interval"]
+
+    def _run(self) -> None:
         stopping = False
         while not stopping:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=self._next_periodic_delay())
+            except queue.Empty:
+                self._run_periodics()
+                continue
             if item is _STOP:
                 return
             batch = [item]
@@ -130,6 +167,7 @@ class WriteActor:
             SERVER_WRITER_QUEUE_DEPTH.set(self._q.qsize())
             SERVER_WRITE_BATCH_SIZE.observe(len(batch))
             self._run_batch(batch)
+            self._run_periodics()
 
     def _run_batch(self, batch: list) -> None:
         # Futures resolve only AFTER the outer transaction commits: an
@@ -180,6 +218,11 @@ class DirectWriter:
 
     def call(self, fn: Callable, *args, **kwargs) -> Any:
         return fn(*args, **kwargs)
+
+    def add_periodic(self, fn: Callable[[], Any], interval_secs: float) -> None:
+        """No background thread here: periodics (the lease sweep) simply
+        don't run. Tests driving DirectWriter call the swept function
+        directly when they need its effect."""
 
     def queue_depth(self) -> int:
         return 0

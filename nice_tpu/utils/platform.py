@@ -108,3 +108,72 @@ def probe_backend(
         f"jax backend init hung >{timeout_s:.0f}s in phase"
         f" '{stalled}' (wedged device lease?)"
     )
+
+
+# The probe child is tiny enough to inline: optionally simulate a hang (test
+# hook), import jax, force the platform, print the device count. Everything
+# jax touches stays in the child.
+_PROBE_CHILD = """\
+import os, sys
+hang = float(os.environ.get("NICE_PROBE_TEST_HANG", "0") or 0)
+if hang:
+    import time
+    time.sleep(hang)
+import jax
+plat = sys.argv[1]
+if plat:
+    jax.config.update("jax_platforms", plat)
+sys.stdout.write(str(len(jax.devices())))
+"""
+
+
+def probe_backend_subprocess(
+    timeout_s: float = 60.0,
+    platform: str | None = None,
+):
+    """HARD-watchdog variant of probe_backend: init runs in a child process
+    that is killed outright on timeout.
+
+    The daemon-thread watchdog above detects a hang but cannot reclaim it —
+    the thread is unjoinable and jax has cached a failed backend, so the
+    only clean retry is re-exec'ing the whole process. Here the parent never
+    imports jax: a wedged init is SIGKILLed with the child, leaving the
+    caller jax-clean and free to retry in-process. Same (count | None,
+    error | None) contract. The NICE_PROBE_TEST_HANG env var (seconds)
+    makes the child sleep before importing jax so tests can exercise the
+    kill path without wedging a real backend."""
+    import subprocess
+    import sys
+
+    from nice_tpu import obs
+
+    with obs.span(
+        "backend-init.subprocess-probe", platform=platform or "default"
+    ):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD, platform or ""],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            out, err_text = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            obs.trace_event(
+                "backend-init", "timeout", phase="subprocess-probe",
+                timeout_s=timeout_s,
+            )
+            return None, TimeoutError(
+                f"jax backend init hung >{timeout_s:.0f}s"
+                f" (probe subprocess killed; wedged device lease?)"
+            )
+    if proc.returncode == 0:
+        try:
+            return int(out.strip().split()[-1]), None
+        except (ValueError, IndexError):
+            pass
+    tail = (err_text or out or "").strip().splitlines()
+    detail = tail[-1] if tail else f"exit code {proc.returncode}"
+    return None, RuntimeError(f"backend probe subprocess failed: {detail}")

@@ -1,0 +1,627 @@
+"""Adversarial client harness: hostile personas against a real server.
+
+Runs the same server twice on identically seeded ledgers:
+
+  phase 1 (baseline)    honest honor-system clients only
+  phase 2 (adversarial) the same honest population PLUS four personas:
+    result-forger   submits fabricated nice numbers (niceonly) and a
+                    fabricated distribution (detailed)
+    claim-hoarder   claims micro-field blocks and walks away (abandons)
+    replayer        re-sends an already-accepted submission verbatim
+    rate-flooder    hammers /claim under one client token
+
+Both phases end with a drain loop that completes every remaining field, then
+the harness audits the ledger and asserts the hardening contract:
+
+  * forged results are 100% disqualified and 0% canon
+  * every abandoned field is re-issued (lease sweep) and completed
+  * the flooder gets 429s while honest clients see none and keep their
+    submit p99 within 2x of the baseline phase
+  * replays are exactly-once (no submit_id ever has two rows)
+  * the adversarial ledger digest is byte-identical to the honest baseline
+    (field ranges + clamped check level + live submission content)
+
+Usage:
+    python scripts/adversarial_smoke.py --out ADVERSARIAL_r01.json
+    python scripts/adversarial_smoke.py --honest 8 --fields 200   # CI scale
+
+Exit code 0 only when every assertion holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import socket
+import sqlite3
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from load_harness import (  # noqa: E402
+    BASE,
+    MiniConn,
+    Stats,
+    _pctl,
+    _pick_port,
+    _seed_db,
+    _submission,
+)
+
+from nice_tpu import faults  # noqa: E402
+
+# The hardening envelope under test — identical for both phases so the
+# ledgers are comparable. Seeded spot verification samples 100% (fresh,
+# untrusted clients) with a pinned RNG seed; micro-leases expire in 1s and
+# the writer-actor sweep re-issues them ~4x/sec; the per-client rate buckets
+# are sized so the sequential honest/drain loops never trip them but a
+# tight-loop flooder does.
+SERVER_ENV = {
+    "NICE_TPU_TRUST_THRESHOLD": "5",
+    "NICE_TPU_SPOT_RATE": "1.0",
+    "NICE_TPU_SPOT_SEED": "1",
+    "NICE_TPU_SPOT_SLICE": "256",
+    "NICE_TPU_UNTRUSTED_LEASE_SECS": "1",
+    "NICE_TPU_LEASE_SWEEP_SECS": "0.25",
+    "NICE_TPU_UNTRUSTED_MAX_CLAIMS": "16",
+    "NICE_TPU_RATE_BUCKET": "200:60",
+    "NICE_TPU_MAX_INFLIGHT": "1024",
+    "NICE_TPU_SERVER_WORKERS": "16",
+    "JAX_PLATFORMS": "cpu",
+}
+DEFAULT_FAULT_SPEC = "http.submit_block:drop_response@0.05"
+DEFAULT_FAULT_SEED = 1
+
+
+def _spawn_server(db_path: str, workdir: str):
+    port = _pick_port()
+    env = dict(os.environ, **SERVER_ENV)
+    env.pop("NICE_TPU_FAULTS", None)  # faults live client-side here
+    logf = open(os.path.join(workdir, "server.log"), "ab")
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "nice_tpu.server",
+            "--db", db_path, "--host", "127.0.0.1", "--port", str(port),
+        ],
+        stdout=logf, stderr=subprocess.STDOUT, env=env,
+    )
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if server.poll() is not None:
+            raise RuntimeError("server subprocess died on startup")
+        try:
+            with socket.create_connection(("127.0.0.1", port), 1):
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        raise RuntimeError("server never started listening")
+    return server, port, logf
+
+
+async def _req(conn: MiniConn, token: str, method: str, target: str,
+               body=None, attempts: int = 4):
+    """One request under a client token, with bounded replay on faults and
+    transport errors (mirrors load_harness._faulted_request)."""
+    endpoint = target.lstrip("/").split("/", 1)[0].split("?", 1)[0]
+    headers = {"X-Client-Token": token}
+    for _ in range(attempts):
+        act = faults.fire(f"http.{endpoint}", target=target)
+        try:
+            if act == "drop_response":
+                await conn.request(method, target, body, headers=headers)
+                continue  # the reply vanished; replay
+            if act in ("conn_error", "raise"):
+                continue
+            return await conn.request(method, target, body, headers=headers)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            continue
+    return None, None
+
+
+# -- personas ----------------------------------------------------------------
+
+
+async def _honest_client(cfg, stats: Stats, idx: int):
+    """The load_harness honor-system loop, under a per-client trust token.
+    Also the control group for the p99 and zero-429 assertions."""
+    token = f"honest-{idx}"
+    conn = MiniConn(cfg["host"], cfg["port"])
+    try:
+        for _ in range(cfg["rounds"]):
+            t0 = time.monotonic()
+            status, block = await _req(
+                conn, token, "POST", "/claim_block",
+                {"mode": "niceonly", "count": cfg["block_size"],
+                 "username": token},
+            )
+            stats.claim_lat.append(time.monotonic() - t0)
+            if status == 429:
+                stats.honest_429s += 1  # honest 429s fail the run
+                continue
+            if status != 200:
+                continue  # claim exhaustion near the end of the frontier
+            subs = [
+                _submission(f["claim_id"], token) for f in block["fields"]
+            ]
+            stats.fields_claimed += len(subs)
+            t0 = time.monotonic()
+            status, resp = await _req(
+                conn, token, "POST", "/submit_block",
+                {"block_id": block["block_id"], "submissions": subs},
+            )
+            stats.submit_lat.append(time.monotonic() - t0)
+            if status == 429:
+                stats.honest_429s += 1
+            elif status == 200 and isinstance(resp, dict):
+                for result in resp.get("results") or []:
+                    if not isinstance(result, dict):
+                        continue
+                    if result.get("status") == "error":
+                        stats.http_errors += 1
+                    elif result.get("duplicate"):
+                        stats.duplicates += 1
+                    else:
+                        stats.submissions_accepted += 1
+                stats.owned_submit_ids.extend(s["submit_id"] for s in subs)
+    finally:
+        await conn.close()
+
+
+async def _forger(cfg, out: dict):
+    """Result forger: fabricated niceonly numbers + a fabricated detailed
+    distribution, all of which pass the accept-time shape checks."""
+    conn = MiniConn(cfg["host"], cfg["port"])
+    forged = 0
+    try:
+        for _ in range(cfg["forgeries"]):
+            status, field = await _req(
+                conn, "forger", "GET", "/claim/niceonly?username=forger"
+            )
+            if status != 200:
+                continue
+            # Claims the field's first number is 100% nice — the
+            # trusted-engine recompute in the spot check disproves it.
+            payload = {
+                "claim_id": field["claim_id"],
+                "username": "forger",
+                "client_version": "adversarial",
+                "unique_distribution": None,
+                "nice_numbers": [
+                    {"number": int(field["range_start"]), "num_uniques": BASE}
+                ],
+            }
+            status, _ = await _req(conn, "forger", "POST", "/submit", payload)
+            forged += status == 200
+        for _ in range(cfg["detailed_forgeries"]):
+            status, field = await _req(
+                conn, "forger", "GET", "/claim/detailed?username=forger"
+            )
+            if status != 200:
+                continue
+            # All mass claimed in one low bucket: sums match, no numbers due
+            # above the cutoff — shape-valid, and refuted by any real slice.
+            payload = {
+                "claim_id": field["claim_id"],
+                "username": "forger",
+                "client_version": "adversarial",
+                "unique_distribution": [
+                    {"num_uniques": 1, "count": int(field["range_size"])}
+                ],
+                "nice_numbers": [],
+            }
+            status, _ = await _req(conn, "forger", "POST", "/submit", payload)
+            forged += status == 200
+    finally:
+        await conn.close()
+    out["forged_accepted"] = forged
+
+
+async def _hoarder(cfg, out: dict):
+    """Claim hoarder/abandoner: grabs micro-field blocks, never submits.
+    The outstanding-claims cap 429s further hoarding; the lease sweep
+    re-issues everything it sat on."""
+    conn = MiniConn(cfg["host"], cfg["port"])
+    abandoned: list[str] = []
+    capped = 0
+    try:
+        for _ in range(8):
+            status, block = await _req(
+                conn, "hoarder", "POST", "/claim_block",
+                {"mode": "niceonly", "count": 8, "username": "hoarder"},
+            )
+            if status == 429:
+                capped += 1
+                break
+            if status == 200:
+                abandoned.extend(f["range_start"] for f in block["fields"])
+    finally:
+        await conn.close()
+    out["abandoned_fields"] = abandoned
+    out["hoarder_hit_cap"] = capped > 0
+
+
+async def _replayer(cfg, out: dict):
+    """Replays one accepted submission verbatim: every replay must answer
+    {"duplicate": true} and mint no second row."""
+    conn = MiniConn(cfg["host"], cfg["port"])
+    duplicates = 0
+    try:
+        status, field = await _req(
+            conn, "replayer", "GET", "/claim/niceonly?username=replayer"
+        )
+        if status == 200:
+            sub = _submission(field["claim_id"], "replayer")
+            await _req(conn, "replayer", "POST", "/submit", sub)
+            for _ in range(5):
+                status, resp = await _req(
+                    conn, "replayer", "POST", "/submit", sub
+                )
+                duplicates += bool(
+                    status == 200 and isinstance(resp, dict)
+                    and resp.get("duplicate")
+                )
+    finally:
+        await conn.close()
+    out["replay_duplicates"] = duplicates
+
+
+async def _flooder(cfg, out: dict):
+    """Rate flooder: a tight claim loop under one token. The per-client
+    bucket 429s it without touching anyone else's budget."""
+    conn = MiniConn(cfg["host"], cfg["port"])
+    limited = sent = 0
+    try:
+        for _ in range(cfg["flood_requests"]):
+            status, _ = await _req(
+                conn, "flooder", "GET", "/claim/niceonly?username=flooder",
+                attempts=1,
+            )
+            sent += status is not None
+            limited += status == 429
+    finally:
+        await conn.close()
+    out["flood_requests"] = sent
+    out["flood_429s"] = limited
+
+
+# -- drain + ledger audits ---------------------------------------------------
+
+
+def _incomplete_fields(db_path: str) -> int:
+    conn = sqlite3.connect(db_path)
+    try:
+        return conn.execute(
+            "SELECT COUNT(*) FROM fields f WHERE NOT EXISTS"
+            " (SELECT 1 FROM submissions s WHERE s.field_id = f.id"
+            "  AND s.disqualified = 0)"
+        ).fetchone()[0]
+    finally:
+        conn.close()
+
+
+async def _drain(cfg, db_path: str, deadline_secs: float = 90.0) -> int:
+    """Complete every remaining field (re-issued abandons surface as their
+    short leases expire). Returns fields left incomplete at the deadline."""
+    conn = MiniConn(cfg["host"], cfg["port"])
+    deadline = time.monotonic() + deadline_secs
+    try:
+        while time.monotonic() < deadline:
+            remaining = _incomplete_fields(db_path)
+            if remaining == 0:
+                return 0
+            status, block = await _req(
+                conn, "drain", "POST", "/claim_block",
+                {"mode": "niceonly", "count": 12, "username": "drain"},
+            )
+            if status != 200:
+                # Exhausted = everything claimable is leased out; wait for
+                # the sweep to recycle abandoned micro-leases.
+                await asyncio.sleep(0.3)
+                continue
+            subs = [
+                _submission(f["claim_id"], "drain") for f in block["fields"]
+            ]
+            await _req(
+                conn, "drain", "POST", "/submit_block",
+                {"block_id": block["block_id"], "submissions": subs},
+            )
+        return _incomplete_fields(db_path)
+    finally:
+        await conn.close()
+
+
+def _ledger_digest(db_path: str) -> str:
+    """Content digest of the canonical ledger: per field, the range bounds,
+    the check level clamped to [0,1] (re-verification churn is not
+    corruption), and the SORTED DISTINCT content of live submissions.
+    Usernames, ips, timestamps, claims, and disqualified rows are all
+    excluded — two runs that established the same canonical knowledge hash
+    identically."""
+    conn = sqlite3.connect(db_path)
+    conn.row_factory = sqlite3.Row
+    try:
+        fields = conn.execute(
+            "SELECT id, range_start, range_end, check_level FROM fields"
+            " ORDER BY range_start"
+        ).fetchall()
+        subs: dict[int, set] = {}
+        for row in conn.execute(
+            "SELECT field_id, search_mode, distribution, numbers"
+            " FROM submissions WHERE disqualified = 0"
+        ):
+            subs.setdefault(row["field_id"], set()).add(
+                (row["search_mode"], row["distribution"], row["numbers"])
+            )
+    finally:
+        conn.close()
+    ledger = [
+        [
+            f["range_start"],
+            f["range_end"],
+            min(f["check_level"], 1),
+            sorted(subs.get(f["id"], set())),
+        ]
+        for f in fields
+    ]
+    return hashlib.sha256(
+        json.dumps(ledger, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _exactly_once_violations(db_path: str) -> int:
+    conn = sqlite3.connect(db_path)
+    try:
+        return conn.execute(
+            "SELECT COUNT(*) FROM (SELECT submit_id FROM submissions"
+            " WHERE submit_id IS NOT NULL GROUP BY submit_id"
+            " HAVING COUNT(*) > 1)"
+        ).fetchone()[0]
+    finally:
+        conn.close()
+
+
+def _forgery_audit(db_path: str) -> dict:
+    conn = sqlite3.connect(db_path)
+    try:
+        total, disq = conn.execute(
+            "SELECT COUNT(*), COALESCE(SUM(disqualified), 0)"
+            " FROM submissions WHERE username = 'forger'"
+        ).fetchone()
+        canon = conn.execute(
+            "SELECT COUNT(*) FROM fields f JOIN submissions s"
+            " ON f.canon_submission_id = s.id WHERE s.username = 'forger'"
+        ).fetchone()[0]
+        suspect = conn.execute(
+            "SELECT COALESCE(MAX(suspect), 0) FROM client_trust"
+            " WHERE client_token = 'forger'"
+        ).fetchone()[0]
+    finally:
+        conn.close()
+    return {
+        "forged_submissions": total,
+        "forged_disqualified": disq,
+        "forged_canon": canon,
+        "forger_marked_suspect": bool(suspect),
+    }
+
+
+def _abandon_audit(db_path: str, abandoned: list[str]) -> dict:
+    if not abandoned:
+        return {"abandoned_fields": 0, "reissued_and_completed": 0}
+    conn = sqlite3.connect(db_path)
+    try:
+        marks = ",".join("?" * len(abandoned))
+        completed = conn.execute(
+            f"SELECT COUNT(*) FROM fields f WHERE f.range_start IN ({marks})"
+            " AND EXISTS (SELECT 1 FROM submissions s"
+            "  WHERE s.field_id = f.id AND s.disqualified = 0"
+            "  AND s.username != 'hoarder')",
+            [f"{int(r):040d}" for r in abandoned],
+        ).fetchone()[0]
+    finally:
+        conn.close()
+    return {
+        "abandoned_fields": len(abandoned),
+        "reissued_and_completed": completed,
+    }
+
+
+# -- phases ------------------------------------------------------------------
+
+
+async def _run_phase(cfg, db_path: str, adversarial: bool) -> dict:
+    stats = Stats()
+    stats.honest_429s = 0  # rate-limit hits against honest tokens only
+    out: dict = {}
+    tasks = [
+        _honest_client(cfg, stats, i) for i in range(cfg["honest"])
+    ]
+    if adversarial:
+        tasks += [
+            _forger(cfg, out),
+            _hoarder(cfg, out),
+            _replayer(cfg, out),
+            _flooder(cfg, out),
+        ]
+    t0 = time.monotonic()
+    await asyncio.gather(*tasks)
+    out["population_secs"] = round(time.monotonic() - t0, 2)
+    out["drain_incomplete"] = await _drain(cfg, db_path)
+    out["honest"] = {
+        "clients": cfg["honest"],
+        "fields_claimed": stats.fields_claimed,
+        "submissions_accepted": stats.submissions_accepted,
+        "duplicates": stats.duplicates,
+        "item_errors": stats.http_errors,
+        "rate_limited_429s": stats.honest_429s,
+        "claim_p99_ms": _pctl(stats.claim_lat, 0.99),
+        "submit_p50_ms": _pctl(stats.submit_lat, 0.50),
+        "submit_p99_ms": _pctl(stats.submit_lat, 0.99),
+    }
+    return out
+
+
+def run(
+    *,
+    honest: int = 16,
+    rounds: int = 2,
+    block_size: int = 6,
+    target_fields: int = 600,
+    forgeries: int = 10,
+    detailed_forgeries: int = 4,
+    flood_requests: int = 400,
+    fault_spec: str | None = DEFAULT_FAULT_SPEC,
+    fault_seed: int = DEFAULT_FAULT_SEED,
+    run_label: str = "r01",
+    keep_workdir: bool = False,
+) -> dict:
+    faults.configure(fault_spec, seed=fault_seed)
+    workdir = tempfile.mkdtemp(prefix="adversarial-smoke-")
+    phases: dict[str, dict] = {}
+    digests: dict[str, str] = {}
+    audits: dict[str, dict] = {}
+    try:
+        for phase in ("baseline", "adversarial"):
+            db_path = os.path.join(workdir, f"{phase}.db")
+            seeded = _seed_db(db_path, target_fields)
+            server, port, logf = _spawn_server(db_path, workdir)
+            try:
+                cfg = {
+                    "host": "127.0.0.1", "port": port,
+                    "honest": honest, "rounds": rounds,
+                    "block_size": block_size,
+                    "forgeries": forgeries,
+                    "detailed_forgeries": detailed_forgeries,
+                    "flood_requests": flood_requests,
+                }
+                phases[phase] = asyncio.run(
+                    _run_phase(cfg, db_path, phase == "adversarial")
+                )
+                phases[phase]["seeded_fields"] = seeded
+            finally:
+                server.terminate()
+                try:
+                    server.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    server.kill()
+                    server.wait()
+                logf.close()
+            digests[phase] = _ledger_digest(db_path)
+            audits[phase] = {
+                "exactly_once_violations": _exactly_once_violations(db_path),
+            }
+            if phase == "adversarial":
+                audits[phase].update(_forgery_audit(db_path))
+                audits[phase].update(
+                    _abandon_audit(
+                        db_path, phases[phase].get("abandoned_fields", [])
+                    )
+                )
+        adv = phases["adversarial"]
+        adv_audit = audits["adversarial"]
+        base_p99 = phases["baseline"]["honest"]["submit_p99_ms"]
+        adv_p99 = adv["honest"]["submit_p99_ms"]
+        assertions = {
+            "forged_never_canon": adv_audit["forged_canon"] == 0,
+            "forged_all_disqualified": (
+                adv_audit["forged_submissions"] > 0
+                and adv_audit["forged_disqualified"]
+                == adv_audit["forged_submissions"]
+            ),
+            "forger_marked_suspect": adv_audit["forger_marked_suspect"],
+            "abandoned_all_reissued_completed": (
+                adv_audit["abandoned_fields"] > 0
+                and adv_audit["reissued_and_completed"]
+                == adv_audit["abandoned_fields"]
+            ),
+            "hoarder_hit_claim_cap": bool(adv.get("hoarder_hit_cap")),
+            "flooder_rate_limited": adv.get("flood_429s", 0) > 0,
+            "honest_zero_429s": (
+                phases["baseline"]["honest"]["rate_limited_429s"] == 0
+                and adv["honest"]["rate_limited_429s"] == 0
+            ),
+            "honest_p99_within_2x": (
+                base_p99 > 0 and adv_p99 <= 2.0 * base_p99
+            ),
+            "replays_deduplicated": adv.get("replay_duplicates", 0) == 5,
+            "exactly_once": all(
+                a["exactly_once_violations"] == 0 for a in audits.values()
+            ),
+            "all_fields_completed": all(
+                p["drain_incomplete"] == 0 for p in phases.values()
+            ),
+            "ledger_byte_identical": (
+                digests["baseline"] == digests["adversarial"]
+            ),
+        }
+        # The raw abandoned range list is audit detail, not report material.
+        adv.pop("abandoned_fields", None)
+        return {
+            "run": run_label,
+            "base": BASE,
+            "server_env": SERVER_ENV,
+            "fault_spec": fault_spec,
+            "fault_seed": fault_seed,
+            "phases": phases,
+            "audits": audits,
+            "ledger_digests": digests,
+            "honest_submit_p99_ms": {
+                "baseline": base_p99,
+                "adversarial": adv_p99,
+                "ratio": round(adv_p99 / base_p99, 3) if base_p99 else None,
+            },
+            "assertions": assertions,
+            "passed": all(assertions.values()),
+        }
+    finally:
+        faults.configure(None)
+        if not keep_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="adversarial_smoke")
+    p.add_argument("--honest", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--block-size", type=int, default=6)
+    p.add_argument("--fields", type=int, default=600)
+    p.add_argument("--forgeries", type=int, default=10)
+    p.add_argument("--detailed-forgeries", type=int, default=4)
+    p.add_argument("--flood-requests", type=int, default=400)
+    p.add_argument("--fault-spec", default=DEFAULT_FAULT_SPEC)
+    p.add_argument("--fault-seed", type=int, default=DEFAULT_FAULT_SEED)
+    p.add_argument("--run-label", default="r01")
+    p.add_argument("--out", default=None, help="write the JSON report here")
+    args = p.parse_args(argv)
+    report = run(
+        honest=args.honest,
+        rounds=args.rounds,
+        block_size=args.block_size,
+        target_fields=args.fields,
+        forgeries=args.forgeries,
+        detailed_forgeries=args.detailed_forgeries,
+        flood_requests=args.flood_requests,
+        fault_spec=args.fault_spec,
+        fault_seed=args.fault_seed,
+        run_label=args.run_label,
+    )
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

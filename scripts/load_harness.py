@@ -100,15 +100,18 @@ class MiniConn:
                 pass
             self.reader = self.writer = None
 
-    async def request(self, method: str, target: str, body=None):
+    async def request(self, method: str, target: str, body=None, headers=None):
         """Returns (status, parsed_json). Raises OSError on transport
-        failure (after the one stale-socket reconnect)."""
+        failure (after the one stale-socket reconnect). headers: extra
+        request headers (the adversarial harness sets X-Client-Token)."""
         payload = b"" if body is None else json.dumps(body).encode()
         head = (
             f"{method} {target} HTTP/1.1\r\n"
             f"Host: {self.host}\r\n"
             "Accept: application/json\r\n"
         )
+        for name, value in (headers or {}).items():
+            head += f"{name}: {value}\r\n"
         if payload:
             head += (
                 "Content-Type: application/json\r\n"

@@ -116,7 +116,10 @@ def test_injected_500s_bump_retry_counter(monkeypatch):
     before = CLIENT_RETRIES.value(("other",))
     with pytest.raises(api_client.ApiError) as ei:
         api_client.retry_request("http://127.0.0.1:9/x", max_retries=3)
-    assert ei.value.status is None  # exhausted retries, not a 4xx verdict
+    # Exhausted retries preserve the last definite server answer (here the
+    # injected 500), so callers can tell "server kept refusing" (e.g. a 429
+    # rate limit to back off from) apart from a dead transport (None).
+    assert ei.value.status == 500
     assert CLIENT_RETRIES.value(("other",)) == before + 3
 
 

@@ -241,3 +241,27 @@ def test_probe_backend_success_records_phases():
     n, exc = plat.probe_backend(timeout_s=30.0, platform="cpu")
     assert exc is None and n >= 1
     assert BACKEND_INIT_SECONDS.label_sums()[("devices",)][1] == before + 1
+
+
+def test_probe_backend_subprocess_kills_hung_init(monkeypatch):
+    """The hard watchdog: a wedged init is killed with its child process —
+    the parent gets a TimeoutError promptly instead of a zombie thread."""
+    import time
+
+    from nice_tpu.utils import platform as plat
+
+    monkeypatch.setenv("NICE_PROBE_TEST_HANG", "30")
+    t0 = time.monotonic()
+    n, exc = plat.probe_backend_subprocess(timeout_s=0.5, platform="cpu")
+    assert n is None
+    assert isinstance(exc, TimeoutError)
+    assert "killed" in str(exc)
+    assert time.monotonic() - t0 < 10.0  # killed at the timeout, not 30s
+
+
+def test_probe_backend_subprocess_counts_devices(monkeypatch):
+    from nice_tpu.utils import platform as plat
+
+    monkeypatch.delenv("NICE_PROBE_TEST_HANG", raising=False)
+    n, exc = plat.probe_backend_subprocess(timeout_s=120.0, platform="cpu")
+    assert exc is None and n >= 1

@@ -1,0 +1,495 @@
+"""Untrusted-client hardening: trust tiers, seeded spot verification,
+micro-field leases, per-client rate limiting, and needs-consensus gating.
+
+Each server test boots a real server (writer actor on, queue prefill off so
+claim order is deterministic) with the hardening knobs set via env, drives
+it with the real client API, and then audits the sqlite ledger directly.
+"""
+
+import json
+import sqlite3
+import threading
+import urllib.error
+import urllib.request
+from contextlib import contextmanager
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from nice_tpu import CLIENT_VERSION
+from nice_tpu.client import api_client
+from nice_tpu.client.main import compile_results, process_field
+from nice_tpu.core import consensus
+from nice_tpu.core.types import DataToServer, FieldRecord, SearchMode
+from nice_tpu.obs.series import (
+    SERVER_CONSENSUS_HOLDS,
+    SERVER_LEASES_EXPIRED,
+    SERVER_SPOT_CHECKS,
+)
+from nice_tpu.ops import scalar
+from nice_tpu.server import app as server_app
+from nice_tpu.server import trust
+from nice_tpu.server.db import Db
+
+
+@contextmanager
+def _serve(tmp_path, monkeypatch, env=None, field_size=5, bases=(10,)):
+    for key, value in (env or {}).items():
+        monkeypatch.setenv(key, value)
+    db_path = str(tmp_path / "nice-trust.db")
+    db = Db(db_path)
+    for base in bases:
+        db.seed_base(base, field_size=field_size)
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=False)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", db_path
+    finally:
+        srv.shutdown()
+        api_client.close_connections()
+
+
+def _query(db_path, sql, params=()):
+    conn = sqlite3.connect(db_path)
+    conn.row_factory = sqlite3.Row
+    try:
+        return conn.execute(sql, params).fetchall()
+    finally:
+        conn.close()
+
+
+def _empty_niceonly(claim_id, username):
+    payload = DataToServer(
+        claim_id=claim_id,
+        username=username,
+        client_version=CLIENT_VERSION,
+        unique_distribution=None,
+        nice_numbers=[],
+    )
+    payload.submit_id = f"{claim_id}-forged"
+    return payload
+
+
+# -- pure trust math ---------------------------------------------------------
+
+
+def test_sample_rate_is_inverse_trust_with_floor(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_SPOT_RATE", "0.01")
+    assert trust.sample_rate(0) == 1.0
+    assert trust.sample_rate(1) == 0.5
+    assert abs(trust.sample_rate(99) - 0.01) < 1e-9
+    assert trust.sample_rate(10_000) == 0.01  # floored, never zero
+    monkeypatch.setenv("NICE_TPU_SPOT_RATE", "0.25")
+    assert trust.sample_rate(10_000) == 0.25
+
+
+def test_submission_rng_is_deterministic(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_SPOT_SEED", "42")
+    a = [trust.submission_rng("claim-7").random() for _ in range(4)]
+    b = [trust.submission_rng("claim-7").random() for _ in range(4)]
+    assert a == b
+    assert trust.submission_rng("claim-8").random() != a[0]
+    monkeypatch.setenv("NICE_TPU_SPOT_SEED", "43")
+    assert trust.submission_rng("claim-7").random() != a[0]
+
+
+def test_resolve_token_priority():
+    headers = {"X-Client-Token": "anon-abc"}
+    payload = {"telemetry": {"client_id": "cli-123"}}
+    assert trust.resolve_token(payload, headers, "u", "1.2.3.4") == "anon-abc"
+    assert trust.resolve_token(payload, {}, "u", "1.2.3.4") == "cli-123"
+    assert trust.resolve_token({}, {}, "u", "1.2.3.4") == "u@1.2.3.4"
+    assert trust.resolve_token({}, None, "", "") == "anon@unknown"
+
+
+def test_spot_check_catches_forged_niceonly(monkeypatch):
+    # 69 is the only 100% nice number in base 10; a slice covering it must
+    # find it in the claimed numbers.
+    monkeypatch.setenv("NICE_TPU_SPOT_SLICE", "64")
+    rng = trust.submission_rng("claim-1")
+    ok, detail = trust.spot_check(10, 67, 72, None, [], rng)
+    assert not ok
+    assert "69" in detail
+    # The honest claim passes the same seeded slice.
+    from nice_tpu.core import number_stats
+    from nice_tpu.core.types import NiceNumberSimple
+
+    honest = number_stats.expand_numbers([NiceNumberSimple(69, 10)], 10)
+    rng = trust.submission_rng("claim-1")
+    ok, _ = trust.spot_check(10, 67, 72, None, honest, rng)
+    assert ok
+    # A fabricated uniques count on a claimed number is caught by the
+    # recompute loop regardless of where the slice lands.
+    fake = number_stats.expand_numbers([NiceNumberSimple(50, 10)], 10)
+    rng = trust.submission_rng("claim-1")
+    ok, detail = trust.spot_check(10, 47, 52, None, fake, rng)
+    assert not ok and "50" in detail
+
+
+def test_consensus_holds_lone_untrusted_submission():
+    field = FieldRecord(
+        field_id=1, base=10, chunk_id=None, range_start=47, range_end=100,
+        range_size=53, last_claim_time=None, canon_submission_id=None,
+        check_level=0, prioritize=False,
+    )
+
+    class _Sub:
+        def __init__(self, sid):
+            self.submission_id = sid
+            self.submit_time = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+    lone = _Sub(11)
+    # Legacy behavior: one submission promotes straight to CL2.
+    canon, cl = consensus.evaluate_consensus(field, [lone])
+    assert canon is lone and cl == 2
+    # Untrusted: the same lone submission is held at needs-consensus.
+    canon, cl = consensus.evaluate_consensus(field, [lone], frozenset({11}))
+    assert canon is None and cl == 1
+
+
+# -- end-to-end: forged results, trust ledger, requeue -----------------------
+
+
+def test_forged_submissions_slashed_disqualified_requeued(
+    tmp_path, monkeypatch
+):
+    env = {"NICE_TPU_SPOT_RATE": "1.0", "NICE_TPU_SPOT_SEED": "0"}
+    with _serve(tmp_path, monkeypatch, env) as (base_url, db_path):
+        block_id, fields = api_client.claim_block_from_server(
+            SearchMode.NICEONLY, base_url, "forgy", count=11, max_retries=0
+        )
+        assert len(fields) == 11
+        # Which fields actually hold a 100% nice number (base 10: just 69)?
+        bad_ranges = {
+            (f.range_start, f.range_end)
+            for f in fields
+            if any(
+                scalar.get_num_unique_digits(x, 10) == 10
+                for x in range(f.range_start, f.range_end)
+            )
+        }
+        assert bad_ranges  # the seeded range contains 69
+        subs = [_empty_niceonly(f.claim_id, "forgy") for f in fields]
+        resp = api_client.submit_block_to_server(
+            base_url, block_id, subs, max_retries=0
+        )
+        assert resp["accepted"] == 11  # accept is still the honor system
+
+        # ... but the spot check caught every forged field post-accept:
+        # submission disqualified, trust slashed + suspect, field requeued.
+        disq = _query(
+            db_path,
+            "SELECT c.field_id AS fid FROM submissions s JOIN claims c"
+            " ON s.claim_id = c.id WHERE s.disqualified = 1",
+        )
+        assert len(disq) == len(bad_ranges)
+        trust_row = _query(
+            db_path,
+            "SELECT * FROM client_trust WHERE client_token = ?",
+            ("forgy@127.0.0.1",),
+        )[0]
+        assert trust_row["suspect"] == 1
+        assert trust_row["spot_checks_failed"] == len(bad_ranges)
+        assert trust_row["submissions_accepted"] == 11
+        requeued = _query(
+            db_path,
+            "SELECT check_level, last_claim_time FROM fields WHERE id IN"
+            " (SELECT c.field_id FROM submissions s JOIN claims c"
+            "  ON s.claim_id = c.id WHERE s.disqualified = 1)",
+        )
+        for row in requeued:
+            assert row["check_level"] == 0
+            assert row["last_claim_time"] is None
+
+        # The forged fields are claimable again and an honest client
+        # completes them.
+        spot_before = dict(SERVER_SPOT_CHECKS.values())
+        for _ in bad_ranges:
+            data = api_client.get_field_from_server(
+                SearchMode.NICEONLY, base_url, "honest", max_retries=0
+            )
+            results, _ = process_field(
+                data, SearchMode.NICEONLY, "scalar", 1024
+            )
+            sub = compile_results(data, results, SearchMode.NICEONLY, "honest")
+            api_client.submit_field_to_server(base_url, sub, max_retries=0)
+        spot_after = dict(SERVER_SPOT_CHECKS.values())
+        assert (
+            spot_after[("pass",)] - spot_before.get(("pass",), 0)
+            >= len(bad_ranges)
+        )
+        clean = _query(
+            db_path,
+            "SELECT COUNT(*) AS n FROM submissions s JOIN claims c"
+            " ON s.claim_id = c.id WHERE s.disqualified = 0"
+            " AND s.username = 'honest'",
+        )
+        assert clean[0]["n"] == len(bad_ranges)
+
+
+def test_needs_consensus_gate_promotes_on_agreement(tmp_path, monkeypatch):
+    env = {
+        "NICE_TPU_TRUST_THRESHOLD": "5",
+        "NICE_TPU_SPOT_RATE": "1.0",
+    }
+    # One field covers the whole base, so both clients scan the same range.
+    with _serve(tmp_path, monkeypatch, env, field_size=60) as (
+        base_url, db_path,
+    ):
+        holds_before = SERVER_CONSENSUS_HOLDS.value()
+        data = api_client.get_field_from_server(
+            SearchMode.DETAILED, base_url, "alice", max_retries=0
+        )
+        results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+        sub_a = compile_results(data, results, SearchMode.DETAILED, "alice")
+        api_client.submit_field_to_server(base_url, sub_a, max_retries=0)
+        # An untrusted client alone never makes canon: held at CL1 with the
+        # lease cleared so an independent client re-claims immediately (the
+        # field-queue refill may already have vacuumed the released field
+        # back into claim inventory, so the lease stamp itself is racy to
+        # assert — the re-claim below is the real contract).
+        row = _query(
+            db_path,
+            "SELECT check_level, canon_submission_id FROM fields",
+        )[0]
+        assert row["check_level"] == 1
+        assert row["canon_submission_id"] is None
+        assert SERVER_CONSENSUS_HOLDS.value() > holds_before
+
+        data_b = api_client.get_field_from_server(
+            SearchMode.DETAILED, base_url, "bob", max_retries=0
+        )
+        assert (data_b.range_start, data_b.range_end) == (
+            data.range_start, data.range_end,
+        )
+        results_b, _ = process_field(
+            data_b, SearchMode.DETAILED, "scalar", 1024
+        )
+        sub_b = compile_results(data_b, results_b, SearchMode.DETAILED, "bob")
+        api_client.submit_field_to_server(base_url, sub_b, max_retries=0)
+        # Two independent agreeing submissions -> streaming consensus
+        # promotes canon without waiting for the jobs runner.
+        row = _query(
+            db_path,
+            "SELECT check_level, canon_submission_id FROM fields",
+        )[0]
+        assert row["check_level"] == 3
+        assert row["canon_submission_id"] is not None
+
+
+def test_untrusted_claim_cap_and_block_clamp(tmp_path, monkeypatch):
+    env = {
+        "NICE_TPU_TRUST_THRESHOLD": "5",
+        "NICE_TPU_UNTRUSTED_MAX_CLAIMS": "2",
+        "NICE_TPU_SPOT_SLICE": "0",  # not under test here
+    }
+    with _serve(tmp_path, monkeypatch, env) as (base_url, _):
+        for _ in range(2):
+            api_client.get_field_from_server(
+                SearchMode.NICEONLY, base_url, "hoarder", max_retries=0
+            )
+        with pytest.raises(api_client.ApiError) as err:
+            api_client.get_field_from_server(
+                SearchMode.NICEONLY, base_url, "hoarder", max_retries=0
+            )
+        assert err.value.status == 429
+        # A block claim from a fresh untrusted client is clamped to the cap,
+        # not rejected.
+        _, fields = api_client.claim_block_from_server(
+            SearchMode.NICEONLY, base_url, "hoarder2", count=8, max_retries=0
+        )
+        assert len(fields) == 2
+
+
+def test_untrusted_claims_carry_micro_lease(tmp_path, monkeypatch):
+    env = {
+        "NICE_TPU_TRUST_THRESHOLD": "5",
+        "NICE_TPU_UNTRUSTED_LEASE_SECS": "90",
+        "NICE_TPU_SPOT_SLICE": "0",
+    }
+    with _serve(tmp_path, monkeypatch, env) as (base_url, db_path):
+        api_client.get_field_from_server(
+            SearchMode.NICEONLY, base_url, "micro", max_retries=0
+        )
+        row = _query(
+            db_path, "SELECT lease_secs, lease_expiry FROM claims"
+        )[0]
+        assert row["lease_secs"] == 90
+        assert row["lease_expiry"] is not None
+
+
+# -- end-to-end: lease expiry lifecycle under the writer actor ---------------
+
+
+def test_lease_expiry_sweep_reissue_and_late_submit_conflict(
+    tmp_path, monkeypatch
+):
+    env = {
+        "NICE_TPU_TRUST_THRESHOLD": "5",
+        "NICE_TPU_UNTRUSTED_LEASE_SECS": "0.5",
+        "NICE_TPU_LEASE_SWEEP_SECS": "0.1",
+        "NICE_TPU_SPOT_RATE": "1.0",
+    }
+    # One field covers the whole base so the re-issue is unambiguous.
+    with _serve(tmp_path, monkeypatch, env, field_size=60) as (
+        base_url, db_path,
+    ):
+        expired_before = SERVER_LEASES_EXPIRED.value()
+        data = api_client.get_field_from_server(
+            SearchMode.NICEONLY, base_url, "abandoner", max_retries=0
+        )
+        # The abandoner walks away. The writer-actor sweep releases the
+        # field once the 0.5s micro-lease expires.
+        deadline = datetime.now(timezone.utc) + timedelta(seconds=10)
+        while (
+            SERVER_LEASES_EXPIRED.value() == expired_before
+            and datetime.now(timezone.utc) < deadline
+        ):
+            threading.Event().wait(0.05)
+        assert SERVER_LEASES_EXPIRED.value() > expired_before, (
+            "sweep never released the abandoned lease"
+        )
+
+        # The field is re-issued to a second client, who completes it.
+        data_b = api_client.get_field_from_server(
+            SearchMode.NICEONLY, base_url, "rescuer", max_retries=0
+        )
+        assert (data_b.range_start, data_b.range_end) == (
+            data.range_start, data.range_end,
+        )
+        results, _ = process_field(data_b, SearchMode.NICEONLY, "scalar", 1024)
+        sub_b = compile_results(data_b, results, SearchMode.NICEONLY, "rescuer")
+        api_client.submit_field_to_server(base_url, sub_b, max_retries=0)
+
+        # The abandoner's zombie submit on the expired, re-issued lease is
+        # rejected with 409 — accepting both would double-count the range.
+        with pytest.raises(api_client.ApiError) as err:
+            api_client.submit_field_to_server(
+                base_url, _empty_niceonly(data.claim_id, "abandoner"),
+                max_retries=0,
+            )
+        assert err.value.status == 409
+        rows = _query(
+            db_path,
+            "SELECT username, disqualified FROM submissions",
+        )
+        assert [(r["username"], r["disqualified"]) for r in rows] == [
+            ("rescuer", 0)
+        ]
+
+
+# -- end-to-end: per-client rate limiting ------------------------------------
+
+
+def test_rate_limit_flood_gets_429_honest_token_unaffected(
+    tmp_path, monkeypatch
+):
+    env = {"NICE_TPU_RATE_BUCKET": "3:0.5", "NICE_TPU_SPOT_SLICE": "0"}
+    with _serve(tmp_path, monkeypatch, env) as (base_url, _):
+        def _claim(token):
+            req = urllib.request.Request(
+                f"{base_url}/claim/niceonly?username=u",
+                headers={"X-Client-Token": token},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status
+
+        for _ in range(3):
+            assert _claim("flooder") == 200
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _claim("flooder")
+        assert err.value.code == 429
+        assert int(err.value.headers["Retry-After"]) >= 1
+        body = json.loads(err.value.read())
+        assert body["error"]["code"] == 429
+        # Budgets are per client token: an honest client is unaffected by
+        # the flood, and read endpoints have their own (4x) bucket.
+        assert _claim("honest") == 200
+        with urllib.request.urlopen(f"{base_url}/status", timeout=10) as r:
+            assert r.status == 200
+
+
+def test_client_retry_honors_429_retry_after(tmp_path, monkeypatch):
+    env = {"NICE_TPU_RATE_BUCKET": "1:2", "NICE_TPU_SPOT_SLICE": "0"}
+    with _serve(tmp_path, monkeypatch, env) as (base_url, _):
+        # Drain the single-token burst, then let retry_request ride the 429
+        # + Retry-After to success (a 429 backs off like a 5xx, it does not
+        # raise like other 4xx).
+        api_client.get_field_from_server(
+            SearchMode.NICEONLY, base_url, "u", max_retries=0
+        )
+        data = api_client.get_field_from_server(
+            SearchMode.NICEONLY, base_url, "u", max_retries=3
+        )
+        assert data.claim_id > 0
+
+
+def test_anonymous_token_endpoint(tmp_path, monkeypatch):
+    with _serve(tmp_path, monkeypatch, {}) as (base_url, _):
+        req = urllib.request.Request(f"{base_url}/token", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["client_token"].startswith("anon-")
+        assert len(body["client_token"]) > 20
+
+
+def test_release_orphaned_inventory_frees_dead_queue_stamps(tmp_path):
+    """A SIGKILLed server's queue inventory is lease stamps with no claims
+    rows; the startup sweep must free exactly those — fields actually issued
+    to a client (claims row at the stamp) and long-running renewed claims
+    (old claim_time, live lease) stay leased."""
+    from nice_tpu.core.types import FieldClaimStrategy
+
+    db = Db(str(tmp_path / "orphan.db"))
+    try:
+        db.seed_base(10, field_size=5)
+        cutoff = db.claim_expiry_cutoff()
+
+        # Dead server's inventory: bulk-claim stamps, no claims rows.
+        inventory = db.bulk_claim_fields(2, cutoff, 0, (1 << 128) - 1)
+        assert len(inventory) == 2
+
+        # Properly issued field: claims row minted with the stamp.
+        issued = db.try_claim_field(
+            FieldClaimStrategy.NEXT, cutoff, 0, (1 << 128) - 1
+        )
+        db.insert_claim(
+            issued.field_id, SearchMode.NICEONLY, "1.2.3.4",
+            client_token="tok", lease_secs=3600.0,
+        )
+
+        # Renewed long-runner: claim_time far behind the field stamp, but
+        # the lease is live and unsubmitted.
+        renewed = db.try_claim_field(
+            FieldClaimStrategy.NEXT, cutoff, 0, (1 << 128) - 1
+        )
+        claim = db.insert_claim(
+            renewed.field_id, SearchMode.NICEONLY, "1.2.3.4",
+            client_token="tok", lease_secs=3600.0,
+        )
+        with db._lock, db._txn():
+            db._conn.execute(
+                "UPDATE claims SET claim_time = ? WHERE id = ?",
+                ("2000-01-01T00:00:00.000000Z", claim.claim_id),
+            )
+        db.renew_claim(claim.claim_id)
+
+        released = db.release_orphaned_inventory()
+        assert released == 2
+        rows = _query(
+            db.path,
+            "SELECT id, last_claim_time FROM fields WHERE id IN (?,?,?,?)",
+            [f.field_id for f in inventory]
+            + [issued.field_id, renewed.field_id],
+        )
+        state = {r["id"]: r["last_claim_time"] for r in rows}
+        for f in inventory:
+            assert state[f.field_id] is None
+        assert state[issued.field_id] is not None
+        assert state[renewed.field_id] is not None
+        # Idempotent: a second sweep finds nothing.
+        assert db.release_orphaned_inventory() == 0
+    finally:
+        db.close()

@@ -7,9 +7,13 @@ its earliest submission becomes canon; check_level = group size + 1, capped at
 
 Untrusted-client extension: callers may pass the set of submission ids that
 came from below-trust-threshold clients. An untrusted submission can never
-carry a field to canon ALONE — it needs a second, independent submission whose
-content agrees (the agreeing group is its corroboration). With an empty
-untrusted set the behavior is byte-identical to the reference.
+carry a field to canon ALONE — it needs a second, INDEPENDENT submission whose
+content agrees (the agreeing group is its corroboration). Independence is by
+client_token, not by row: duplicate submissions from one untrusted client
+count once, both for the corroboration test and for check_level, so a client
+that re-claims its own released field and re-submits identical content cannot
+self-corroborate. With an empty untrusted set the behavior is byte-identical
+to the reference.
 """
 
 from __future__ import annotations
@@ -56,11 +60,19 @@ def evaluate_consensus(
 
     majority_group = max(groups.values(), key=len)
     first_submission = min(majority_group, key=lambda s: s.submit_time)
-    if len(majority_group) < 2 and all(
-        s.submission_id in untrusted_ids for s in majority_group
-    ):
+    trusted_members = [
+        s for s in majority_group if s.submission_id not in untrusted_ids
+    ]
+    untrusted_tokens = {
+        s.client_token
+        for s in majority_group
+        if s.submission_id in untrusted_ids
+    }
+    vouchers = len(trusted_members) + len(untrusted_tokens)
+    if not trusted_members and vouchers < 2:
         # The winning content is vouched for by exactly one client, and an
-        # untrusted one: no corroboration, no canon.
+        # untrusted one: no corroboration, no canon — even if that client
+        # submitted the same content more than once.
         return (None, 1)
-    check_level = min(len(majority_group) + 1, 255)
+    check_level = min(vouchers + 1, 255)
     return (first_submission, check_level)

@@ -212,10 +212,11 @@ class ApiContext:
         result (exceptions — notably IntegrityError — re-raise here)."""
         return self.writer.call(fn, *args, **kwargs)
 
-    def _bucket_multiplier(self, token: str) -> float:
+    def _bucket_multiplier(self, key: str) -> float:
         """Trusted veterans earn bigger rate-limit buckets (up to 4x).
-        Cache-only read: this runs on the event-loop thread."""
-        row = self.trust.peek(token)
+        Cache-only read: this runs on the event-loop thread. The bucket key
+        is "ip|token" for validated tokens, the bare IP otherwise."""
+        row = self.trust.peek(key.rsplit("|", 1)[-1])
         if not row or row.get("suspect"):
             return 1.0
         return 1.0 + min(3.0, float(row.get("trust", 0.0)) / 25.0)
@@ -299,18 +300,30 @@ def _untrusted_max_claims() -> int:
     return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_CLAIMS", 16))
 
 
-def _enforce_claim_cap(ctx: ApiContext, client_token: str, requested: int) -> int:
+def _untrusted_max_claims_per_ip() -> int:
+    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_CLAIMS_PER_IP", 256))
+
+
+def _enforce_claim_cap(
+    ctx: ApiContext, client_token: str, user_ip: str, requested: int
+) -> int:
     """Cap outstanding (unexpired, unsubmitted) claims per untrusted client
-    so a hoarder cannot lock up the frontier. Returns how many of the
-    requested claims fit; raises 429 when none do."""
+    so a hoarder cannot lock up the frontier. A second, aggregate ceiling
+    applies per source IP: identities are cheap (telemetry client_id,
+    username@ip variants), so without it a single machine could hoard
+    NICE_TPU_UNTRUSTED_MAX_CLAIMS once per minted identity. Returns how
+    many of the requested claims fit; raises 429 when none do."""
     cap = _untrusted_max_claims()
+    ip_cap = _untrusted_max_claims_per_ip()
     open_claims = ctx.db.count_open_claims(client_token)
-    allowed = max(0, cap - open_claims)
+    open_ip = ctx.db.count_open_claims_by_ip(user_ip) if user_ip else 0
+    allowed = max(0, min(cap - open_claims, ip_cap - open_ip))
     if allowed == 0:
         raise ApiError(
             429,
-            f"too many outstanding claims ({open_claims} open, cap {cap});"
-            " submit results or let the leases expire",
+            f"too many outstanding claims ({open_claims} open for this"
+            f" client, cap {cap}; {open_ip} open for this address, cap"
+            f" {ip_cap}); submit results or let the leases expire",
             headers={
                 "Retry-After": str(
                     max(1, min(int(_untrusted_lease_secs()), 30))
@@ -404,7 +417,7 @@ def claim_helper(
         client_token
     )
     if untrusted:
-        _enforce_claim_cap(ctx, client_token, 1)
+        _enforce_claim_cap(ctx, client_token, user_ip, 1)
     claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
         search_mode, untrusted
     )
@@ -468,11 +481,12 @@ def handle_claim_block(
         raise ApiError(400, f"count must be an integer, got {payload.get('count')!r}")
     count = max(1, min(count, _max_claim_block()))
     client_token = trust_mod.resolve_token(
-        payload, headers, str(payload.get("username") or ""), user_ip
+        payload, headers, str(payload.get("username") or ""), user_ip,
+        store=ctx.trust,
     )
     untrusted = not ctx.trust.is_trusted(client_token)
     if untrusted:
-        count = _enforce_claim_cap(ctx, client_token, count)
+        count = _enforce_claim_cap(ctx, client_token, user_ip, count)
     claim_strategy, max_check_level, max_range_size = _roll_claim_strategy(
         search_mode, untrusted
     )
@@ -594,7 +608,7 @@ def _verify_submission(
             f" {claim.field_id} was re-issued; results discarded",
         )
     client_token = trust_mod.resolve_token(
-        payload, headers, data.username, user_ip
+        payload, headers, data.username, user_ip, store=ctx.trust
     )
     trusted = ctx.trust.is_trusted(client_token)
     submit_key = data.submit_id or f"claim-{data.claim_id}"
@@ -1187,10 +1201,18 @@ def rate_limit_check(ctx: ApiContext, request: Request):
     path = urlparse(request.target).path.rstrip("/")
     if path == "/metrics" or request.method == "OPTIONS":
         return None
-    token = (
-        request.headers.get("X-Client-Token") or request.client_ip or "anon"
-    )
-    allowed, retry_after = ctx.limiter.allow(token, path)
+    ip = request.client_ip or "anon"
+    token = request.headers.get("X-Client-Token")
+    # A header token earns its own bucket only when the server knows it
+    # (cache-only check — this runs on the event-loop thread, where the DB
+    # is off-limits), and the bucket is still scoped by source IP. Unknown
+    # bearer strings all share the plain per-IP bucket, so minting fresh
+    # tokens cannot mint fresh rate-limit budget.
+    if token and ctx.trust.peek_known(str(token)[:256]):
+        key = f"{ip}|{str(token)[:256]}"
+    else:
+        key = ip
+    allowed, retry_after = ctx.limiter.allow(key, path)
     if allowed:
         return None
     SERVER_RATE_LIMITED.inc()
@@ -1315,7 +1337,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                 else SearchMode.NICEONLY
             )
             client_token = trust_mod.resolve_token(
-                {}, request.headers, "", user_ip
+                {}, request.headers, "", user_ip, store=ctx.trust
             )
             return _json_response(
                 200, claim_helper(ctx, mode, user_ip, client_token).to_json()
@@ -1419,11 +1441,15 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
         if method == "POST" and path == "/token":
             # Anonymous trust identity for browser/WASM clients with no
             # telemetry client_id: the token is a bearer credential the
-            # client sends back as X-Client-Token; its trust row is created
-            # lazily on the first accepted submission.
-            return _json_response(
-                200, {"client_token": "anon-" + secrets.token_hex(16)}
-            )
+            # client sends back as X-Client-Token. Its trust row is minted
+            # HERE — only registered tokens are honored as identity, so a
+            # client cannot reset per-token claim caps or the trust ledger
+            # by inventing bearer strings (minting itself is rate-limited
+            # under the per-IP bucket).
+            token = "anon-" + secrets.token_hex(16)
+            row = ctx.write(ctx.db.upsert_client_trust, token)
+            ctx.trust.update(row)
+            return _json_response(200, {"client_token": token})
         if method == "POST" and path == "/telemetry":
             return _json_response(
                 200, handle_telemetry(ctx, _parse_json_body(request), user_ip)

@@ -272,6 +272,12 @@ class Db:
                     "CREATE INDEX IF NOT EXISTS idx_claims_client_token"
                     " ON claims(client_token) WHERE client_token IS NOT NULL"
                 )
+                # The aggregate per-IP outstanding-claims ceiling counts
+                # leased claims by source address.
+                self._conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_claims_user_ip"
+                    " ON claims(user_ip) WHERE lease_expiry IS NOT NULL"
+                )
 
     def close(self) -> None:
         with self._lock, self._pool_lock:
@@ -722,9 +728,12 @@ class Db:
         stamp and claim-row clocks are read milliseconds apart, in either
         order). A renewed claim re-stamps the field while claim_time stays
         at the original claim, so a live unsubmitted lease also keeps its
-        field. Must run before this process's own FieldQueue starts
-        refilling."""
+        field — including a renewed LEGACY claim (lease_expiry NULL, from a
+        pre-trust server), which keeps its field as long as its claim_time
+        is inside the global claim-expiry window. Must run before this
+        process's own FieldQueue starts refilling."""
         now = ts(now_utc())
+        cutoff = ts(self.claim_expiry_cutoff())
         with self._lock, self._txn():
             cur = self._conn.execute(
                 """
@@ -738,9 +747,13 @@ class Db:
                            OR (c.lease_expiry IS NOT NULL
                                AND c.lease_expiry >= :now
                                AND NOT EXISTS (SELECT 1 FROM submissions s
+                                               WHERE s.claim_id = c.id))
+                           OR (c.lease_expiry IS NULL
+                               AND c.claim_time >= :cutoff
+                               AND NOT EXISTS (SELECT 1 FROM submissions s
                                                WHERE s.claim_id = c.id))))
                 """,
-                {"now": now},
+                {"now": now, "cutoff": cutoff},
             )
             released = cur.rowcount
         if released:
@@ -757,6 +770,20 @@ class Db:
                 " AND NOT EXISTS (SELECT 1 FROM submissions s"
                 "                 WHERE s.claim_id = c.id)",
                 (client_token, ts(now_utc())),
+            ).fetchone()
+        return int(row["n"])
+
+    def count_open_claims_by_ip(self, user_ip: str) -> int:
+        """Outstanding unexpired, unsubmitted claims from one source IP,
+        across every client identity behind it (the aggregate ceiling that
+        makes per-identity caps meaningful when identities are free)."""
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT COUNT(*) AS n FROM claims c"
+                " WHERE c.user_ip = ? AND c.lease_expiry >= ?"
+                " AND NOT EXISTS (SELECT 1 FROM submissions s"
+                "                 WHERE s.claim_id = c.id)",
+                (user_ip, ts(now_utc())),
             ).fetchone()
         return int(row["n"])
 

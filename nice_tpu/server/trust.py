@@ -3,12 +3,20 @@
 Every client carries a persisted trust score (server/db.py client_trust
 table), keyed by its trust token: the telemetry client_id for CLI clients,
 a server-issued anonymous token for browser clients (POST /token), or
-username@ip as the legacy fallback. On each accepted submission the server
+username@ip as the legacy fallback. An X-Client-Token header is honored
+only when the server knows the token (issued via POST /token or an earned
+trust history) — an arbitrary bearer string minted by the client falls
+back to the ip-keyed identity, so fresh tokens cannot reset per-client
+claim caps or the trust ledger. On each accepted submission the server
 re-runs a random sample of the claimed range on the trusted scalar engine;
 the sampling rate scales inversely with trust (~100% for brand-new clients
 down to the NICE_TPU_SPOT_RATE floor for veterans), and the RNG is seeded
-per submission (NICE_TPU_SPOT_SEED + the submit key) so the decision and
-the sampled slice are deterministic regardless of thread interleaving.
+per submission (spot seed + the submit key) so the decision and the
+sampled slice are deterministic regardless of thread interleaving. The
+spot seed is a SECRET generated fresh at process start: the submit key is
+client-chosen, so a predictable seed would let an adversary precompute
+the sampled slice and forge everything outside it. NICE_TPU_SPOT_SEED
+overrides it for deterministic tests only — never set it in production.
 
 A passed check adds +1 trust through ONE writer-actor upsert (the only DB
 write spot verification adds to the hot accept path). A failed check
@@ -28,6 +36,7 @@ from __future__ import annotations
 import logging
 import os
 import random
+import secrets
 import threading
 from typing import Optional
 
@@ -50,8 +59,16 @@ def spot_rate_floor() -> float:
     return min(1.0, max(0.0, float(os.environ.get("NICE_TPU_SPOT_RATE", 0.01))))
 
 
+# Secret per-process default for the spot-check RNG seed. The other seed
+# input (the submit key) is chosen by the client, so the seed itself must be
+# unpredictable or the whole verification scheme is precomputable.
+_RUNTIME_SPOT_SEED = secrets.token_hex(16)
+
+
 def spot_seed() -> str:
-    return os.environ.get("NICE_TPU_SPOT_SEED", "0")
+    """NICE_TPU_SPOT_SEED is a TEST override; unset (the production
+    default) uses a random secret generated at process start."""
+    return os.environ.get("NICE_TPU_SPOT_SEED") or _RUNTIME_SPOT_SEED
 
 
 def spot_slice_len() -> int:
@@ -72,13 +89,23 @@ def submission_rng(submit_key: str) -> random.Random:
     return random.Random(f"{spot_seed()}:{submit_key}")
 
 
-def resolve_token(payload: dict, headers, username: str, user_ip: str) -> str:
+def resolve_token(
+    payload: dict, headers, username: str, user_ip: str, store=None,
+) -> str:
     """The client's trust identity, most-specific first: an explicit
     X-Client-Token header (server-issued anonymous tokens), the telemetry
-    client_id piggybacked on the payload, then username@ip."""
+    client_id piggybacked on the payload, then username@ip.
+
+    When a TrustStore is provided, a header token is honored only if the
+    server KNOWS it (a client_trust row exists — minted by POST /token or
+    earned by submission history). An unvalidated bearer string would let a
+    client reset every per-token control (claim caps, trust, rate buckets)
+    by inventing a fresh token per request."""
     token = headers.get("X-Client-Token") if headers is not None else None
     if token:
-        return str(token)[:256]
+        token = str(token)[:256]
+        if store is None or store.known(token):
+            return token
     tel = payload.get("telemetry") if isinstance(payload, dict) else None
     if isinstance(tel, dict) and tel.get("client_id"):
         return str(tel["client_id"])[:256]
@@ -117,9 +144,24 @@ class TrustStore:
         with self._lock:
             return self._cache.get(client_token)
 
+    def peek_known(self, client_token: str) -> bool:
+        """Cache-only known() (event-loop safe): False when the token is
+        unknown OR simply not cached yet. get()'s fabricated defaults are
+        cached too, so a probed-but-unregistered token stays False."""
+        row = self.peek(client_token)
+        return bool(row) and "first_seen" in row
+
     def update(self, row: dict) -> None:
         with self._lock:
             self._cache[row["client_token"]] = row
+
+    def known(self, client_token: str) -> bool:
+        """True when the token has a persisted trust row (minted by POST
+        /token or earned by submission history). The fabricated default
+        from get() carries no first_seen, so it never counts as known;
+        upserts refresh the cache through update(), clearing the negative
+        entry."""
+        return "first_seen" in self.get(client_token)
 
     def trust(self, client_token: str) -> float:
         return float(self.get(client_token).get("trust", 0.0))

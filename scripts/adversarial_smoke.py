@@ -71,6 +71,7 @@ SERVER_ENV = {
     "NICE_TPU_UNTRUSTED_LEASE_SECS": "1",
     "NICE_TPU_LEASE_SWEEP_SECS": "0.25",
     "NICE_TPU_UNTRUSTED_MAX_CLAIMS": "16",
+    "NICE_TPU_UNTRUSTED_MAX_CLAIMS_PER_IP": "256",
     "NICE_TPU_RATE_BUCKET": "200:60",
     "NICE_TPU_MAX_INFLIGHT": "1024",
     "NICE_TPU_SERVER_WORKERS": "16",
@@ -126,21 +127,36 @@ async def _req(conn: MiniConn, token: str, method: str, target: str,
     return None, None
 
 
+async def _mint_token(conn: MiniConn, fallback: str) -> str:
+    """Register a server-issued trust token (POST /token). The server only
+    honors tokens it minted — arbitrary bearer strings fall back to the
+    ip-keyed identity — so every persona registers one real token up front.
+    The static name is only a last resort against a dead server."""
+    try:
+        status, body = await conn.request("POST", "/token", None)
+    except OSError:
+        return fallback
+    if status == 200 and isinstance(body, dict) and body.get("client_token"):
+        return body["client_token"]
+    return fallback
+
+
 # -- personas ----------------------------------------------------------------
 
 
 async def _honest_client(cfg, stats: Stats, idx: int):
     """The load_harness honor-system loop, under a per-client trust token.
     Also the control group for the p99 and zero-429 assertions."""
-    token = f"honest-{idx}"
+    name = f"honest-{idx}"
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, name)
     try:
         for _ in range(cfg["rounds"]):
             t0 = time.monotonic()
             status, block = await _req(
                 conn, token, "POST", "/claim_block",
                 {"mode": "niceonly", "count": cfg["block_size"],
-                 "username": token},
+                 "username": name},
             )
             stats.claim_lat.append(time.monotonic() - t0)
             if status == 429:
@@ -149,7 +165,7 @@ async def _honest_client(cfg, stats: Stats, idx: int):
             if status != 200:
                 continue  # claim exhaustion near the end of the frontier
             subs = [
-                _submission(f["claim_id"], token) for f in block["fields"]
+                _submission(f["claim_id"], name) for f in block["fields"]
             ]
             stats.fields_claimed += len(subs)
             t0 = time.monotonic()
@@ -179,11 +195,13 @@ async def _forger(cfg, out: dict):
     """Result forger: fabricated niceonly numbers + a fabricated detailed
     distribution, all of which pass the accept-time shape checks."""
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, "forger")
+    out["forger_token"] = token
     forged = 0
     try:
         for _ in range(cfg["forgeries"]):
             status, field = await _req(
-                conn, "forger", "GET", "/claim/niceonly?username=forger"
+                conn, token, "GET", "/claim/niceonly?username=forger"
             )
             if status != 200:
                 continue
@@ -198,11 +216,11 @@ async def _forger(cfg, out: dict):
                     {"number": int(field["range_start"]), "num_uniques": BASE}
                 ],
             }
-            status, _ = await _req(conn, "forger", "POST", "/submit", payload)
+            status, _ = await _req(conn, token, "POST", "/submit", payload)
             forged += status == 200
         for _ in range(cfg["detailed_forgeries"]):
             status, field = await _req(
-                conn, "forger", "GET", "/claim/detailed?username=forger"
+                conn, token, "GET", "/claim/detailed?username=forger"
             )
             if status != 200:
                 continue
@@ -217,7 +235,7 @@ async def _forger(cfg, out: dict):
                 ],
                 "nice_numbers": [],
             }
-            status, _ = await _req(conn, "forger", "POST", "/submit", payload)
+            status, _ = await _req(conn, token, "POST", "/submit", payload)
             forged += status == 200
     finally:
         await conn.close()
@@ -229,12 +247,13 @@ async def _hoarder(cfg, out: dict):
     The outstanding-claims cap 429s further hoarding; the lease sweep
     re-issues everything it sat on."""
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, "hoarder")
     abandoned: list[str] = []
     capped = 0
     try:
         for _ in range(8):
             status, block = await _req(
-                conn, "hoarder", "POST", "/claim_block",
+                conn, token, "POST", "/claim_block",
                 {"mode": "niceonly", "count": 8, "username": "hoarder"},
             )
             if status == 429:
@@ -252,17 +271,18 @@ async def _replayer(cfg, out: dict):
     """Replays one accepted submission verbatim: every replay must answer
     {"duplicate": true} and mint no second row."""
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, "replayer")
     duplicates = 0
     try:
         status, field = await _req(
-            conn, "replayer", "GET", "/claim/niceonly?username=replayer"
+            conn, token, "GET", "/claim/niceonly?username=replayer"
         )
         if status == 200:
             sub = _submission(field["claim_id"], "replayer")
-            await _req(conn, "replayer", "POST", "/submit", sub)
+            await _req(conn, token, "POST", "/submit", sub)
             for _ in range(5):
                 status, resp = await _req(
-                    conn, "replayer", "POST", "/submit", sub
+                    conn, token, "POST", "/submit", sub
                 )
                 duplicates += bool(
                     status == 200 and isinstance(resp, dict)
@@ -277,11 +297,12 @@ async def _flooder(cfg, out: dict):
     """Rate flooder: a tight claim loop under one token. The per-client
     bucket 429s it without touching anyone else's budget."""
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, "flooder")
     limited = sent = 0
     try:
         for _ in range(cfg["flood_requests"]):
             status, _ = await _req(
-                conn, "flooder", "GET", "/claim/niceonly?username=flooder",
+                conn, token, "GET", "/claim/niceonly?username=flooder",
                 attempts=1,
             )
             sent += status is not None
@@ -311,6 +332,7 @@ async def _drain(cfg, db_path: str, deadline_secs: float = 90.0) -> int:
     """Complete every remaining field (re-issued abandons surface as their
     short leases expire). Returns fields left incomplete at the deadline."""
     conn = MiniConn(cfg["host"], cfg["port"])
+    token = await _mint_token(conn, "drain")
     deadline = time.monotonic() + deadline_secs
     try:
         while time.monotonic() < deadline:
@@ -318,7 +340,7 @@ async def _drain(cfg, db_path: str, deadline_secs: float = 90.0) -> int:
             if remaining == 0:
                 return 0
             status, block = await _req(
-                conn, "drain", "POST", "/claim_block",
+                conn, token, "POST", "/claim_block",
                 {"mode": "niceonly", "count": 12, "username": "drain"},
             )
             if status != 200:
@@ -330,7 +352,7 @@ async def _drain(cfg, db_path: str, deadline_secs: float = 90.0) -> int:
                 _submission(f["claim_id"], "drain") for f in block["fields"]
             ]
             await _req(
-                conn, "drain", "POST", "/submit_block",
+                conn, token, "POST", "/submit_block",
                 {"block_id": block["block_id"], "submissions": subs},
             )
         return _incomplete_fields(db_path)
@@ -388,7 +410,7 @@ def _exactly_once_violations(db_path: str) -> int:
         conn.close()
 
 
-def _forgery_audit(db_path: str) -> dict:
+def _forgery_audit(db_path: str, forger_token: str) -> dict:
     conn = sqlite3.connect(db_path)
     try:
         total, disq = conn.execute(
@@ -401,7 +423,8 @@ def _forgery_audit(db_path: str) -> dict:
         ).fetchone()[0]
         suspect = conn.execute(
             "SELECT COALESCE(MAX(suspect), 0) FROM client_trust"
-            " WHERE client_token = 'forger'"
+            " WHERE client_token = ?",
+            (forger_token,),
         ).fetchone()[0]
     finally:
         conn.close()
@@ -519,7 +542,11 @@ def run(
                 "exactly_once_violations": _exactly_once_violations(db_path),
             }
             if phase == "adversarial":
-                audits[phase].update(_forgery_audit(db_path))
+                audits[phase].update(
+                    _forgery_audit(
+                        db_path, phases[phase].get("forger_token", "forger")
+                    )
+                )
                 audits[phase].update(
                     _abandon_audit(
                         db_path, phases[phase].get("abandoned_fields", [])
@@ -562,8 +589,10 @@ def run(
                 digests["baseline"] == digests["adversarial"]
             ),
         }
-        # The raw abandoned range list is audit detail, not report material.
+        # The raw abandoned range list and the minted token are audit
+        # detail, not report material.
         adv.pop("abandoned_fields", None)
+        adv.pop("forger_token", None)
         return {
             "run": run_label,
             "base": BASE,

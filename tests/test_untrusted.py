@@ -19,8 +19,15 @@ import pytest
 from nice_tpu import CLIENT_VERSION
 from nice_tpu.client import api_client
 from nice_tpu.client.main import compile_results, process_field
-from nice_tpu.core import consensus
-from nice_tpu.core.types import DataToServer, FieldRecord, SearchMode
+from nice_tpu.core import consensus, distribution_stats, number_stats
+from nice_tpu.core.types import (
+    DataToServer,
+    FieldRecord,
+    NiceNumberSimple,
+    SearchMode,
+    SubmissionRecord,
+    UniquesDistributionSimple,
+)
 from nice_tpu.obs.series import (
     SERVER_CONSENSUS_HOLDS,
     SERVER_LEASES_EXPIRED,
@@ -104,6 +111,46 @@ def test_resolve_token_priority():
     assert trust.resolve_token({}, None, "", "") == "anon@unknown"
 
 
+def test_resolve_token_requires_server_known_token():
+    class _Store:
+        def known(self, token):
+            return token == "anon-minted"
+
+    headers = {"X-Client-Token": "anon-minted"}
+    payload = {"telemetry": {"client_id": "cli-123"}}
+    store = _Store()
+    # A server-minted token is honored as the trust identity...
+    assert (
+        trust.resolve_token(payload, headers, "u", "1.2.3.4", store=store)
+        == "anon-minted"
+    )
+    # ...but an invented bearer string is not: identity falls back to the
+    # telemetry client_id (then username@ip), so fresh tokens cannot reset
+    # per-client claim caps, rate buckets, or the trust ledger.
+    forged = {"X-Client-Token": "anon-i-made-this-up"}
+    assert (
+        trust.resolve_token(payload, forged, "u", "1.2.3.4", store=store)
+        == "cli-123"
+    )
+    assert (
+        trust.resolve_token({}, forged, "u", "1.2.3.4", store=store)
+        == "u@1.2.3.4"
+    )
+
+
+def test_spot_seed_is_secret_by_default(monkeypatch):
+    monkeypatch.delenv("NICE_TPU_SPOT_SEED", raising=False)
+    seed = trust.spot_seed()
+    # The submit key is client-chosen, so a predictable seed would make the
+    # sampled slice precomputable: unset, the seed is a per-process secret
+    # (stable within the process so replays stay deterministic).
+    assert seed == trust.spot_seed()
+    assert len(seed) == 32
+    assert seed != "0"
+    monkeypatch.setenv("NICE_TPU_SPOT_SEED", "7")
+    assert trust.spot_seed() == "7"  # explicit test override still wins
+
+
 def test_spot_check_catches_forged_niceonly(monkeypatch):
     # 69 is the only 100% nice number in base 10; a slice covering it must
     # find it in the claimed numbers.
@@ -147,6 +194,61 @@ def test_consensus_holds_lone_untrusted_submission():
     # Untrusted: the same lone submission is held at needs-consensus.
     canon, cl = consensus.evaluate_consensus(field, [lone], frozenset({11}))
     assert canon is None and cl == 1
+
+
+def _detailed_sub(sub_id, token, when):
+    return SubmissionRecord(
+        submission_id=sub_id,
+        claim_id=sub_id,
+        field_id=1,
+        search_mode=SearchMode.DETAILED,
+        submit_time=when,
+        elapsed_secs=1.0,
+        username=f"user{sub_id}",
+        user_ip="127.0.0.1",
+        client_version=CLIENT_VERSION,
+        disqualified=False,
+        distribution=distribution_stats.expand_distribution(
+            [
+                UniquesDistributionSimple(num_uniques=i, count=c)
+                for i, c in [(1, 50), (2, 50)]
+            ],
+            10,
+        ),
+        numbers=number_stats.expand_numbers(
+            [NiceNumberSimple(number=69, num_uniques=10)], 10
+        ),
+        client_token=token,
+    )
+
+
+def test_consensus_same_token_duplicates_do_not_corroborate():
+    field = FieldRecord(
+        field_id=1, base=10, chunk_id=None, range_start=47, range_end=100,
+        range_size=53, last_claim_time=None, canon_submission_id=None,
+        check_level=0, prioritize=False,
+    )
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    dup_a = _detailed_sub(11, "mallory", t0)
+    dup_b = _detailed_sub(12, "mallory", t0 + timedelta(seconds=5))
+    # One untrusted client re-claiming its own released field and
+    # re-submitting identical content is NOT corroboration: the winning
+    # group holds two rows but one distinct client, so the field stays at
+    # needs-consensus instead of promoting canon.
+    canon, cl = consensus.evaluate_consensus(
+        field, [dup_a, dup_b], frozenset({11, 12})
+    )
+    assert canon is None and cl == 1
+    # A second, independent untrusted client with agreeing content IS
+    # corroboration — and check_level counts distinct vouchers, not rows.
+    other = _detailed_sub(13, "ivan", t0 + timedelta(seconds=9))
+    canon, cl = consensus.evaluate_consensus(
+        field, [dup_a, dup_b, other], frozenset({11, 12, 13})
+    )
+    assert canon is dup_a and cl == 3
+    # Trusted-only groups keep the reference row-count semantics.
+    canon, cl = consensus.evaluate_consensus(field, [dup_a, dup_b])
+    assert canon is dup_a and cl == 3
 
 
 # -- end-to-end: forged results, trust ledger, requeue -----------------------
@@ -383,32 +485,57 @@ def test_lease_expiry_sweep_reissue_and_late_submit_conflict(
 # -- end-to-end: per-client rate limiting ------------------------------------
 
 
+def _mint_token(base_url):
+    req = urllib.request.Request(f"{base_url}/token", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())["client_token"]
+
+
+def _claim_with_token(base_url, token):
+    req = urllib.request.Request(
+        f"{base_url}/claim/niceonly?username=u",
+        headers={"X-Client-Token": token},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status
+
+
 def test_rate_limit_flood_gets_429_honest_token_unaffected(
     tmp_path, monkeypatch
 ):
     env = {"NICE_TPU_RATE_BUCKET": "3:0.5", "NICE_TPU_SPOT_SLICE": "0"}
     with _serve(tmp_path, monkeypatch, env) as (base_url, _):
-        def _claim(token):
-            req = urllib.request.Request(
-                f"{base_url}/claim/niceonly?username=u",
-                headers={"X-Client-Token": token},
-            )
-            with urllib.request.urlopen(req, timeout=10) as r:
-                return r.status
-
+        # Budgets are keyed ip|token for server-minted tokens only; mint
+        # both identities up front (minting itself spends from the shared
+        # bare-IP bucket).
+        flooder = _mint_token(base_url)
+        honest = _mint_token(base_url)
         for _ in range(3):
-            assert _claim("flooder") == 200
+            assert _claim_with_token(base_url, flooder) == 200
         with pytest.raises(urllib.error.HTTPError) as err:
-            _claim("flooder")
+            _claim_with_token(base_url, flooder)
         assert err.value.code == 429
         assert int(err.value.headers["Retry-After"]) >= 1
         body = json.loads(err.value.read())
         assert body["error"]["code"] == 429
-        # Budgets are per client token: an honest client is unaffected by
+        # Budgets are per minted token: an honest client is unaffected by
         # the flood, and read endpoints have their own (4x) bucket.
-        assert _claim("honest") == 200
+        assert _claim_with_token(base_url, honest) == 200
         with urllib.request.urlopen(f"{base_url}/status", timeout=10) as r:
             assert r.status == 200
+
+
+def test_rate_limit_unknown_tokens_share_the_ip_bucket(tmp_path, monkeypatch):
+    env = {"NICE_TPU_RATE_BUCKET": "3:0.5", "NICE_TPU_SPOT_SLICE": "0"}
+    with _serve(tmp_path, monkeypatch, env) as (base_url, _):
+        # Invented bearer strings are not separate limiter identities: they
+        # all drain the one bare-IP bucket, so cycling fresh tokens per
+        # request does not reset the limiter.
+        for i in range(3):
+            assert _claim_with_token(base_url, f"made-up-{i}") == 200
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _claim_with_token(base_url, "made-up-fresh")
+        assert err.value.code == 429
 
 
 def test_client_retry_honors_429_retry_after(tmp_path, monkeypatch):
@@ -427,20 +554,55 @@ def test_client_retry_honors_429_retry_after(tmp_path, monkeypatch):
 
 
 def test_anonymous_token_endpoint(tmp_path, monkeypatch):
-    with _serve(tmp_path, monkeypatch, {}) as (base_url, _):
-        req = urllib.request.Request(f"{base_url}/token", method="POST")
-        with urllib.request.urlopen(req, timeout=10) as r:
-            body = json.loads(r.read())
-        assert body["client_token"].startswith("anon-")
-        assert len(body["client_token"]) > 20
+    with _serve(tmp_path, monkeypatch, {}) as (base_url, db_path):
+        token = _mint_token(base_url)
+        assert token.startswith("anon-")
+        assert len(token) > 20
+        # Minting REGISTERS the token: a client_trust row exists, so the
+        # server honors it as an identity (resolve_token only accepts
+        # tokens it knows).
+        rows = _query(
+            db_path,
+            "SELECT trust, suspect FROM client_trust WHERE client_token = ?",
+            (token,),
+        )
+        assert len(rows) == 1 and rows[0]["suspect"] == 0
+
+
+def test_per_ip_claim_ceiling_across_identities(tmp_path, monkeypatch):
+    env = {
+        "NICE_TPU_TRUST_THRESHOLD": "5",
+        "NICE_TPU_UNTRUSTED_MAX_CLAIMS": "2",
+        "NICE_TPU_UNTRUSTED_MAX_CLAIMS_PER_IP": "3",
+        "NICE_TPU_SPOT_SLICE": "0",
+    }
+    with _serve(tmp_path, monkeypatch, env) as (base_url, _):
+        # Two minted identities, each under the per-client cap, from one
+        # address...
+        sybil_a = _mint_token(base_url)
+        sybil_b = _mint_token(base_url)
+        assert _claim_with_token(base_url, sybil_a) == 200
+        assert _claim_with_token(base_url, sybil_a) == 200
+        assert _claim_with_token(base_url, sybil_b) == 200
+        # ...reach the aggregate per-address ceiling: a THIRD fresh identity
+        # is refused even though its own outstanding-claim count is zero.
+        # Without the ceiling, minting identities would multiply the cap.
+        sybil_c = _mint_token(base_url)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _claim_with_token(base_url, sybil_c)
+        assert err.value.code == 429
+        assert "address" in json.loads(err.value.read())["error"]["message"]
 
 
 def test_release_orphaned_inventory_frees_dead_queue_stamps(tmp_path):
     """A SIGKILLed server's queue inventory is lease stamps with no claims
     rows; the startup sweep must free exactly those — fields actually issued
     to a client (claims row at the stamp) and long-running renewed claims
-    (old claim_time, live lease) stay leased."""
+    (old claim_time, live lease) stay leased. Renewed LEGACY claims (NULL
+    lease_expiry, pre-trust servers) stay leased while their claim_time is
+    inside the global expiry window, and are freed once it is not."""
     from nice_tpu.core.types import FieldClaimStrategy
+    from nice_tpu.server.db import now_utc, ts
 
     db = Db(str(tmp_path / "orphan.db"))
     try:
@@ -476,19 +638,62 @@ def test_release_orphaned_inventory_frees_dead_queue_stamps(tmp_path):
             )
         db.renew_claim(claim.claim_id)
 
+        # Renewed LEGACY long-runner: NULL lease_expiry (minted by a
+        # pre-trust server), claim_time pushed outside the 2s stamp window
+        # by a later renewal but still inside the global expiry window —
+        # this is a LIVE lease, not an orphan.
+        legacy = db.try_claim_field(
+            FieldClaimStrategy.NEXT, cutoff, 0, (1 << 128) - 1
+        )
+        legacy_claim = db.insert_claim(
+            legacy.field_id, SearchMode.NICEONLY, "1.2.3.4",
+            client_token="tok",
+        )
+        with db._lock, db._txn():
+            db._conn.execute(
+                "UPDATE claims SET claim_time = ? WHERE id = ?",
+                (
+                    ts(now_utc() - timedelta(seconds=60)),
+                    legacy_claim.claim_id,
+                ),
+            )
+        db.renew_claim(legacy_claim.claim_id)
+
+        # Renewed legacy claim whose claim_time fell OUT of the expiry
+        # window: truly expired, so its field is freed.
+        stale = db.try_claim_field(
+            FieldClaimStrategy.NEXT, cutoff, 0, (1 << 128) - 1
+        )
+        stale_claim = db.insert_claim(
+            stale.field_id, SearchMode.NICEONLY, "1.2.3.4",
+            client_token="tok",
+        )
+        with db._lock, db._txn():
+            db._conn.execute(
+                "UPDATE claims SET claim_time = ? WHERE id = ?",
+                ("2000-01-01T00:00:00.000000Z", stale_claim.claim_id),
+            )
+        db.renew_claim(stale_claim.claim_id)
+
         released = db.release_orphaned_inventory()
-        assert released == 2
+        assert released == 3
         rows = _query(
             db.path,
-            "SELECT id, last_claim_time FROM fields WHERE id IN (?,?,?,?)",
+            "SELECT id, last_claim_time FROM fields WHERE id IN"
+            " (?,?,?,?,?,?)",
             [f.field_id for f in inventory]
-            + [issued.field_id, renewed.field_id],
+            + [
+                issued.field_id, renewed.field_id, legacy.field_id,
+                stale.field_id,
+            ],
         )
         state = {r["id"]: r["last_claim_time"] for r in rows}
         for f in inventory:
             assert state[f.field_id] is None
         assert state[issued.field_id] is not None
         assert state[renewed.field_id] is not None
+        assert state[legacy.field_id] is not None
+        assert state[stale.field_id] is None
         # Idempotent: a second sweep finds nothing.
         assert db.release_orphaned_inventory() == 0
     finally:

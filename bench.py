@@ -264,6 +264,32 @@ def _span_delta(before: dict, after: dict) -> dict:
     return out
 
 
+def _stepprof_sums() -> dict:
+    """Snapshot of the device-step profiler's cumulative phase table
+    (empty unless NICE_TPU_STEPPROF=1 — see nice_tpu/obs/stepprof.py)."""
+    from nice_tpu.obs import stepprof
+
+    return stepprof.cumulative()
+
+
+def _stepprof_delta(before: dict, after: dict) -> dict:
+    """Per-(mode|base|backend) phase-seconds delta between two snapshots —
+    the same windowing idiom as _span_delta, over the profiler table."""
+    out = {}
+    for key, cur in after.items():
+        prev = before.get(key, {})
+        fields = int(cur.get("fields", 0)) - int(prev.get("fields", 0))
+        if not fields:
+            continue
+        d = {
+            k: round(float(v) - float(prev.get(k, 0.0)), 6)
+            for k, v in cur.items() if k != "fields"
+        }
+        d["fields"] = fields
+        out[key] = d
+    return out
+
+
 def _init_jax(remaining):
     """Import jax and force backend init, retrying on transient failure.
 
@@ -548,6 +574,7 @@ def main() -> int:
     headline = None
     wedged = False
     suite_spans0 = _span_sums()
+    suite_prof0 = _stepprof_sums()
     _phase("suite", "begin", modes=[f"{k}/{m}" for m, k in suite],
            n_chips=n_chips, backend=jax.default_backend())
     for idx, (mode, kind) in enumerate(suite):
@@ -593,10 +620,14 @@ def main() -> int:
             _phase(f"mode.{kind}.{mode}", "begin", batch=batch,
                    cap_secs=round(cap, 1), reserved_secs=round(reserve, 1))
             spans_before = _span_sums()
+            prof_before = _stepprof_sums()
             line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
             mode_spans = _span_delta(spans_before, _span_sums())
             if mode_spans:
                 line["spans"] = mode_spans
+            mode_prof = _stepprof_delta(prof_before, _stepprof_sums())
+            if mode_prof:
+                line["phase_breakdown"] = mode_prof
             _phase(
                 f"mode.{kind}.{mode}",
                 "error" if ("error" in line or wedged) else "end",
@@ -639,6 +670,9 @@ def main() -> int:
     # stats spans + any server/client spans that ran in-process): the driver
     # artifact carries not just the throughput but where the wall went.
     headline["span_summary"] = _span_delta(suite_spans0, _span_sums())
+    suite_prof = _stepprof_delta(suite_prof0, _stepprof_sums())
+    if suite_prof:
+        headline["phase_breakdown"] = suite_prof
     _phase("suite", "end", budget_used_secs=round(budget - remaining(), 1))
     print(json.dumps(headline), flush=True)
     return 1 if any("error" in r for r in results.values()) else 0

@@ -903,6 +903,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     obs.maybe_serve_metrics()
     # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR).
     obs.flight.install()
+    # Time-series history sampler behind the same local port's GET /history
+    # (NICE_TPU_HISTORY_SECS; 0 disables).
+    obs.history.maybe_start_sampler()
     if args.threads > 0:
         # The native backend sizes its pools from NICE_THREADS (engine
         # _native_threads); the flag is the CLI face of the same knob

@@ -18,6 +18,13 @@ Five pieces, all stdlib-only at import time:
   ``/debug/flight``.
 - ``telemetry``: condenses this process's registry into the compact
   per-client snapshot the server aggregates fleet-wide.
+- ``history``: ring-buffer time-series recorder over the registry (raw /
+  1m / 15m downsampling tiers) behind ``GET /history``.
+- ``stepprof``: the device-step profiler bucketing each field's wall time
+  into compile / h2d_feed / device_compute / fold / readback / host_other
+  (NICE_TPU_STEPPROF=1; off = zero extra device syncs).
+- ``slo``: declarative SLOs with multi-window burn-rate alert states
+  (ok / warn / page) evaluated over the history.
 
 Env vars: NICE_TPU_METRICS_PORT (serve /metrics locally; 0 = ephemeral
 port, exported as nice_metrics_bound_port), NICE_TPU_TRACE (span sink:
@@ -26,7 +33,7 @@ sinks), NICE_TPU_PROFILE (jax profiler output dir), NICE_TPU_FLIGHT_DIR /
 NICE_TPU_FLIGHT_EVENTS (flight-recorder dump dir / ring capacity).
 """
 
-from . import flight, series, telemetry  # noqa: F401 — importing pre-seeds
+from . import flight, history, series, slo, stepprof, telemetry  # noqa: F401 — importing pre-seeds
 from .metrics import (  # noqa: F401
     REGISTRY,
     Counter,
@@ -64,6 +71,9 @@ __all__ = [
     "render",
     "series",
     "flight",
+    "history",
+    "slo",
+    "stepprof",
     "telemetry",
     "serve_metrics",
     "maybe_serve_metrics",

@@ -62,7 +62,11 @@ FLIGHT_DUMPS = metrics.counter(
 # shows the series at zero (registry convention, see obs/series.py).
 _KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint", "restore",
                 "downgrade", "spool", "quarantine", "submit", "claim",
-                "crash", "telemetry")
+                "crash", "telemetry",
+                # elastic mesh + trust state transitions (PR 8 / PR 9 sites)
+                # and SLO alerting — a post-crash dump must explain them.
+                "mesh_reshard", "device_loss", "spot_check_fail",
+                "trust_slash", "consensus_hold", "slo_transition")
 for _k in _KNOWN_KINDS:
     FLIGHT_EVENTS.labels(_k)
 for _r in ("crash", "sigusr2", "quarantine", "manual"):

@@ -1,0 +1,412 @@
+"""Time-series history: ring-buffer recorder over the metrics registry.
+
+Point-in-time scrapes (`/metrics`, `/status`) answer "what is happening";
+this module answers "what happened" — the backbone the reference builds its
+whole public story on (cached search-rate/distribution history tables behind
+a static site, PAPER.md L5). Everything is stdlib-only and bounded:
+
+* ``TieredSeries`` — one metric series' history in three fixed-capacity
+  downsampling tiers: ``raw`` (every sample), ``1m`` (60 s buckets) and
+  ``15m`` (900 s buckets). Coarse tiers keep (bucket_ts, mean, min, max,
+  last, n) and are finalized on bucket rollover; queries also include the
+  in-progress bucket so short runs still produce multi-tier data.
+* ``HistoryStore`` — {series name -> TieredSeries}, fed by
+  ``sample_registries()`` which walks one or more metrics registries every
+  ``NICE_TPU_HISTORY_SECS`` (default 15): counters/gauges become one series
+  per label combination plus an aggregate sum; histograms become
+  ``_sum``/``_count`` aggregates plus *windowed* p50/p95/p99 series derived
+  from bucket-count deltas between consecutive samples (so the quantiles
+  describe the last interval, not the process lifetime).
+* ``handle_query()`` — the shared ``GET /history`` implementation used by
+  both the server app and the client metrics port (obs/serve.py): JSON
+  bodies, real JSON 404s for unknown series, and a directory listing when
+  no ``series`` is given.
+
+The server additionally persists finalized points through the writer actor
+into the ``metric_history`` table (``HistoryStore.drain_rows()`` +
+``Db.insert_metric_history``); the in-memory store stays the source for
+``/history`` reads so the hot read path never touches SQLite.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+import urllib.parse
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import metrics as metrics_mod
+
+__all__ = [
+    "TieredSeries",
+    "HistoryStore",
+    "STORE",
+    "handle_query",
+    "maybe_start_sampler",
+    "sample_interval_secs",
+]
+
+TIERS = ("raw", "1m", "15m")
+
+# Per-tier point capacities: ~1 h of raw at 15 s, ~6 h of 1-min, ~7 d of
+# 15-min. All three are small fixed rings — a process that runs forever
+# holds a bounded history.
+RAW_CAP = int(os.environ.get("NICE_TPU_HISTORY_RAW_CAP", "240"))
+TIER1_CAP = int(os.environ.get("NICE_TPU_HISTORY_1M_CAP", "360"))
+TIER2_CAP = int(os.environ.get("NICE_TPU_HISTORY_15M_CAP", "672"))
+
+QUANTILES = ((50, 0.50), (95, 0.95), (99, 0.99))
+
+# Cap on un-drained persistence rows (client-side stores are never drained).
+_PENDING_CAP = 4096
+
+
+def sample_interval_secs() -> float:
+    """The sampling cadence knob (0 disables the background sampler)."""
+    try:
+        return float(os.environ.get("NICE_TPU_HISTORY_SECS", "15"))
+    except ValueError:
+        return 15.0
+
+
+def _tier_secs() -> Tuple[float, float]:
+    """Coarse-tier bucket widths; env-scalable so short harness runs (the
+    perf gate) can exercise real bucket rollover in seconds."""
+    try:
+        t1 = float(os.environ.get("NICE_TPU_HISTORY_1M_SECS", "60"))
+    except ValueError:
+        t1 = 60.0
+    try:
+        t2 = float(os.environ.get("NICE_TPU_HISTORY_15M_SECS", "900"))
+    except ValueError:
+        t2 = 900.0
+    return max(t1, 1e-6), max(t2, 1e-6)
+
+
+class _CoarseTier:
+    """One downsampling tier: an in-progress aggregate bucket plus a ring of
+    finalized (bucket_ts, mean, min, max, last, n) points."""
+
+    __slots__ = ("secs", "points", "cur_ts", "sum", "min", "max", "last", "n")
+
+    def __init__(self, secs: float, cap: int):
+        self.secs = secs
+        self.points: collections.deque = collections.deque(maxlen=cap)
+        self.cur_ts: Optional[float] = None
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self.last = 0.0
+        self.n = 0
+
+    def _bucket(self, ts: float) -> float:
+        return ts - (ts % self.secs)
+
+    def add(self, ts: float, value: float):
+        """Fold a sample in; returns the finalized point on rollover."""
+        b = self._bucket(ts)
+        done = None
+        if self.cur_ts is not None and b != self.cur_ts:
+            done = self._finalize()
+        if self.cur_ts is None:
+            self.cur_ts = b
+            self.sum = self.min = self.max = self.last = value
+            self.n = 1
+        else:
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+            self.last = value
+            self.n += 1
+        return done
+
+    def _finalize(self):
+        pt = (self.cur_ts, self.sum / self.n, self.min, self.max,
+              self.last, self.n)
+        self.points.append(pt)
+        self.cur_ts = None
+        self.n = 0
+        return pt
+
+    def snapshot(self, since: float) -> List[list]:
+        out = [list(p) for p in self.points if p[0] >= since]
+        if self.n > 0 and self.cur_ts is not None and self.cur_ts >= since:
+            out.append([self.cur_ts, self.sum / self.n, self.min, self.max,
+                        self.last, self.n])
+        return out
+
+
+class TieredSeries:
+    """One series' raw ring + 1m/15m downsampling tiers. Not thread-safe on
+    its own — HistoryStore serializes access."""
+
+    __slots__ = ("raw", "t1", "t2", "last_ts")
+
+    def __init__(self, tier1_secs: float, tier2_secs: float):
+        self.raw: collections.deque = collections.deque(maxlen=RAW_CAP)
+        self.t1 = _CoarseTier(tier1_secs, TIER1_CAP)
+        self.t2 = _CoarseTier(tier2_secs, TIER2_CAP)
+        self.last_ts = 0.0
+
+    def add(self, ts: float, value: float):
+        """Record one sample; returns [(tier, point), ...] finalized now."""
+        self.raw.append((ts, value))
+        self.last_ts = ts
+        done = []
+        p1 = self.t1.add(ts, value)
+        if p1 is not None:
+            done.append(("1m", p1))
+        p2 = self.t2.add(ts, value)
+        if p2 is not None:
+            done.append(("15m", p2))
+        return done
+
+    def snapshot(self, since: float, tiers: Sequence[str]) -> Dict[str, list]:
+        out: Dict[str, list] = {}
+        if "raw" in tiers:
+            out["raw"] = [[t, v] for t, v in self.raw if t >= since]
+        if "1m" in tiers:
+            out["1m"] = self.t1.snapshot(since)
+        if "15m" in tiers:
+            out["15m"] = self.t2.snapshot(since)
+        return out
+
+
+def _series_key(name: str, labelnames, key) -> str:
+    if not key:
+        return name
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(labelnames, key))
+    return f"{name}{{{inner}}}"
+
+
+def _quantile_from_deltas(bounds, deltas, overflow, q):
+    """Linear-interpolated quantile from non-cumulative bucket deltas. The
+    overflow (+Inf) bucket clamps to the highest finite bound."""
+    total = sum(deltas) + overflow
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for b, d in zip(bounds, deltas):
+        if d > 0:
+            if cum + d >= rank:
+                frac = (rank - cum) / d
+                return lo + (b - lo) * frac
+            cum += d
+        lo = b
+    return bounds[-1] if bounds else 0.0
+
+
+class HistoryStore:
+    """Bounded in-memory history for every sampled series.
+
+    One instance per process role: the module-global ``STORE`` backs the
+    client metrics port; the server builds its own over both the global
+    registry and its private API-latency registry.
+    """
+
+    def __init__(self, tier1_secs: Optional[float] = None,
+                 tier2_secs: Optional[float] = None):
+        t1, t2 = _tier_secs()
+        self._t1 = tier1_secs if tier1_secs is not None else t1
+        self._t2 = tier2_secs if tier2_secs is not None else t2
+        self._lock = threading.Lock()
+        self._series: Dict[str, TieredSeries] = {}
+        # Previous histogram bucket snapshots, for windowed quantiles.
+        self._hist_prev: Dict[str, Tuple[Tuple[int, ...], float, int]] = {}
+        # Rows appended since the last drain_rows(): (series, tier, ts,
+        # value, vmin, vmax, n). Bounded so never-drained stores can't leak.
+        self._pending: collections.deque = collections.deque(
+            maxlen=_PENDING_CAP
+        )
+        self.samples_taken = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def add(self, series: str, value: float, ts: Optional[float] = None):
+        ts = time.time() if ts is None else ts
+        value = float(value)
+        with self._lock:
+            s = self._series.get(series)
+            if s is None:
+                s = self._series[series] = TieredSeries(self._t1, self._t2)
+            finalized = s.add(ts, value)
+            self._pending.append(
+                (series, "raw", ts, value, value, value, 1)
+            )
+            for tier, (bts, mean, vmin, vmax, _last, n) in finalized:
+                self._pending.append(
+                    (series, tier, bts, mean, vmin, vmax, n)
+                )
+
+    def sample_registries(self, registries, ts: Optional[float] = None) -> int:
+        """Walk every metric in the given registries and record one sample
+        per derived series. Returns the number of points recorded."""
+        ts = time.time() if ts is None else ts
+        n = 0
+        for reg in registries:
+            for name, m in sorted(reg.metrics().items()):
+                if isinstance(m, metrics_mod.Histogram):
+                    n += self._sample_histogram(name, m, ts)
+                elif isinstance(m, (metrics_mod.Counter, metrics_mod.Gauge)):
+                    values = m.values()
+                    for key, v in values.items():
+                        self.add(_series_key(name, m.labelnames, key), v, ts)
+                        n += 1
+                    if m.labelnames and len(values) > 1:
+                        self.add(name, sum(values.values()), ts)
+                        n += 1
+        self.samples_taken += 1
+        return n
+
+    def _sample_histogram(self, name, m, ts) -> int:
+        n = 0
+        snap = m.bucket_counts()
+        agg_sum = 0.0
+        agg_count = 0
+        for key, (counts, total, count) in snap.items():
+            agg_sum += total
+            agg_count += count
+            skey = _series_key("", m.labelnames, key)  # "{...}" or ""
+            prev = self._hist_prev.get(name + skey)
+            self._hist_prev[name + skey] = (counts, total, count)
+            if prev is None:
+                continue
+            pc, _ps, pn = prev
+            deltas = [c - p for c, p in zip(counts, pc)]
+            overflow = (count - sum(counts)) - (pn - sum(pc))
+            if count - pn <= 0:
+                continue  # nothing observed this window
+            for pname, q in QUANTILES:
+                qv = _quantile_from_deltas(m.buckets, deltas, overflow, q)
+                if qv is not None:
+                    self.add(f"{name}_p{pname}{skey}", qv, ts)
+                    n += 1
+        self.add(f"{name}_sum", agg_sum, ts)
+        self.add(f"{name}_count", agg_count, ts)
+        return n + 2
+
+    # -- reading -----------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def query(self, series: str, since: float = 0.0,
+              tiers: Sequence[str] = TIERS) -> Optional[Dict[str, list]]:
+        with self._lock:
+            s = self._series.get(series)
+            if s is None:
+                return None
+            return s.snapshot(since, tiers)
+
+    def drain_rows(self) -> List[tuple]:
+        """Rows appended since the last drain — the server's writer-actor
+        periodic persists these into metric_history."""
+        with self._lock:
+            rows = list(self._pending)
+            self._pending.clear()
+            return rows
+
+
+STORE = HistoryStore()
+
+_sampler_lock = threading.Lock()
+_sampler_started = False
+
+
+def maybe_start_sampler(registries=None, store: Optional[HistoryStore] = None,
+                        interval: Optional[float] = None) -> bool:
+    """Start the background sampling thread once per process (client side;
+    the server samples on the writer actor's periodic instead). Returns
+    True when the sampler is running. ``NICE_TPU_HISTORY_SECS=0`` disables."""
+    global _sampler_started
+    secs = sample_interval_secs() if interval is None else interval
+    if not secs or secs <= 0:
+        return False
+    with _sampler_lock:
+        if _sampler_started:
+            return True
+        _sampler_started = True
+    regs = registries if registries is not None else [metrics_mod.REGISTRY]
+    st = store if store is not None else STORE
+
+    def _run():
+        while True:
+            time.sleep(secs)
+            try:
+                st.sample_registries(regs)
+            except Exception:  # noqa: BLE001 — sampling must never crash
+                pass
+
+    threading.Thread(target=_run, name="nice-history", daemon=True).start()
+    return True
+
+
+# -- shared GET /history handler ------------------------------------------
+
+
+def _split_series_list(raw: str) -> List[str]:
+    """Split a comma-separated series list WITHOUT breaking label sets:
+    ``a{x="1",y="2"},b`` is two names — commas inside ``{...}`` belong to
+    the name itself."""
+    out, cur, depth = [], [], 0
+    for ch in raw:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return [s for s in (x.strip() for x in out) if s]
+
+
+def handle_query(store: HistoryStore, query_string: str):
+    """Shared ``GET /history`` implementation: returns (status, body-dict).
+
+    ``?series=a,b`` selects series (exact names, URL-encoded; commas inside
+    ``{...}`` label sets are part of the name); ``?since=TS``
+    filters points at-or-after a Unix timestamp; ``?tier=raw|1m|15m`` limits
+    tiers. No ``series`` returns the directory of known names. Unknown
+    series get a real 404 JSON body naming a sample of known series.
+    """
+    qs = urllib.parse.parse_qs(query_string or "")
+    wanted = []
+    for part in qs.get("series", []):
+        wanted.extend(_split_series_list(part))
+    if not wanted:
+        names = store.series_names()
+        return 200, {"series": names, "count": len(names)}
+    try:
+        since = float(qs.get("since", ["0"])[0])
+    except ValueError:
+        return 400, {"error": "since must be a unix timestamp"}
+    tiers: Sequence[str] = TIERS
+    if "tier" in qs:
+        tiers = tuple(t for t in qs["tier"][0].split(",") if t in TIERS)
+        if not tiers:
+            return 400, {"error": f"tier must be one of {list(TIERS)}"}
+    out: Dict[str, Dict[str, list]] = {}
+    missing = []
+    for name in wanted:
+        snap = store.query(name, since=since, tiers=tiers)
+        if snap is None:
+            missing.append(name)
+        else:
+            out[name] = snap
+    if missing:
+        known = store.series_names()
+        return 404, {
+            "error": f"unknown series: {', '.join(missing)}",
+            "unknown": missing,
+            "known_sample": known[:50],
+            "known_count": len(known),
+        }
+    return 200, {"series": out, "since": since, "tiers": list(tiers)}

@@ -247,6 +247,17 @@ class Histogram(_Metric):
         with self._lock:
             return {k: (st.sum, st.count) for k, st in self._states.items()}
 
+    def bucket_counts(self) -> Dict[LabelKey, Tuple[Tuple[int, ...], float, int]]:
+        """Per-label-combination (per-bucket NON-cumulative counts, sum,
+        count) snapshot. The history sampler diffs consecutive snapshots to
+        derive windowed quantiles (obs/history.py); ``self.buckets`` gives
+        the matching finite upper bounds, with overflow = count - sum(counts)."""
+        with self._lock:
+            return {
+                k: (tuple(st.counts), st.sum, st.count)
+                for k, st in self._states.items()
+            }
+
     def render(self) -> Iterable[str]:
         with self._lock:
             items = sorted(
@@ -329,6 +340,12 @@ class Registry:
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def metrics(self) -> Dict[str, _Metric]:
+        """Point-in-time copy of {name: metric} for iteration without holding
+        the registry lock (the history sampler walks every series)."""
+        with self._lock:
+            return dict(self._metrics)
 
     def render(self) -> str:
         with self._lock:

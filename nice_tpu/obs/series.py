@@ -376,6 +376,35 @@ SERVER_TELEMETRY_REPORTS = metrics.counter(
     labelnames=("source",),
 )
 
+# --- performance observatory (obs/history.py, stepprof.py, slo.py) -------
+STEPPROF_PHASE_SECONDS = metrics.histogram(
+    "nice_stepprof_phase_seconds",
+    "Per-field phase-attributed wall time from the device-step profiler "
+    "(NICE_TPU_STEPPROF=1): compile / h2d_feed / device_compute / fold / "
+    "readback / host_other, by mode, base and backend.",
+    labelnames=("mode", "base", "backend", "phase"),
+    buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 60.0),
+)
+SLO_STATE = metrics.gauge(
+    "nice_slo_state",
+    "Burn-rate alert state per SLO (0 = ok, 1 = warn, 2 = page).",
+    labelnames=("slo",),
+)
+SLO_TRANSITIONS = metrics.counter(
+    "nice_slo_transitions_total",
+    "SLO alert state transitions, by SLO and entered state.",
+    labelnames=("slo", "state"),
+)
+HISTORY_SAMPLES = metrics.counter(
+    "nice_history_samples_total",
+    "History sampler ticks (each tick records one point per derived "
+    "series into the ring-buffer history).",
+)
+HISTORY_PERSISTED_ROWS = metrics.counter(
+    "nice_history_persisted_rows_total",
+    "metric_history rows persisted through the writer actor.",
+)
+
 # --- local metrics endpoint (obs/serve.py) -------------------------------
 METRICS_BOUND_PORT = metrics.gauge(
     "nice_metrics_bound_port",
@@ -454,3 +483,6 @@ for _outcome in ("delivered", "rejected", "deferred"):
     SPOOL_REPLAYS.labels(_outcome)
 for _from, _to in (("pallas", "jnp"), ("jnp", "scalar")):
     ENGINE_BACKEND_DOWNGRADES.labels(_from, _to)
+for _slo in ("claim_p99", "submit_success", "feed_idle_p95",
+             "spot_check_fail"):
+    SLO_STATE.labels(_slo)

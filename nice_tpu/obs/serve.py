@@ -1,13 +1,15 @@
-"""Local /metrics + /debug/flight HTTP endpoint for processes that aren't
-the API server.
+"""Local /metrics + /debug/flight + /history HTTP endpoint for processes
+that aren't the API server.
 
 The client and daemon run hot loops with no HTTP surface of their own; a
 tiny stdlib ThreadingHTTPServer on a localhost port makes their registry
-scrapeable and their flight-recorder ring inspectable without signalling
-the process. Opt-in via NICE_TPU_METRICS_PORT — port 0 binds an ephemeral
-port so client+daemon on one host never collide; the actually-bound port is
-logged and exported as the ``nice_metrics_bound_port`` gauge (scrape the
-daemon, learn where its clients live). Unknown paths get a real 404.
+scrapeable, their flight-recorder ring inspectable, and their sampled
+time-series history queryable without signalling the process. Opt-in via
+NICE_TPU_METRICS_PORT — port 0 binds an ephemeral port so client+daemon on
+one host never collide; the actually-bound port is logged and exported as
+the ``nice_metrics_bound_port`` gauge (scrape the daemon, learn where its
+clients live). Unknown paths — and unknown history series — get a real
+``application/json`` 404 body, not the stdlib HTML error page.
 """
 
 from __future__ import annotations
@@ -19,7 +21,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from . import flight, metrics, series
+from . import flight, history, metrics, series
 
 log = logging.getLogger("nice_tpu.obs")
 
@@ -29,7 +31,8 @@ _started: Optional[ThreadingHTTPServer] = None
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        status = 200
         if path in ("/metrics", "/"):
             body = metrics.render().encode("utf-8")
             ctype = "text/plain; version=0.0.4"
@@ -44,10 +47,20 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 default=repr,
             ).encode("utf-8")
             ctype = "application/json"
+        elif path == "/history":
+            status, payload = history.handle_query(history.STORE, query)
+            body = json.dumps(payload, default=repr).encode("utf-8")
+            ctype = "application/json"
         else:
-            self.send_error(404)
-            return
-        self.send_response(200)
+            status = 404
+            body = json.dumps(
+                {
+                    "error": f"unknown path {path!r}",
+                    "known": ["/metrics", "/debug/flight", "/history"],
+                }
+            ).encode("utf-8")
+            ctype = "application/json"
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -1,0 +1,264 @@
+"""Device-step profiler: phase-attributed wall time per engine batch loop.
+
+Buckets each field's wall time into
+``{compile, h2d_feed, device_compute, fold, readback, host_other}`` so a
+slow field is attributable to a specific phase (the kernel-benchmarking
+discipline of "FastKernels" / the TPU blocking analysis in "Large Scale
+Distributed Linear Algebra With TPUs" — PAPERS.md).
+
+Design constraints, in order:
+
+1. **Zero hot-path overhead when off.** ``NICE_TPU_STEPPROF=0`` (the
+   default) means: no new ``block_until_ready`` fences, no per-batch
+   timestamps beyond what the engine already takes, and the per-batch guard
+   is a single attribute check (``prof.enabled``). The module-level
+   ``fence_count()`` counter proves it — tests assert it stays 0 for a
+   disabled run.
+2. **Fences only at existing boundaries.** With the profiler on, the one
+   new sync is a post-dispatch ``block_until_ready`` that separates
+   ``device_compute`` from the host-side loop; ``fold``/``readback`` are
+   timed around the collector's *existing* device->host transfers.
+   Attribution caveat (documented, accepted): dispatch is async under jit,
+   so with the profiler off nothing changes; with it on, the pipeline
+   serializes slightly — which is why the gate report A/Bs both settings.
+3. **Cross-thread attribution.** The dispatch loop and the collector run in
+   different threads; a profiler instance is handed into the collector
+   closure explicitly and ``add()`` is lock-guarded. Compile time is
+   attributed through a thread-local "current profiler" stack so
+   ``ops/compile_cache.py`` can report ``build()`` durations without a
+   direct dependency on the engine.
+
+Per-(mode, base, backend) phase totals are emitted into the
+``nice_stepprof_phase_seconds`` histogram series on ``finish()``, kept in
+``LAST_BREAKDOWN`` (most recent field) and a cumulative table that
+``obs/telemetry.py`` folds into ``DataToServer.telemetry`` and ``bench.py``
+diffs per mode.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "PHASES",
+    "StepProfiler",
+    "enabled",
+    "fence_count",
+    "note_compile",
+    "cumulative",
+    "reset",
+    "LAST_BREAKDOWN",
+]
+
+PHASES = (
+    "compile",        # executable build()s (compile_cache misses)
+    "h2d_feed",       # waiting on the host->device feed (_SliceFeed.get)
+    "device_compute", # dispatch enqueue + on-device execution (fenced)
+    "fold",           # device->host accumulator folds (stats transfers)
+    "readback",       # scalar/near-miss readbacks + survivor extraction
+    "host_other",     # wall - sum(above): host loop, slicing, bookkeeping
+)
+
+_state_lock = threading.Lock()
+_fence_count = 0
+_cumulative: Dict[str, Dict[str, float]] = {}
+LAST_BREAKDOWN: Dict[str, object] = {}
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """Read the knob at call time (not import) so tests/bench can flip it."""
+    return os.environ.get("NICE_TPU_STEPPROF", "0").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
+def fence_count() -> int:
+    """Total profiler-inserted device fences this process. Stays 0 whenever
+    the profiler is disabled — the no-extra-syncs guarantee, testable."""
+    return _fence_count
+
+
+def reset() -> None:
+    """Clear cumulative state (tests / bench A-B runs)."""
+    global _fence_count
+    with _state_lock:
+        _fence_count = 0
+        _cumulative.clear()
+        LAST_BREAKDOWN.clear()
+
+
+def cumulative() -> Dict[str, Dict[str, float]]:
+    """Copy of {"mode|b<base>|backend": {phase: secs, "wall": secs,
+    "fields": n}} accumulated since process start (or reset())."""
+    with _state_lock:
+        return {k: dict(v) for k, v in _cumulative.items()}
+
+
+def _current() -> Optional["StepProfiler"]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def note_compile(secs: float) -> None:
+    """Called by compile_cache around build(): attribute compile time to the
+    dispatch thread's active profiler, if any."""
+    prof = _current()
+    if prof is not None and prof.enabled:
+        prof.add("compile", secs)
+
+
+class StepProfiler:
+    """Per-field phase accumulator. Construct one per engine field pass;
+    engine hot loops guard every hook with ``if prof.enabled`` so the
+    disabled path costs one attribute load."""
+
+    __slots__ = ("mode", "base", "backend", "enabled", "_buckets", "_lock",
+                 "_t_start", "_finished")
+
+    def __init__(self, mode: str, base: int, backend: str,
+                 enabled_override: Optional[bool] = None):
+        self.mode = mode
+        self.base = int(base)
+        self.backend = backend
+        self.enabled = enabled() if enabled_override is None else bool(
+            enabled_override
+        )
+        self._buckets = {p: 0.0 for p in PHASES} if self.enabled else None
+        self._lock = threading.Lock() if self.enabled else None
+        self._t_start = time.perf_counter() if self.enabled else 0.0
+        self._finished = False
+
+    # -- hooks -------------------------------------------------------------
+
+    def add(self, phase: str, secs: float) -> None:
+        if not self.enabled or secs <= 0:
+            return
+        with self._lock:
+            self._buckets[phase] += secs
+
+    def fence(self, x) -> None:
+        """block_until_ready(x), counted — ONLY when profiling. The disabled
+        path returns before touching the device."""
+        global _fence_count
+        if not self.enabled or x is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            import jax
+
+            jax.block_until_ready(x)
+        except Exception:  # noqa: BLE001 — non-device values pass through
+            pass
+        with _state_lock:
+            _fence_count += 1
+        self.add("device_compute", time.perf_counter() - t0)
+
+    class _Span:
+        __slots__ = ("prof", "phase", "t0")
+
+        def __init__(self, prof, phase):
+            self.prof = prof
+            self.phase = phase
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.prof.add(self.phase, time.perf_counter() - self.t0)
+
+    def measure(self, phase: str):
+        """Context manager for non-hot-path phases. Hot loops should take
+        explicit timestamps behind ``if prof.enabled`` instead."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return StepProfiler._Span(self, phase)
+
+    def __enter__(self) -> "StepProfiler":
+        if self.enabled:
+            stack = getattr(_tls, "stack", None)
+            if stack is None:
+                stack = _tls.stack = []
+            stack.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.enabled:
+            stack = getattr(_tls, "stack", None)
+            if stack and stack[-1] is self:
+                stack.pop()
+            self.finish()
+
+    def start(self) -> "StepProfiler":
+        """``__enter__`` alias for flows with multiple exit points (the
+        engine loops); pair with ``stop()`` before every return/raise."""
+        return self.__enter__()
+
+    def stop(self) -> None:
+        """``__exit__`` alias: pop the thread-local stack and finish()."""
+        self.__exit__(None, None, None)
+
+    # -- reporting ---------------------------------------------------------
+
+    def breakdown(self) -> Optional[Dict[str, float]]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            return dict(self._buckets)
+
+    def finish(self, wall_secs: Optional[float] = None) -> Optional[dict]:
+        """Close the field: derive host_other = wall - sum(phases), emit the
+        phase histogram series, and fold into the cumulative table."""
+        if not self.enabled or self._finished:
+            return None
+        self._finished = True
+        wall = (
+            wall_secs if wall_secs is not None
+            else time.perf_counter() - self._t_start
+        )
+        with self._lock:
+            b = dict(self._buckets)
+        accounted = sum(v for p, v in b.items() if p != "host_other")
+        b["host_other"] = max(0.0, wall - accounted)
+        from .series import STEPPROF_PHASE_SECONDS
+
+        for phase, secs in b.items():
+            if secs > 0:
+                STEPPROF_PHASE_SECONDS.labels(
+                    self.mode, str(self.base), self.backend, phase
+                ).observe(secs)
+        key = f"{self.mode}|b{self.base}|{self.backend}"
+        entry = dict(b)
+        entry["wall"] = wall
+        with _state_lock:
+            cum = _cumulative.setdefault(
+                key, {p: 0.0 for p in PHASES} | {"wall": 0.0, "fields": 0}
+            )
+            for p in PHASES:
+                cum[p] += b[p]
+            cum["wall"] += wall
+            cum["fields"] += 1
+            LAST_BREAKDOWN.clear()
+            LAST_BREAKDOWN.update(
+                {"key": key, "mode": self.mode, "base": self.base,
+                 "backend": self.backend, **entry}
+            )
+        return entry
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()

@@ -80,7 +80,17 @@ def snapshot(
         "feed_idle_sum": round(sum(s for s, _ in idle.values()), 6),
         "feed_idle_count": int(sum(c for _, c in idle.values())),
     }
-    return {
+    # Device-step profiler phase attribution (NICE_TPU_STEPPROF=1): the
+    # cumulative per-(mode|base|backend) bucket table, empty — and omitted
+    # from the wire — when the profiler never ran.
+    from . import stepprof
+
+    phase_breakdown = {
+        key: {k: round(v, 6) if isinstance(v, float) else v
+              for k, v in entry.items()}
+        for key, entry in stepprof.cumulative().items()
+    }
+    out = {
         "v": SNAPSHOT_VERSION,
         "client_id": client_id(username),
         "username": username,
@@ -97,3 +107,6 @@ def snapshot(
         "spool_depth": int(spool_depth),
         "mesh": mesh,
     }
+    if phase_breakdown:
+        out["phase_breakdown"] = phase_breakdown
+    return out

@@ -146,18 +146,29 @@ def choose(mode: str, base: int, backend: str, param: str, default: int) -> int:
 
 
 def record(mode: str, base: int, backend: str, new_params: dict,
-           throughput: float | None = None, swept: list | None = None) -> Path:
+           throughput: float | None = None, swept: list | None = None,
+           phase_breakdown: dict | None = None) -> Path:
     """Persist a winner (atomic tmp+rename; concurrent writers last-wins at
-    whole-file granularity, which is fine for a tuning table)."""
+    whole-file granularity, which is fine for a tuning table).
+
+    phase_breakdown: optional stepprof phase->secs dict captured while the
+    winner was measured (NICE_TPU_STEPPROF=1), stored alongside throughput
+    so a later regression can be attributed to a phase, not just a total."""
     path = winners_path()
     path.parent.mkdir(parents=True, exist_ok=True)
     table = dict(_load())
-    table[key(mode, base, backend)] = {
+    entry = {
         "params": {k: int(v) for k, v in new_params.items()},
         "signature": signature(base),
         "throughput": throughput,
         "swept": swept or [],
     }
+    if phase_breakdown:
+        entry["phase_breakdown"] = {
+            k: round(float(v), 6) for k, v in phase_breakdown.items()
+            if isinstance(v, (int, float))
+        }
+    table[key(mode, base, backend)] = entry
     fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
@@ -232,5 +243,8 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
              ("batch_size", "block_rows", "carry_interval", "numbers_per_sec")}
             for r in results
         ],
+        # The harness subprocess reports a stepprof breakdown when it ran
+        # with NICE_TPU_STEPPROF=1; absent otherwise.
+        phase_breakdown=best.get("phase_breakdown"),
     )
     return new_params

@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
+from nice_tpu.obs import stepprof
 from nice_tpu.obs.series import COMPILE_CACHE_EVENTS
 
 _lock = threading.Lock()
@@ -95,7 +97,9 @@ def executable(key, build):
     if ex is not None:
         COMPILE_CACHE_EVENTS.labels("executable", "hit").inc()
         return ex
+    t0 = time.perf_counter()
     ex = build()
+    stepprof.note_compile(time.perf_counter() - t0)
     with _lock:
         prior = _executables.get(key)
         if prior is None:

@@ -27,6 +27,7 @@ import numpy as np
 
 from nice_tpu import faults
 from nice_tpu import obs
+from nice_tpu.obs import stepprof
 from nice_tpu.core import base_range
 from nice_tpu.core.types import (
     FieldResults,
@@ -1830,6 +1831,10 @@ def _process_range_detailed(
     plan = get_plan(base)
     backend = _pick_backend(plan, batch_size, backend)
     compile_cache.setup()
+    # Device-step profiler (NICE_TPU_STEPPROF=1): started here so AOT
+    # compile_cache builds below attribute to this field via the
+    # thread-local stack; stop() pairs with every exit after the collector.
+    prof = stepprof.StepProfiler("detailed", base, backend).start()
     hist = np.zeros(plan.base + 2, dtype=np.int64)
     nice_numbers: list[NiceNumberSimple] = []
     for sub in slivers:
@@ -1979,9 +1984,13 @@ def _process_range_detailed(
             # to its remaining-set is already folded into hist/nice_numbers.
             (rem,) = payload
             checkpoint_cb(_ckpt_state(rem))
-        ENGINE_BATCH_KERNEL_SECONDS.labels("detailed").observe(
-            _time.monotonic() - t0
-        )
+        dt = _time.monotonic() - t0
+        if prof.enabled:  # collector thread; add() is lock-guarded
+            if kind == "nm":
+                prof.add("readback", dt)
+            elif kind in ("stats", "stats_host"):
+                prof.add("fold", dt)
+        ENGINE_BATCH_KERNEL_SECONDS.labels("detailed").observe(dt)
 
     # Collection (the near-miss readback + rare-path re-scan) runs on its
     # own thread: each readback pays the device->host RTT (~68 ms through
@@ -2004,6 +2013,7 @@ def _process_range_detailed(
     reshards = 0
     reshard_secs = 0.0
     idle_gaps: list[float] = []
+    prof_on = prof.enabled  # hoisted: the disabled per-batch cost is a load
     err_final = None  # (exception, remaining segments or None)
     with _Collector(collect_item, DISPATCH_WINDOW, "detailed-collect",
                     occupancy=ENGINE_DISPATCH_OCCUPANCY) as collector:
@@ -2026,11 +2036,14 @@ def _process_range_detailed(
                     while True:
                         if collector.failed():
                             break
+                        t_feed = _time.monotonic() if prof_on else 0.0
                         item = feed.get()
                         if item is None:
                             segments = []
                             break
                         now = _time.monotonic()
+                        if prof_on:
+                            prof.add("h2d_feed", now - t_feed)
                         if t_prev is not None:
                             gap = now - t_prev
                             MESH_FEED_IDLE.labels("detailed").observe(gap)
@@ -2048,7 +2061,19 @@ def _process_range_detailed(
                                 _fire_mesh_fault(
                                     n_batch, n_dev, item.segs[0][0]
                                 )
+                            t_disp = _time.monotonic() if prof_on else 0.0
                             acc, nm = dispatch(acc, item)
+                            if prof_on:
+                                # Enqueue + jit tracing cost of the call
+                                # itself, then the only profiler-added device
+                                # sync: fence the step so on-device execution
+                                # separates from the host loop. Off = no
+                                # fence at all.
+                                prof.add(
+                                    "device_compute",
+                                    _time.monotonic() - t_disp,
+                                )
+                                prof.fence(nm)
                         except Exception as e:  # noqa: BLE001 — boundary
                             failure = e
                             break
@@ -2087,6 +2112,12 @@ def _process_range_detailed(
                 survivors = None
                 if mesh is not None and _elastic_enabled():
                     survivors, reason = _diagnose_survivors(mesh, failure)
+                    obs.flight.record(
+                        "device_loss", mode="detailed", base=base,
+                        survivors=len(survivors) if survivors else 0,
+                        reason=reason if survivors else "fatal",
+                        error=repr(failure)[:200],
+                    )
                 if not survivors:
                     err_final = (failure, rem)
                     break
@@ -2146,6 +2177,7 @@ def _process_range_detailed(
                 collector.put(("stats", acc, fold_np))
     _record_feed_stats("detailed", idle_gaps, n_batch, n_dev0, n_dev,
                        reshards, reshard_secs, feed_depth)
+    prof.stop()  # collector drained: fold/readback attribution is complete
     if err_final is not None:
         err, rem = err_final
         # The collector has drained: hist/nice_numbers now cover every batch
@@ -2406,6 +2438,9 @@ def _process_range_niceonly(
         return FieldResults(distribution=(), nice_numbers=tuple(nice_numbers))
 
     compile_cache.setup()
+    # Device-step profiler — same shape as the detailed path; the dense
+    # loop's MSD host filter lands in host_other by construction.
+    prof = stepprof.StepProfiler("niceonly", base, backend).start()
     mesh = _mesh_or_none()
     if mesh is not None:
         from nice_tpu.parallel import mesh as pmesh
@@ -2480,9 +2515,10 @@ def _process_range_niceonly(
         else:  # "ckpt": by now every batch before the marker is folded.
             (rem,) = payload
             checkpoint_cb(_ckpt_state(rem))
-        ENGINE_BATCH_KERNEL_SECONDS.labels("dense").observe(
-            time.monotonic() - t0
-        )
+        dt = time.monotonic() - t0
+        if prof.enabled and kind == "count":
+            prof.add("readback", dt)
+        ENGINE_BATCH_KERNEL_SECONDS.labels("dense").observe(dt)
 
     # Same adaptive host-filter floor as the strided device path: the dense
     # device scan is cheap per lane, so a fine (250) floor would be
@@ -2522,6 +2558,7 @@ def _process_range_niceonly(
     reshards = 0
     reshard_secs = 0.0
     idle_gaps: list[float] = []
+    prof_on = prof.enabled
     # The count readback (+ rare-path extraction behind a hit) runs on the
     # shared _Collector like every other path; only the collector touches
     # nice_numbers. Pod layer: per-slice queues, threaded feed, elastic
@@ -2548,11 +2585,14 @@ def _process_range_niceonly(
                     while True:
                         if collector.failed():
                             break
+                        t_feed = time.monotonic() if prof_on else 0.0
                         item = feed.get()
                         if item is None:
                             segments = []
                             break
                         now = time.monotonic()
+                        if prof_on:
+                            prof.add("h2d_feed", now - t_feed)
                         if t_prev is not None:
                             gap = now - t_prev
                             MESH_FEED_IDLE.labels("niceonly").observe(gap)
@@ -2566,7 +2606,14 @@ def _process_range_niceonly(
                                 _fire_mesh_fault(
                                     n_batch, n_dev, item.segs[0][0]
                                 )
+                            t_disp = time.monotonic() if prof_on else 0.0
                             counts = dispatch(item)
+                            if prof_on:
+                                prof.add(
+                                    "device_compute",
+                                    time.monotonic() - t_disp,
+                                )
+                                prof.fence(counts)
                         except Exception as e:  # noqa: BLE001 — boundary
                             failure = e
                             break
@@ -2593,6 +2640,12 @@ def _process_range_niceonly(
                 survivors = None
                 if mesh is not None and _elastic_enabled():
                     survivors, reason = _diagnose_survivors(mesh, failure)
+                    obs.flight.record(
+                        "device_loss", mode="niceonly", base=base,
+                        survivors=len(survivors) if survivors else 0,
+                        reason=reason if survivors else "fatal",
+                        error=repr(failure)[:200],
+                    )
                 if not survivors:
                     err_final = (failure, rem)
                     break
@@ -2626,6 +2679,7 @@ def _process_range_niceonly(
                 )
     _record_feed_stats("niceonly", idle_gaps, n_batch, n_dev0, n_dev,
                        reshards, reshard_secs, feed_depth)
+    prof.stop()
     if err_final is not None:
         err, rem = err_final
         # The collector has drained: nice_numbers holds every hit outside

@@ -57,6 +57,8 @@ from nice_tpu.obs.series import (
     FLEET_RESTORES,
     FLEET_SPOOL_DEPTH,
     SERVER_BLOCK_LEASE_SIZE,
+    HISTORY_PERSISTED_ROWS,
+    HISTORY_SAMPLES,
     SERVER_CONSENSUS_HOLDS,
     SERVER_DUPLICATE_SUBMITS,
     SERVER_FIELD_ELAPSED,
@@ -206,6 +208,43 @@ class ApiContext:
         )
         self._status_cache: dict = {}
         self._status_cache_lock = threading.Lock()
+        # Performance observatory: one writer-actor periodic samples every
+        # nice_* series (process-global registry + this context's private
+        # API-latency registry) into the in-memory ring history, persists
+        # the new points into metric_history, evaluates SLO burn rates and
+        # occasionally prunes retention. /history reads serve from the ring
+        # — they never touch SQLite. NICE_TPU_HISTORY_SECS=0 disables.
+        self.history = obs.history.HistoryStore()
+        self.slo = obs.slo.SloEngine(self.history)
+        self.history_retention_secs = float(
+            os.environ.get("NICE_TPU_HISTORY_RETENTION_SECS",
+                           7 * 24 * 3600.0)
+        )
+        self._last_history_prune = time.monotonic()
+        history_secs = obs.history.sample_interval_secs()
+        if history_secs > 0:
+            self.writer.add_periodic(self.history_tick, history_secs)
+
+    def history_tick(self) -> None:
+        """One observatory beat. Runs on the writer thread between batches
+        (its own transaction; exceptions are logged, never fatal). Tests
+        with a DirectWriter call this directly to advance history."""
+        self.history.sample_registries(
+            [obs.REGISTRY, self.metrics.registry]
+        )
+        HISTORY_SAMPLES.inc()
+        rows = self.history.drain_rows()
+        if rows:
+            HISTORY_PERSISTED_ROWS.inc(self.db.insert_metric_history(rows))
+        self.slo.evaluate()
+        now = time.monotonic()
+        if self.history_retention_secs > 0 and (
+            now - self._last_history_prune >= 600.0
+        ):
+            self._last_history_prune = now
+            self.db.prune_metric_history(
+                time.time() - self.history_retention_secs
+            )
 
     def write(self, fn, *args, **kwargs):
         """Run one mutation through the writer actor, blocking for its
@@ -775,6 +814,10 @@ def _streaming_consensus(ctx: ApiContext, field_id: int) -> None:
         )
     else:
         SERVER_CONSENSUS_HOLDS.inc()
+        obs.flight.record(
+            "consensus_hold", field=field_id, cl=field.check_level,
+            submissions=len(subs), untrusted=len(untrusted_ids),
+        )
 
 
 def _post_accept_trust(
@@ -794,6 +837,10 @@ def _post_accept_trust(
     )
     if verdict == "fail":
         SERVER_TRUST_SLASHES.inc()
+        obs.flight.record(
+            "trust_slash", client=prep.client_token,
+            submission=submission_id, field=prep.field.field_id,
+        )
 
         def slash_op():
             row = ctx.db.upsert_client_trust(
@@ -1149,7 +1196,7 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root", "token"}
+     "root", "token", "history"}
 )
 
 _CORS_HEADERS = {
@@ -1365,8 +1412,19 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                         ctx.queue.detailed_thin_queue_size(),
                     "writer_queue_depth": ctx.writer.queue_depth(),
                     "fleet": ctx.cached_fleet_block(),
+                    "slo": ctx.slo.last(),
                 },
             )
+        if method == "GET" and path == "/history":
+            h_status, h_body = obs.history.handle_query(
+                ctx.history, parsed.query
+            )
+            if h_status >= 400:
+                # Bypass ApiError so the JSON body keeps its known-series
+                # sample (satellite: real 404 bodies for unknown series).
+                status = h_status
+                return _json_response(h_status, h_body)
+            return _json_response(200, h_body)
         if method == "GET" and path == "/debug/flight":
             return _json_response(
                 200,

@@ -1418,6 +1418,79 @@ class Db:
             "slowest_in_flight": slowest,
         }
 
+    # -- performance-observatory history (obs/history.py) ------------------
+    # Durable mirror of the in-memory ring store, written in batches by the
+    # writer actor's history periodic. /history reads stay in-memory; these
+    # tables exist for post-restart analysis and ROADMAP item 5's
+    # incremental analytics.
+
+    def insert_metric_history(self, rows: list[tuple]) -> int:
+        """Batch-persist (series, tier, ts, value, vmin, vmax, n) rows in
+        one transaction. INSERT OR REPLACE: a re-sampled bucket (in-progress
+        coarse tier finalized later) updates in place."""
+        if not rows:
+            return 0
+        packed = [
+            (str(s)[:512], str(t), float(at), float(v), float(mn),
+             float(mx), int(n))
+            for s, t, at, v, mn, mx, n in rows
+        ]
+        with self._lock, self._txn():
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO metric_history"
+                " (series, tier, ts, value, vmin, vmax, n)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+                packed,
+            )
+        return len(packed)
+
+    def get_metric_history(
+        self,
+        series: str,
+        since: float = 0.0,
+        tier: Optional[str] = None,
+        limit: int = 5000,
+    ) -> list[dict]:
+        """Persisted points for one series, ascending by time."""
+        sql = (
+            "SELECT series, tier, ts, value, vmin, vmax, n"
+            " FROM metric_history WHERE series = ? AND ts >= ?"
+        )
+        params: list = [str(series), float(since)]
+        if tier is not None:
+            sql += " AND tier = ?"
+            params.append(str(tier))
+        sql += " ORDER BY ts ASC LIMIT ?"
+        params.append(int(limit))
+        with self._read_conn() as conn:
+            rows = conn.execute(sql, params).fetchall()
+        return [
+            {
+                "series": r["series"], "tier": r["tier"],
+                "ts": float(r["ts"]), "value": float(r["value"]),
+                "vmin": float(r["vmin"]), "vmax": float(r["vmax"]),
+                "n": int(r["n"]),
+            }
+            for r in rows
+        ]
+
+    def get_metric_history_series(self) -> list[str]:
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT DISTINCT series FROM metric_history ORDER BY series"
+            ).fetchall()
+        return [r["series"] for r in rows]
+
+    def prune_metric_history(self, cutoff_ts: float) -> int:
+        """Drop points older than cutoff (retention sweep; returns rows
+        deleted)."""
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "DELETE FROM metric_history WHERE ts < ?",
+                (float(cutoff_ts),),
+            )
+            return cur.rowcount
+
     def get_recent_field_elapsed(self, limit: int = 200) -> list[float]:
         """elapsed_secs of the most recent submissions (for the fleet p50/p95
         field-latency gauges)."""

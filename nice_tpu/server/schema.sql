@@ -156,6 +156,25 @@ CREATE INDEX IF NOT EXISTS idx_client_telemetry_last_seen
 -- claim profile (micro-fields + short leases below NICE_TPU_TRUST_THRESHOLD)
 -- and the rate-limit bucket multiplier. NOT exposed via /query — tokens act
 -- as bearer credentials.
+-- Performance-observatory history: downsampled samples of every nice_*
+-- series, persisted through the writer actor from the in-memory ring
+-- (obs/history.py). tier is 'raw' | '1m' | '15m'; coarse tiers carry the
+-- bucket aggregate (value = mean) while raw rows have vmin = vmax = value,
+-- n = 1. Pruned by retention sweep (NICE_TPU_HISTORY_RETENTION_SECS).
+-- This is the historical-tables backbone ROADMAP item 5 reads from.
+CREATE TABLE IF NOT EXISTS metric_history (
+    series          TEXT NOT NULL,
+    tier            TEXT NOT NULL,
+    ts              REAL NOT NULL,                 -- unix seconds
+    value           REAL NOT NULL,                 -- sample / bucket mean
+    vmin            REAL NOT NULL,
+    vmax            REAL NOT NULL,
+    n               INTEGER NOT NULL DEFAULT 1,
+    PRIMARY KEY (series, tier, ts)
+);
+
+CREATE INDEX IF NOT EXISTS idx_metric_history_ts ON metric_history(ts);
+
 CREATE TABLE IF NOT EXISTS client_trust (
     client_token    TEXT PRIMARY KEY,
     trust           REAL NOT NULL DEFAULT 0,

@@ -1,0 +1,504 @@
+#!/usr/bin/env python
+"""Performance-observatory run + regression gate.
+
+One short REAL run that exercises the whole observatory stack and writes
+an ``OBSERVATORY_rNN.json`` evidence report:
+
+  history     in-process server (port 0), driven /claim + /submit traffic,
+              manual history ticks with shrunken tier widths so a ~12 s
+              run rolls raw -> 1m -> 15m buckets; multi-tier payloads are
+              read back over GET /history and persisted rows counted in
+              the metric_history table.
+  slo         the claim-latency SLO threshold is forced to 0 via its env
+              override, so real traffic breaches it (ok -> page); the
+              threshold is then restored operator-style to exercise the
+              recovery transition (-> ok).
+  stepprof    A/B engine runs: NICE_TPU_STEPPROF=0 (asserting ZERO
+              profiler fences) vs =1 (per-(mode|base|backend) phase
+              breakdown whose bucket sum must reconcile with measured
+              wall time within 10%), plus a hot-path overhead estimate.
+  regression  a fresh short ``bench.py`` suite diffed against the newest
+              committed BENCH_r*.json from the SAME backend (TPU baselines
+              are never compared against CPU CI runs), and a small
+              ``load_harness`` run diffed against LOAD_r01.json latency.
+              A >25% throughput drop or latency growth is a warning.
+
+Exit code is 0 unless --strict is given AND a gate check failed (the CI
+step runs warn-only initially, per the rollout plan).
+
+Usage:
+    python scripts/perf_gate.py --out OBSERVATORY_r01.json
+    python scripts/perf_gate.py --strict            # fail CI on regression
+    python scripts/perf_gate.py --skip-load --skip-bench   # observatory only
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# Observatory knobs for the short run — set BEFORE nice_tpu imports so the
+# server context picks them up: manual ticks (no sampler thread), 2 s "1m"
+# and 10 s "15m" buckets so every tier finalizes inside the run, and a
+# claim-latency SLO threshold of zero so real traffic breaches it.
+GATE_ENV = {
+    "NICE_TPU_HISTORY_SECS": "3600",
+    "NICE_TPU_HISTORY_1M_SECS": "2",
+    "NICE_TPU_HISTORY_15M_SECS": "10",
+    "NICE_TPU_SLO_CLAIM_P99_THRESHOLD": "0.0",
+}
+for _k, _v in GATE_ENV.items():
+    os.environ[_k] = _v
+
+REGRESSION_TOLERANCE = 0.25  # >25% worse than baseline = warn/fail
+
+# Ticks at 0.5 s for 12.5 s: ~6 finalized 2 s buckets and at least one
+# finalized 10 s bucket on every continuously-sampled series.
+TICK_SECS = 0.5
+TICKS = 25
+
+
+def _get_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _post_json(url: str, body: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _submission(claim_id: int, username: str, client_version: str) -> dict:
+    """The real client's submit_id derivation (claim id + content hash)."""
+    payload = {
+        "claim_id": claim_id,
+        "username": username,
+        "client_version": client_version,
+        "unique_distribution": None,
+        "nice_numbers": [],
+    }
+    content = json.dumps(payload, sort_keys=True).encode()
+    payload["submit_id"] = (
+        f"{claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return payload
+
+
+# -- section 1: history + SLO against a live server -------------------------
+
+
+def run_observatory(report: dict, problems: list) -> None:
+    from nice_tpu import CLIENT_VERSION, obs
+    from nice_tpu.server import app as server_app
+    from nice_tpu.server.db import Db
+
+    with tempfile.TemporaryDirectory(prefix="perf-gate-") as workdir:
+        db_path = os.path.join(workdir, "gate.db")
+        db = Db(db_path)
+        # ~100 claimable fields: enough for every driving round to claim.
+        db.seed_base(30, field_size=5_000_000)
+        db.close()
+        srv = server_app.serve(db_path, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        ctx = srv.context
+        base_url = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            _drive_and_tick(report, problems, base_url, ctx, CLIENT_VERSION)
+            _check_history(report, problems, base_url, ctx)
+            _check_slo(report, problems, base_url, ctx, obs)
+        finally:
+            srv.shutdown()
+
+
+def _drive_and_tick(report, problems, base_url, ctx, client_version):
+    """Real claim/submit/status traffic interleaved with history ticks."""
+    t0 = time.monotonic()
+    claims = submits = 0
+    for i in range(TICKS):
+        try:
+            got = _get_json(f"{base_url}/claim/niceonly?username=gate-{i}")
+            claims += 1
+            sub = _submission(got["claim_id"], f"gate-{i}", client_version)
+            _post_json(f"{base_url}/submit", sub)
+            submits += 1
+        except urllib.error.HTTPError:
+            pass  # seeded fields can run out near the end; ticks continue
+        _get_json(f"{base_url}/status")
+        ctx.history_tick()
+        time.sleep(TICK_SECS)
+    report["history"]["traffic"] = {
+        "claims": claims,
+        "submits": submits,
+        "ticks": TICKS,
+        "drive_secs": round(time.monotonic() - t0, 3),
+    }
+    if claims < 5:
+        problems.append(f"only {claims} claims succeeded while driving")
+
+
+def _check_history(report, problems, base_url, ctx):
+    directory = _get_json(f"{base_url}/history")
+    names = directory["series"]
+    report["history"]["series_count"] = directory["count"]
+
+    # Multi-tier evidence: every continuously sampled series must have
+    # raw + 1m points, and the run is long enough for 15m buckets too.
+    multi, sample = 0, {}
+    for name in names:
+        q = urllib.parse.quote(name)
+        body = _get_json(f"{base_url}/history?series={q}")
+        tiers = body["series"][name]
+        counts = {t: len(p) for t, p in tiers.items()}
+        if counts.get("raw", 0) >= 2 and counts.get("1m", 0) >= 2:
+            multi += 1
+            if len(sample) < 5:
+                sample[name] = tiers
+    report["history"]["multi_tier_series"] = multi
+    report["history"]["tier_point_counts"] = {
+        n: {t: len(p) for t, p in tiers.items()} for n, tiers in sample.items()
+    }
+    report["history"]["sample_payload"] = sample
+    if multi < 5:
+        problems.append(
+            f"only {multi} series have multi-tier history (need >= 5)"
+        )
+
+    persisted = ctx.db.get_metric_history_series()
+    report["history"]["persisted_series"] = len(persisted)
+    if not persisted:
+        problems.append("history ticks persisted no metric_history rows")
+
+    # The 404 contract the fleet UI and progress_charts rely on.
+    try:
+        _get_json(f"{base_url}/history?series=definitely_not_a_series")
+        problems.append("/history returned 200 for an unknown series")
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        report["history"]["unknown_series_404"] = (
+            e.code == 404 and body.get("unknown") == ["definitely_not_a_series"]
+        )
+        if not report["history"]["unknown_series_404"]:
+            problems.append("/history unknown-series 404 contract broken")
+
+
+def _check_slo(report, problems, base_url, ctx, obs):
+    """The forced-threshold breach must have paged; restoring the threshold
+    must recover to ok — both transitions on real traffic."""
+    states = {s["slo"]: s for s in ctx.slo.last()}
+    claim = states.get("claim_p99")
+    transitions_at_breach = ctx.slo.transitions
+    report["slo"]["breach"] = claim
+    if not claim or claim["state"] == "ok":
+        problems.append(
+            "forced claim_p99 threshold breach did not leave ok "
+            f"(state={claim and claim['state']})"
+        )
+
+    # Operator-style recovery: restore a sane threshold and re-evaluate.
+    for spec in ctx.slo.specs:
+        if spec.name == "claim_p99":
+            spec.threshold = 1e9
+    recovered = {s["slo"]: s for s in ctx.slo.evaluate()}["claim_p99"]
+    report["slo"]["recovered"] = recovered
+    report["slo"]["transitions"] = ctx.slo.transitions
+    if recovered["state"] != "ok":
+        problems.append("claim_p99 did not recover to ok after restore")
+    if ctx.slo.transitions < 2:
+        problems.append(
+            f"expected >= 2 SLO transitions, saw {ctx.slo.transitions}"
+        )
+
+    status = _get_json(f"{base_url}/status")
+    report["slo"]["status_block"] = status.get("slo")
+    if not status.get("slo"):
+        problems.append("/status is missing the slo block")
+
+    events = [
+        e for e in obs.flight.snapshot()
+        if e.get("kind") == "slo_transition"
+    ]
+    report["slo"]["flight_transition_events"] = len(events)
+
+
+# -- section 2: device-step profiler A/B ------------------------------------
+
+
+def run_stepprof(report: dict, problems: list, reps: int) -> None:
+    import jax
+
+    from nice_tpu.core.base_range import get_base_range
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.obs import stepprof
+    from nice_tpu.ops import engine
+
+    os.environ["NICE_TPU_HOST_NICEONLY_MAX"] = "0"  # keep niceonly on-device
+    report["stepprof"]["backend"] = jax.default_backend()
+    base = 30
+    start, _ = get_base_range(base)
+    field = FieldSize(start, start + 400_000)
+
+    def one_detailed():
+        t0 = time.monotonic()
+        engine.process_range_detailed(field, base, batch_size=1 << 12)
+        return time.monotonic() - t0
+
+    # Warm the compile caches once so A/B walls compare steady-state.
+    os.environ["NICE_TPU_STEPPROF"] = "0"
+    one_detailed()
+
+    stepprof.reset()
+    off_walls = [one_detailed() for _ in range(reps)]
+    report["stepprof"]["profiler_off"] = {
+        "walls_secs": [round(w, 4) for w in off_walls],
+        "mean_secs": round(statistics.mean(off_walls), 4),
+        "fences": stepprof.fence_count(),
+        "cumulative_keys": sorted(stepprof.cumulative()),
+    }
+    if stepprof.fence_count() != 0:
+        problems.append(
+            f"NICE_TPU_STEPPROF=0 still issued {stepprof.fence_count()} fences"
+        )
+
+    os.environ["NICE_TPU_STEPPROF"] = "1"
+    stepprof.reset()
+    on_walls = [one_detailed() for _ in range(reps)]
+    engine.process_range_niceonly(field, base, batch_size=1 << 12)
+    cum = stepprof.cumulative()
+    report["stepprof"]["profiler_on"] = {
+        "walls_secs": [round(w, 4) for w in on_walls],
+        "mean_secs": round(statistics.mean(on_walls), 4),
+        "fences": stepprof.fence_count(),
+        "phase_breakdown": cum,
+    }
+    os.environ["NICE_TPU_STEPPROF"] = "0"
+
+    modes = {k.split("|", 1)[0] for k in cum}
+    if not {"detailed", "niceonly"} <= modes:
+        problems.append(f"phase breakdown missing a mode: {sorted(modes)}")
+    for key, entry in cum.items():
+        bucket_sum = sum(entry[p] for p in stepprof.PHASES)
+        ok = abs(bucket_sum - entry["wall"]) <= 0.10 * entry["wall"]
+        report["stepprof"].setdefault("reconciliation", {})[key] = {
+            "bucket_sum_secs": round(bucket_sum, 4),
+            "wall_secs": round(entry["wall"], 4),
+            "within_10pct": ok,
+        }
+        if not ok:
+            problems.append(
+                f"stepprof buckets for {key} sum to {bucket_sum:.3f}s "
+                f"vs wall {entry['wall']:.3f}s (>10% apart)"
+            )
+
+    off_mean, on_mean = statistics.mean(off_walls), statistics.mean(on_walls)
+    overhead = (on_mean - off_mean) / off_mean if off_mean else 0.0
+    report["stepprof"]["overhead_frac_on_vs_off"] = round(overhead, 4)
+
+
+# -- section 3: regression gate vs committed baselines ----------------------
+
+
+def _baseline_platform(bench: dict) -> str:
+    cmd = bench.get("cmd", "")
+    if "NICE_BENCH_PLATFORM=cpu" in cmd:
+        return "cpu"
+    if "NICE_BENCH_PLATFORM=" in cmd:
+        return cmd.split("NICE_BENCH_PLATFORM=", 1)[1].split()[0]
+    return "tpu"  # unannotated committed runs were TPU-lease runs
+
+
+def _latest_bench_baseline(platform: str):
+    """Newest committed BENCH_r*.json with a parseable suite from the SAME
+    backend — cross-backend diffs (TPU baseline vs CPU CI) are meaningless."""
+    for path in sorted(glob.glob(str(ROOT / "BENCH_r*.json")), reverse=True):
+        try:
+            bench = json.loads(Path(path).read_text())
+        except (OSError, ValueError):
+            continue
+        suite = (bench.get("parsed") or {}).get("suite")
+        if not suite or _baseline_platform(bench) != platform:
+            continue
+        return os.path.basename(path), suite
+    return None, None
+
+
+def run_bench_gate(report: dict, problems: list, budget: int) -> None:
+    import jax
+
+    platform = jax.default_backend()
+    baseline_name, baseline = _latest_bench_baseline(platform)
+    gate = report["regression"]["bench"] = {
+        "platform": platform,
+        "baseline": baseline_name,
+    }
+    if baseline is None:
+        gate["note"] = (
+            f"no committed BENCH_r*.json from backend {platform!r}; "
+            "throughput diff skipped"
+        )
+        return
+
+    env = dict(
+        os.environ,
+        NICE_BENCH_PLATFORM=platform,
+        NICE_BENCH_SUITE="default:detailed,msd-ineffective:niceonly",
+        NICE_BENCH_BUDGET=str(budget),
+    )
+    env.pop("NICE_BENCH_T0", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=budget * 4,
+    )
+    suite = None
+    for line in reversed(proc.stdout.splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "suite" in parsed:
+            suite = parsed["suite"]
+            break
+    if proc.returncode != 0 or suite is None:
+        problems.append(
+            f"gate bench run failed (rc={proc.returncode}); "
+            f"tail: {proc.stdout[-300:]!r}"
+        )
+        gate["error"] = f"rc={proc.returncode}"
+        return
+
+    gate["fresh_suite"] = suite
+    gate["cases"] = {}
+    for case, new in suite.items():
+        old = baseline.get(case)
+        if not old or old.get("skipped") or new.get("skipped"):
+            continue
+        old_v, new_v = float(old["value"]), float(new["value"])
+        drop = (old_v - new_v) / old_v if old_v else 0.0
+        regressed = drop > REGRESSION_TOLERANCE
+        gate["cases"][case] = {
+            "baseline": old_v,
+            "current": new_v,
+            "drop_frac": round(drop, 4),
+            "regressed": regressed,
+        }
+        if regressed:
+            problems.append(
+                f"bench {case}: {new_v:.0f} vs baseline {old_v:.0f} "
+                f"numbers/sec/chip ({drop:.0%} drop > "
+                f"{REGRESSION_TOLERANCE:.0%})"
+            )
+
+
+def run_load_gate(report: dict, problems: list) -> None:
+    """Small load-harness run vs LOAD_r01.json latency. The committed
+    baseline is a 10k-client/500-way run; this 120-client probe only trips
+    on catastrophic latency regressions, by design."""
+    from scripts.load_harness import run_load
+
+    try:
+        baseline = json.loads((ROOT / "LOAD_r01.json").read_text())
+    except (OSError, ValueError):
+        report["regression"]["load"] = {"note": "no LOAD_r01.json baseline"}
+        return
+    result = run_load(
+        clients=120, block_share=0.8, block_size=8, rounds=1,
+        concurrency=40, fault_spec=None,
+    )
+    gate = report["regression"]["load"] = {
+        "baseline": "LOAD_r01.json",
+        "baseline_clients": baseline.get("clients"),
+        "probe_clients": 120,
+        "note": "probe is ~100x lighter than the baseline run; only "
+                "catastrophic latency regressions can trip this leg",
+    }
+    for op in ("claim", "submit"):
+        old_p95 = float(baseline[op]["p95_ms"])
+        new_p95 = float(result[op]["p95_ms"])
+        regressed = new_p95 > old_p95 * (1 + REGRESSION_TOLERANCE)
+        gate[op] = {
+            "baseline_p95_ms": old_p95,
+            "probe_p95_ms": new_p95,
+            "probe_p99_ms": float(result[op]["p99_ms"]),
+            "regressed": regressed,
+        }
+        if regressed:
+            problems.append(
+                f"load {op} p95 {new_p95:.0f}ms vs baseline "
+                f"{old_p95:.0f}ms (>25% worse at 1/100th the load)"
+            )
+    gate["probe_requests_per_sec"] = result["throughput"][
+        "requests_per_sec"
+    ]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="OBSERVATORY_r01.json")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on any gate problem (default: warn only)")
+    p.add_argument("--reps", type=int, default=3,
+                   help="engine A/B repetitions per profiler state")
+    p.add_argument("--bench-budget", type=int, default=70,
+                   help="wall budget (s) for the fresh bench run")
+    p.add_argument("--skip-bench", action="store_true")
+    p.add_argument("--skip-load", action="store_true")
+    args = p.parse_args(argv)
+
+    report: dict = {
+        "run": "perf-gate",
+        "generated_ts": time.time(),
+        "gate_env": GATE_ENV,
+        "history": {},
+        "slo": {},
+        "stepprof": {},
+        "regression": {},
+        "problems": [],
+    }
+    problems: list = []
+
+    print("== observatory: history + SLO against a live server ==")
+    run_observatory(report, problems)
+    print("== stepprof: profiler A/B engine runs ==")
+    run_stepprof(report, problems, args.reps)
+    if not args.skip_bench:
+        print("== regression: fresh bench vs committed baseline ==")
+        run_bench_gate(report, problems, args.bench_budget)
+    if not args.skip_load:
+        print("== regression: small load probe vs LOAD_r01 ==")
+        run_load_gate(report, problems)
+
+    report["problems"] = problems
+    report["ok"] = not problems
+    Path(args.out).write_text(json.dumps(report, indent=1, sort_keys=True))
+    print(f"wrote {args.out}")
+    for prob in problems:
+        print(f"WARN: {prob}")
+    if problems and args.strict:
+        return 1
+    if problems:
+        print(f"{len(problems)} problem(s); warn-only (pass --strict to fail)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

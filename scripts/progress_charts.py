@@ -1,36 +1,105 @@
 #!/usr/bin/env python
-"""Search-progress charts from the coordination ledger (reference
-scripts/progress_charts.py: submission history -> progress-over-time plots).
+"""Search-progress charts from the ledger or the live /history endpoint
+(reference scripts/progress_charts.py: submission history ->
+progress-over-time plots).
 
-Renders two PNGs from the sqlite ledger:
-  1. daily numbers searched, one line per search mode
-  2. cumulative numbers searched over time per mode
+Two sources:
 
-With no --out, prints the daily totals as text instead.
+  --db nice.db        legacy path: daily totals from the sqlite ledger,
+                      rendered as PNGs (--out prefix) or printed as text.
+  --url http://host:port
+                      live path: pulls the observatory time-series
+                      (GET /history, obs/history.py) and emits the chart
+                      JSON web/fleet.html's search-progress pane consumes
+                      (--out <file.json>, default web/progress_chart.json).
 
 Usage:
     python scripts/progress_charts.py --db nice.db --out /tmp/progress
+    python scripts/progress_charts.py --url http://localhost:8089 \\
+        --out web/progress_chart.json
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
+import urllib.parse
+import urllib.request
 from collections import defaultdict
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-
-from nice_tpu.server.db import Db, unpad  # noqa: E402
 
 # Okabe-Ito CVD-safe hues, fixed assignment: detailed is always blue,
 # niceonly always orange (color follows the entity, never the rank).
 MODE_COLORS = {"detailed": "#0072B2", "niceonly": "#E69F00"}
 MODES = ("detailed", "niceonly")
 
+# The /history series behind the live search-progress pane: cumulative
+# numbers searched, the instantaneous fleet rate, and fields completed
+# per mode (labels keep MODE_COLORS meaningful).
+PROGRESS_SERIES = (
+    "nice_fleet_numbers",
+    "nice_fleet_numbers_per_sec",
+    'nice_fleet_fields_total{mode="detailed"}',
+    'nice_fleet_fields_total{mode="niceonly"}',
+)
 
-def daily_totals(db: Db) -> dict[str, dict[str, int]]:
+
+def fetch_history(url: str, series=PROGRESS_SERIES, since: float = 0.0,
+                  timeout: float = 10.0) -> dict:
+    """GET /history for the progress series; tolerates absent series (a
+    young server may not have sampled them yet)."""
+    q = urllib.parse.urlencode(
+        {"series": ",".join(series), "since": since}
+    )
+    req = urllib.request.Request(f"{url.rstrip('/')}/history?{q}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            # Unknown series: fall back to one-by-one so the known subset
+            # still charts.
+            out: dict = {"series": {}}
+            for s in series:
+                q1 = urllib.parse.urlencode({"series": s, "since": since})
+                try:
+                    with urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"{url.rstrip('/')}/history?{q1}"
+                        ),
+                        timeout=timeout,
+                    ) as resp:
+                        out["series"].update(
+                            json.loads(resp.read().decode("utf-8")).get(
+                                "series", {}
+                            )
+                        )
+                except urllib.error.HTTPError:
+                    continue
+            return out
+        raise
+
+
+def chart_json(history: dict, source: str) -> dict:
+    """The wire format web/fleet.html's progress pane reads: per-series
+    multi-tier points plus the fixed mode palette."""
+    return {
+        "v": 1,
+        "generated_ts": time.time(),
+        "source": source,
+        "colors": MODE_COLORS,
+        "series": history.get("series", {}),
+    }
+
+
+def daily_totals(db) -> dict[str, dict[str, int]]:
     """date -> mode -> numbers searched that day (disqualified excluded)."""
+    from nice_tpu.server.db import unpad
+
     with db._lock:
         rows = db._conn.execute(
             "SELECT s.submit_time, s.search_mode, f.range_size"
@@ -46,9 +115,32 @@ def daily_totals(db: Db) -> dict[str, dict[str, int]]:
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--db", default="nice.db")
-    p.add_argument("--out", help="output path prefix (writes <out>_daily.png"
-                                 " and <out>_cumulative.png)")
+    p.add_argument("--url", default=None,
+                   help="server base URL; switches to the live /history "
+                        "source and JSON output")
+    p.add_argument("--out", help="PNG path prefix (--db mode) or chart JSON "
+                                 "path (--url mode; default "
+                                 "web/progress_chart.json)")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="--url mode: only points at/after this unix ts")
     args = p.parse_args()
+
+    if args.url:
+        history = fetch_history(args.url, since=args.since)
+        chart = chart_json(history, f"{args.url.rstrip('/')}/history")
+        out_path = Path(args.out or "web/progress_chart.json")
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(chart, indent=1, sort_keys=True))
+        n_pts = sum(
+            len(pts)
+            for tiers in chart["series"].values()
+            for pts in tiers.values()
+        )
+        print(f"wrote {out_path} ({len(chart['series'])} series, "
+              f"{n_pts} points)")
+        return 0
+
+    from nice_tpu.server.db import Db
 
     db = Db(args.db)
     try:

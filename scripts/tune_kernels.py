@@ -221,13 +221,24 @@ def main() -> int:
     carries = [int(c) for c in args.carry.split(",")]
 
     def rec_for(batch_size, rows, carry, floor, el):
-        return {
+        rec = {
             "kind": args.kind, "mode": args.mode, "base": data.base,
             "backend": args.backend, "batch_size": batch_size,
             "block_rows": rows, "carry_interval": carry,
             "msd_floor": floor, "elapsed_secs": round(el, 6),
             "numbers_per_sec": round(args.slice / el, 1) if el > 0 else None,
         }
+        # With NICE_TPU_STEPPROF=1 the engine left the most recent field's
+        # phase attribution behind; autotune.record persists it with the
+        # winner so regressions are attributable to a phase.
+        from nice_tpu.obs import stepprof
+
+        if stepprof.LAST_BREAKDOWN:
+            rec["phase_breakdown"] = {
+                p: round(float(stepprof.LAST_BREAKDOWN.get(p, 0.0)), 6)
+                for p in stepprof.PHASES
+            }
+        return rec
 
     if args.kind == "blocks":
         sweep_stats_blocks(

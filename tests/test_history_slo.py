@@ -1,0 +1,403 @@
+"""Performance observatory tests: history tier math, writer-actor
+persistence roundtrip, SLO burn-rate state transitions, device-step
+profiler attribution, and the profiler-off no-extra-syncs guarantee."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from nice_tpu import obs
+from nice_tpu.obs import history, slo, stepprof
+from nice_tpu.obs.history import HistoryStore, TieredSeries, handle_query
+from nice_tpu.server.db import Db
+from nice_tpu.server.writer import WriteActor
+
+
+# -- ring downsampling math -------------------------------------------------
+
+
+def test_coarse_tier_bucket_aggregates():
+    """raw -> 1m -> 15m tier math: finalized buckets carry exact
+    mean/min/max/last/n for the samples that fell inside them."""
+    s = TieredSeries(tier1_secs=60.0, tier2_secs=900.0)
+    t0 = 1_000_020.0  # mid-bucket start: bucket ts must still align to 60s
+    # Four samples inside one 1m bucket, then one in the next bucket.
+    for i, v in enumerate((2.0, 4.0, 6.0, 8.0)):
+        assert s.add(t0 + i * 5, v) == []  # no rollover yet
+    done = s.add(t0 + 60, 10.0)
+    assert [tier for tier, _ in done] == ["1m"]
+    bts, mean, vmin, vmax, last, n = done[0][1]
+    assert bts == 1_000_020.0 - (1_000_020.0 % 60)
+    assert mean == pytest.approx(5.0)
+    assert (vmin, vmax, last, n) == (2.0, 8.0, 8.0, 4)
+    # The in-progress bucket shows up in snapshots (short-run visibility).
+    snap = s.snapshot(since=0.0, tiers=("raw", "1m", "15m"))
+    assert len(snap["raw"]) == 5
+    assert len(snap["1m"]) == 2  # finalized + in-progress
+    assert snap["1m"][1][1] == pytest.approx(10.0)
+    assert len(snap["15m"]) == 1  # single in-progress 15m bucket
+
+    # 15m rollover after crossing a 900 s boundary.
+    done = s.add(t0 + 900, 1.0)
+    tiers = dict(done)
+    assert "15m" in tiers and "1m" in tiers
+    assert tiers["15m"][5] == 5  # all five earlier samples in one bucket
+
+
+def test_raw_ring_is_bounded(monkeypatch):
+    s = TieredSeries(60.0, 900.0)
+    for i in range(history.RAW_CAP + 50):
+        s.add(1_000_000.0 + i, float(i))
+    assert len(s.raw) == history.RAW_CAP
+
+
+def test_store_samples_counters_gauges_and_histograms():
+    reg = obs.Registry()
+    c = reg.counter("t_hist_ctr", "d", labelnames=("mode",))
+    g = reg.gauge("t_hist_gauge", "d")
+    h = reg.histogram("t_hist_lat", "d", buckets=(0.1, 0.5, 1.0))
+    c.labels("detailed").inc(3)
+    c.labels("niceonly").inc(1)
+    g.set(7.5)
+    h.observe(0.05)  # create the label state before the first snapshot
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    store.sample_registries([reg], ts=1_000_000.0)
+    names = store.series_names()
+    assert 't_hist_ctr{mode="detailed"}' in names
+    assert "t_hist_ctr" in names  # aggregate sum across label combos
+    assert "t_hist_gauge" in names
+    agg = store.query("t_hist_ctr")
+    assert agg["raw"][0][1] == pytest.approx(4.0)
+
+    # Histogram quantiles are windowed: derived from bucket-count DELTAS
+    # between consecutive samples, so they need a second sample.
+    for _ in range(20):
+        h.observe(0.3)
+    store.sample_registries([reg], ts=1_000_015.0)
+    q = store.query("t_hist_lat_p95")
+    assert q is not None and q["raw"]
+    # All 20 observations sit in the (0.1, 0.5] bucket: the interpolated
+    # p95 must land inside it.
+    assert 0.1 <= q["raw"][-1][1] <= 0.5
+
+
+def test_handle_query_contract():
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    store.add("a_series", 1.0, ts=1_000_000.0)
+    status, body = handle_query(store, "")
+    assert status == 200 and body["series"] == ["a_series"]
+    status, body = handle_query(store, "series=a_series&since=0")
+    assert status == 200 and body["series"]["a_series"]["raw"]
+    status, body = handle_query(store, "series=nope")
+    assert status == 404
+    assert body["unknown"] == ["nope"] and "a_series" in body["known_sample"]
+    status, body = handle_query(store, "series=a_series&since=abc")
+    assert status == 400
+    status, body = handle_query(store, "series=a_series&tier=bogus")
+    assert status == 400
+    status, body = handle_query(store, "series=a_series&tier=raw")
+    assert status == 200 and list(body["series"]["a_series"]) == ["raw"]
+
+
+def test_handle_query_labeled_series_with_commas():
+    """Commas inside {label="..."} sets belong to the series name; only
+    top-level commas separate the requested list."""
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    multi = 'req_total{endpoint="/status",status="200"}'
+    store.add(multi, 3.0, ts=1_000_000.0)
+    store.add("plain", 1.0, ts=1_000_000.0)
+    status, body = handle_query(
+        store, "series=" + urllib.parse.quote(f"{multi},plain")
+    )
+    assert status == 200
+    assert set(body["series"]) == {multi, "plain"}
+
+
+# -- persistence through the writer actor ----------------------------------
+
+
+def test_history_rows_roundtrip_through_writer_actor(tmp_path):
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    t0 = 2_000_000.0
+    for i in range(8):
+        store.add("rt_series", float(i), ts=t0 + i * 10)  # crosses one 1m edge
+    rows = store.drain_rows()
+    assert rows and store.drain_rows() == []  # drain empties the pending set
+    tiers = {r[1] for r in rows}
+    assert "raw" in tiers and "1m" in tiers
+
+    db = Db(str(tmp_path / "hist.db"))
+    try:
+        w = WriteActor(db)
+        try:
+            n = w.submit(db.insert_metric_history, rows).result(timeout=10)
+            assert n == len(rows)
+            # Idempotent upsert: re-inserting the same rows cannot dup.
+            w.submit(db.insert_metric_history, rows).result(timeout=10)
+        finally:
+            w.close()
+        got = db.get_metric_history("rt_series", tier="raw")
+        assert [r["value"] for r in got] == [float(i) for i in range(8)]
+        assert db.get_metric_history_series() == ["rt_series"]
+        coarse = db.get_metric_history("rt_series", tier="1m")
+        assert coarse and coarse[0]["n"] >= 1
+        # Retention prune drops everything before the cutoff.
+        pruned = db.prune_metric_history(t0 + 35)
+        assert pruned > 0
+        left = db.get_metric_history("rt_series", tier="raw")
+        assert all(r["ts"] >= t0 + 35 for r in left)
+    finally:
+        db.close()
+
+
+# -- SLO burn-rate state machine -------------------------------------------
+
+
+def _quantile_spec(**kw):
+    base = dict(
+        name="t_claim_p99", kind="quantile", series_prefix="t_lat_p99",
+        threshold=0.5, objective=0.10, short_secs=300, long_secs=3600,
+    )
+    base.update(kw)
+    return slo.SloSpec(**base)
+
+
+def test_slo_transitions_ok_warn_page_ok():
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    spec = _quantile_spec()
+    eng = slo.SloEngine(store, specs=[spec])
+    now = 3_000_000.0
+
+    # No data -> ok (explicitly flagged).
+    res = eng.evaluate(now=now)[0]
+    assert res["state"] == "ok" and res["no_data"]
+
+    # All samples under threshold -> ok.
+    for i in range(10):
+        store.add("t_lat_p99", 0.1, ts=now - 200 + i * 10)
+    assert eng.evaluate(now=now)[0]["state"] == "ok"
+    t_before = eng.transitions
+
+    # Breach a fraction of the window above warn burn but below page burn:
+    # 2 of ~12 samples bad -> bad_fraction ~0.17, burn ~1.7x.
+    store.add("t_lat_p99", 0.9, ts=now - 95)
+    store.add("t_lat_p99", 0.9, ts=now - 90)
+    res = eng.evaluate(now=now)[0]
+    assert res["state"] == "warn"
+    assert res["burn_short"] >= 1.0
+    assert eng.transitions == t_before + 1
+
+    # Saturate the window -> page on both windows.
+    for i in range(40):
+        store.add("t_lat_p99", 2.0, ts=now - 80 + i * 2)
+    res = eng.evaluate(now=now)[0]
+    assert res["state"] == "page"
+    assert res["burn_short"] >= spec.page_burn
+
+    # Recover: advance time so the bad samples age out of both windows.
+    later = now + 3600 * 2
+    for i in range(10):
+        store.add("t_lat_p99", 0.1, ts=later - 100 + i * 10)
+    res = eng.evaluate(now=later)[0]
+    assert res["state"] == "ok"
+    states = [s["slo"] for s in eng.last()]
+    assert states == ["t_claim_p99"]
+
+
+def test_slo_ratio_kind_uses_counter_deltas():
+    store = HistoryStore(tier1_secs=60.0, tier2_secs=900.0)
+    now = 4_000_000.0
+    # Counters grow over the window: 100 total, 10 bad -> 10% bad.
+    for i, (tot, bad) in enumerate(((0, 0), (50, 2), (100, 10))):
+        ts = now - 200 + i * 60
+        store.add('t_req{endpoint="/submit",status="200"}', tot - bad, ts=ts)
+        store.add('t_req{endpoint="/submit",status="500"}', bad, ts=ts)
+    spec = slo.SloSpec(
+        name="t_submit", kind="ratio", series_prefix="t_req",
+        label_filter='endpoint="/submit', bad_filter=lambda s: 'status="5' in s,
+        objective=0.01, short_secs=300, long_secs=3600,
+    )
+    res = spec.evaluate(store, now)
+    assert res["burn_long"] == pytest.approx(10.0, rel=0.01)
+    assert res["state"] == "page"
+
+
+def test_default_specs_cover_issue_slos():
+    names = {s.name for s in slo.default_specs()}
+    assert {"claim_p99", "submit_success", "feed_idle_p95",
+            "spot_check_fail"} <= names
+
+
+# -- device-step profiler ---------------------------------------------------
+
+
+@pytest.fixture()
+def _prof_reset():
+    stepprof.reset()
+    yield
+    stepprof.reset()
+
+
+def _run_small_detailed(base=30, size=300_000, batch=1 << 12):
+    from nice_tpu.core.base_range import get_base_range
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import engine
+
+    start, _end = get_base_range(base)
+    return engine.process_range_detailed(
+        FieldSize(start, start + size), base, batch_size=batch
+    )
+
+
+def test_stepprof_disabled_adds_zero_fences(monkeypatch, _prof_reset):
+    monkeypatch.setenv("NICE_TPU_STEPPROF", "0")
+    _run_small_detailed()
+    assert stepprof.fence_count() == 0
+    assert stepprof.cumulative() == {}
+    assert stepprof.LAST_BREAKDOWN == {}
+
+
+def test_stepprof_buckets_sum_to_wall(monkeypatch, _prof_reset):
+    monkeypatch.setenv("NICE_TPU_STEPPROF", "1")
+    _run_small_detailed()
+    cum = stepprof.cumulative()
+    assert len(cum) == 1
+    (key, entry), = cum.items()
+    assert key.startswith("detailed|b30|")
+    assert entry["fields"] == 1
+    bucket_sum = sum(entry[p] for p in stepprof.PHASES)
+    # host_other is derived as wall - sum(attributed), so the total must
+    # reconcile within 10% (the acceptance bound from the observatory spec).
+    assert bucket_sum == pytest.approx(entry["wall"], rel=0.10)
+    assert stepprof.fence_count() > 0
+    assert entry["device_compute"] > 0
+    # The phase histogram series observed at least one phase.
+    from nice_tpu.obs.series import STEPPROF_PHASE_SECONDS
+
+    sums = STEPPROF_PHASE_SECONDS.label_sums()
+    assert any(k[0] == "detailed" for k in sums)
+
+
+def test_stepprof_thread_local_compile_attribution(_prof_reset):
+    prof = stepprof.StepProfiler("detailed", 99, "jnp", enabled_override=True)
+    with prof:
+        stepprof.note_compile(0.25)
+    assert stepprof.cumulative()["detailed|b99|jnp"]["compile"] == (
+        pytest.approx(0.25)
+    )
+    # Outside any profiler context, note_compile is a silent no-op.
+    stepprof.note_compile(1.0)
+    assert stepprof.cumulative()["detailed|b99|jnp"]["compile"] == (
+        pytest.approx(0.25)
+    )
+
+
+# -- server wiring: /history endpoint + periodic tick -----------------------
+
+
+@pytest.fixture()
+def obs_server(tmp_path, monkeypatch):
+    import threading
+
+    from nice_tpu.server import app as server_app
+
+    monkeypatch.setenv("NICE_TPU_HISTORY_SECS", "3600")  # tick manually
+    db_path = str(tmp_path / "obs.db")
+    db = Db(db_path)
+    db.seed_base(10, field_size=20)
+    db.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv.context
+    srv.shutdown()
+
+
+def test_server_history_endpoint_and_slo_block(obs_server):
+    base_url, ctx = obs_server
+    # Generate some API traffic, then take two samples so histogram
+    # quantile series (windowed) materialize.
+    urllib.request.urlopen(f"{base_url}/status", timeout=10).read()
+    ctx.history_tick()
+    urllib.request.urlopen(f"{base_url}/status", timeout=10).read()
+    ctx.history_tick()
+
+    with urllib.request.urlopen(f"{base_url}/history", timeout=10) as r:
+        assert r.headers.get("Content-Type", "").startswith(
+            "application/json"
+        )
+        directory = json.loads(r.read())
+    assert directory["count"] >= 5
+    assert any(s.startswith("nice_api_request") for s in directory["series"])
+
+    name = directory["series"][0]
+    q = urllib.parse.quote(name)
+    with urllib.request.urlopen(
+        f"{base_url}/history?series={q}", timeout=10
+    ) as r:
+        body = json.loads(r.read())
+    assert body["series"][name]["raw"]
+
+    # Unknown series: real 404 with a JSON body.
+    try:
+        urllib.request.urlopen(
+            f"{base_url}/history?series=definitely_not_a_series", timeout=10
+        )
+        raise AssertionError("expected HTTP 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert e.headers.get("Content-Type", "").startswith(
+            "application/json"
+        )
+        err = json.loads(e.read())
+        assert err["unknown"] == ["definitely_not_a_series"]
+        assert err["known_count"] >= 5
+
+    # Ticks persisted rows into metric_history via the writer path.
+    rows = ctx.db.get_metric_history_series()
+    assert rows, "history_tick persisted no rows"
+
+    # /status carries the SLO block.
+    with urllib.request.urlopen(f"{base_url}/status", timeout=10) as r:
+        status = json.loads(r.read())
+    assert isinstance(status.get("slo"), list) and status["slo"]
+    assert {s["slo"] for s in status["slo"]} >= {"claim_p99"}
+    assert all(s["state"] in ("ok", "warn", "page") for s in status["slo"])
+
+
+def test_local_serve_history_route(monkeypatch):
+    """The client metrics port serves /history from the module STORE and
+    JSON 404s for unknown paths."""
+    history.STORE.add("local_series", 42.0)
+    srv = obs.serve_metrics(0)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        with urllib.request.urlopen(
+            f"{base}/history?series=local_series", timeout=10
+        ) as r:
+            assert r.headers.get("Content-Type", "").startswith(
+                "application/json"
+            )
+            body = json.loads(r.read())
+        assert body["series"]["local_series"]["raw"][-1][1] == 42.0
+        try:
+            urllib.request.urlopen(
+                f"{base}/definitely-not-a-path", timeout=10
+            )
+            raise AssertionError("expected HTTP 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert e.headers.get("Content-Type", "").startswith(
+                "application/json"
+            )
+            assert "/history" in json.loads(e.read())["known"]
+    finally:
+        srv.shutdown()
+
+
+def test_flight_kinds_cover_observatory_events():
+    for kind in ("mesh_reshard", "device_loss", "trust_slash",
+                 "consensus_hold", "slo_transition", "spot_check_fail"):
+        assert kind in obs.flight._KNOWN_KINDS

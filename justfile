@@ -9,6 +9,25 @@ test-fast:
     python -m pytest tests/test_base_range.py tests/test_core_misc.py \
         tests/test_filters.py tests/test_native.py -q
 
+# project-invariant static analysis (nicelint) + optional ruff floor
+lint:
+    #!/usr/bin/env bash
+    set -euo pipefail
+    python scripts/nicelint.py --strict
+    if command -v ruff >/dev/null 2>&1; then
+        ruff check nice_tpu scripts tests
+    else
+        echo "lint: ruff not installed; skipped the generic floor"
+    fi
+
+# rewrite the nicelint ratchet baseline (justify every entry you keep)
+lint-baseline:
+    python scripts/nicelint.py --update-baseline
+
+# regenerate docs/KNOBS.md + README knob tables from the knob registry
+knobs-docs:
+    python scripts/nicelint.py --write-docs
+
 # build the C++ native host engine
 native:
     make -C nice_tpu/native

@@ -1,0 +1,44 @@
+"""nicelint: project-invariant static analysis for nice_tpu.
+
+Seven AST-based rule families, each enforcing an invariant the codebase
+otherwise holds only by convention:
+
+==== =====================================================================
+W1   writer-actor discipline — mutating ``server/db.py`` calls outside
+     ``server/writer.py`` / sanctioned init paths
+L1   event-loop purity — no blocking calls reachable from the async core's
+     loop-thread functions
+D1   device-sync discipline — ``block_until_ready`` / ``jax.device_get`` /
+     ``np.asarray``-on-device-array only at ``# nicelint: fence`` sites in
+     the engine/mesh hot paths
+M1   metrics discipline — every ``nice_*`` series name used anywhere is
+     declared in ``obs/series.py``, with literal (bounded) label sets
+K1   knob discipline — every ``NICE_TPU_*`` read goes through
+     ``nice_tpu/utils/knobs.py``; generated knob docs must not drift
+A1   atomic-write discipline — state files written only via
+     ``nice_tpu.utils.fsio``
+X1   lock-order — static lock graph from nested ``with`` acquisitions must
+     be acyclic; project locks must be built via ``lockdep.make_lock``
+==== =====================================================================
+
+Violations are compared against a committed ratchet baseline
+(``nice_tpu/analysis/baseline.json``): new violations fail, baselined ones
+burn down, stale baseline entries fail ``--strict``. Inline escapes:
+
+* ``# nicelint: allow W1 (reason)`` — suppress a rule on that line
+* ``# nicelint: fence`` — sanctioned D1 device-sync fence
+* ``# nicelint: loop-thread`` — mark a function as an L1 root
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): the linter must
+run in CI images with no third-party packages installed.
+"""
+
+from nice_tpu.analysis.core import (  # noqa: F401
+    Project,
+    SourceFile,
+    Violation,
+    all_rules,
+    load_baseline,
+    run_rules,
+    save_baseline,
+)

@@ -1,0 +1,260 @@
+"""nicelint framework: source model, inline escapes, ratchet baseline.
+
+The design center is the RATCHET: a violation's identity must survive
+unrelated edits, so baseline keys are ``rule|path|detail`` with no line
+numbers — the line is carried separately for display only. A baselined
+violation therefore stays baselined as the file grows around it, and fixing
+it strands a stale key that ``--strict`` forces out of the baseline file.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+# ``# nicelint: allow W1 (reason)`` / ``# nicelint: allow W1,K1 (reason)``
+_ALLOW_RE = re.compile(
+    r"#\s*nicelint:\s*allow\s+([A-Z]\d(?:\s*,\s*[A-Z]\d)*)\b"
+)
+_FENCE_RE = re.compile(r"#\s*nicelint:\s*fence\b")
+_LOOP_THREAD_RE = re.compile(r"#\s*nicelint:\s*loop-thread\b")
+
+
+class Violation:
+    """One finding. ``key`` (rule|path|detail) is the ratchet identity and
+    deliberately excludes the line number."""
+
+    __slots__ = ("rule", "path", "line", "message", "detail")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 detail: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+        self.detail = detail
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.detail}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Violation {self.rule} {self.path}:{self.line} {self.detail}>"
+
+
+class SourceFile:
+    """One parsed file plus its inline nicelint escape markers."""
+
+    def __init__(self, root: str, relpath: str):
+        self.root = root
+        self.relpath = relpath
+        with open(os.path.join(root, relpath), encoding="utf-8",
+                  errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._parse_error: Optional[SyntaxError] = None
+        self._allows: Optional[Dict[int, Set[str]]] = None
+        self._fences: Optional[Set[int]] = None
+        self._loop_thread_marks: Optional[Set[int]] = None
+
+    # -- parsing -----------------------------------------------------------
+
+    @property
+    def is_python(self) -> bool:
+        return self.relpath.endswith(".py")
+
+    def tree(self) -> Optional[ast.AST]:
+        """The module AST, or None on syntax errors (ruff's E9 floor owns
+        those; nicelint rules just skip the file)."""
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as exc:
+                self._parse_error = exc
+        return self._tree
+
+    # -- inline escapes ----------------------------------------------------
+
+    def _scan_markers(self) -> None:
+        self._allows = {}
+        self._fences = set()
+        self._loop_thread_marks = set()
+        for i, line in enumerate(self.lines, start=1):
+            if "nicelint" not in line:
+                continue
+            m = _ALLOW_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self._allows.setdefault(i, set()).update(rules)
+            if _FENCE_RE.search(line):
+                self._fences.add(i)
+            if _LOOP_THREAD_RE.search(line):
+                self._loop_thread_marks.add(i)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True when ``line`` (or the line above, for markers placed on
+        their own comment line) carries an allow for ``rule``."""
+        if self._allows is None:
+            self._scan_markers()
+        for ln in (line, line - 1):
+            rules = self._allows.get(ln)
+            if rules and rule in rules:
+                return True
+        return False
+
+    def is_fence(self, line: int) -> bool:
+        if self._fences is None:
+            self._scan_markers()
+        return line in self._fences or (line - 1) in self._fences
+
+    def loop_thread_lines(self) -> Set[int]:
+        if self._loop_thread_marks is None:
+            self._scan_markers()
+        return set(self._loop_thread_marks)
+
+
+class Project:
+    """The file set nicelint runs over. Python files under the package,
+    scripts, and tests; plus non-Python assets (web UI, docs) that the M1/K1
+    usage scans read as text."""
+
+    PY_DIRS = ("nice_tpu", "scripts", "tests")
+    TEXT_GLOB_DIRS = ("web",)
+    TEXT_EXTS = (".html", ".js", ".mjs", ".css")
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._files: Optional[List[SourceFile]] = None
+
+    def files(self) -> List[SourceFile]:
+        if self._files is not None:
+            return self._files
+        out: List[SourceFile] = []
+        for top in self.PY_DIRS:
+            base = os.path.join(self.root, top)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and
+                               not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root
+                        )
+                        out.append(SourceFile(self.root, rel))
+        for top in self.TEXT_GLOB_DIRS:
+            base = os.path.join(self.root, top)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d != "node_modules"]
+                for fn in sorted(filenames):
+                    if fn.endswith(self.TEXT_EXTS):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root
+                        )
+                        out.append(SourceFile(self.root, rel))
+        self._files = out
+        return out
+
+    def python_files(self, prefix: str = "") -> List[SourceFile]:
+        return [f for f in self.files()
+                if f.is_python and f.relpath.startswith(prefix)]
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        for f in self.files():
+            if f.relpath == relpath:
+                return f
+        return None
+
+
+# -- rule registry ---------------------------------------------------------
+
+Rule = Callable[[Project], List[Violation]]
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str):
+    def deco(fn: Rule) -> Rule:
+        _RULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    # Import side-effect registers every rule module exactly once.
+    from nice_tpu.analysis import rules  # noqa: F401
+    return dict(_RULES)
+
+
+def run_rules(project: Project,
+              only: Optional[Iterable[str]] = None) -> List[Violation]:
+    """Run rule families (all by default) and drop inline-allowed findings."""
+    wanted = set(only) if only else None
+    out: List[Violation] = []
+    for rule_id, fn in sorted(all_rules().items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        for v in fn(project):
+            src = project.get(v.path)
+            if src is not None and src.allowed(v.rule, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule, v.detail))
+    return out
+
+
+# -- ratchet baseline ------------------------------------------------------
+
+BASELINE_RELPATH = os.path.join("nice_tpu", "analysis", "baseline.json")
+
+
+def load_baseline(root: str) -> Dict[str, str]:
+    """key -> justification. Missing file means an empty baseline."""
+    path = os.path.join(root, BASELINE_RELPATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", {})
+    if isinstance(entries, list):  # tolerate the bare-list form
+        return {k: "" for k in entries}
+    return dict(entries)
+
+
+def save_baseline(root: str, entries: Dict[str, str]) -> None:
+    path = os.path.join(root, BASELINE_RELPATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "comment": (
+            "nicelint ratchet baseline. Every key is rule|path|detail for a "
+            "KNOWN violation with a justification; new violations fail CI "
+            "immediately. Regenerate with: python scripts/nicelint.py "
+            "--update-baseline"
+        ),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as f:  # nicelint: allow A1 (dev-only tool output, not crash-safety state)
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+
+
+def diff_against_baseline(
+    violations: List[Violation], baseline: Dict[str, str]
+) -> Tuple[List[Violation], List[str]]:
+    """(new_violations, stale_baseline_keys)."""
+    found = {v.key for v in violations}
+    new = [v for v in violations if v.key not in baseline]
+    stale = sorted(k for k in baseline if k not in found)
+    return new, stale

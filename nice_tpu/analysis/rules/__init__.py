@@ -1,0 +1,11 @@
+"""Rule modules register themselves with core.rule on import."""
+
+from nice_tpu.analysis.rules import (  # noqa: F401
+    a1_atomic_write,
+    d1_device_sync,
+    k1_knobs,
+    l1_loop_purity,
+    m1_metrics,
+    w1_writer,
+    x1_lock_order,
+)

@@ -1,0 +1,69 @@
+"""D1: device-sync discipline in the engine/mesh hot paths.
+
+PR 10's device-step profiler guarantees it adds zero device syncs; that
+only stays true if every sync site in ``ops/engine.py`` and
+``parallel/mesh.py`` is deliberate. Each ``block_until_ready``,
+``jax.device_get``, or ``np.asarray``-of-a-device-value call must sit on a
+line marked ``# nicelint: fence`` (or directly below a fence comment line)
+— making every host-device synchronization point grep-able and reviewed.
+
+``np.asarray`` over obvious host data (list/tuple/comprehension literals,
+``np.*`` results) is skipped; only Name/Attribute arguments — potential
+device arrays — count.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from nice_tpu.analysis import astutil
+from nice_tpu.analysis.core import Project, Violation, rule
+
+SCOPE = ("nice_tpu/ops/engine.py", "nice_tpu/parallel/mesh.py")
+
+HOST_ARG_TYPES = (ast.List, ast.ListComp, ast.Tuple, ast.GeneratorExp,
+                  ast.Dict, ast.Constant, ast.BinOp)
+
+
+def _is_sync_call(node: ast.Call) -> str:
+    name = astutil.call_name(node) or ""
+    if name.endswith(".block_until_ready"):
+        return "block_until_ready"
+    if name in ("jax.device_get", "device_get"):
+        return "jax.device_get"
+    if name in ("np.asarray", "numpy.asarray", "np.array", "numpy.array"):
+        if node.args and isinstance(node.args[0], HOST_ARG_TYPES):
+            return ""  # host-literal construction, no device sync
+        if node.args and isinstance(node.args[0], ast.Call):
+            inner = astutil.call_name(node.args[0]) or ""
+            if inner.startswith(("np.", "numpy.")):
+                return ""  # np-on-np, host side
+        return name
+    return ""
+
+
+@rule("D1")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for relpath in SCOPE:
+        src = project.get(relpath)
+        if src is None or src.tree() is None:
+            continue
+        enclosing = astutil.enclosing_function_map(src.tree())
+        for node in ast.walk(src.tree()):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _is_sync_call(node)
+            if not kind:
+                continue
+            if src.is_fence(node.lineno):
+                continue
+            fn = enclosing.get(node.lineno, "<module>")
+            out.append(Violation(
+                "D1", relpath, node.lineno,
+                f"device sync {kind} outside a '# nicelint: fence' site "
+                f"in {fn}",
+                detail=f"{fn}->{kind}",
+            ))
+    return out

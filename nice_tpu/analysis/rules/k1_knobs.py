@@ -1,0 +1,186 @@
+"""K1: knob discipline.
+
+(a) No direct ``NICE_TPU_*`` environment READS inside ``nice_tpu/``
+outside the registry itself — every read goes through
+``nice_tpu.utils.knobs`` so type, default, and documentation live in one
+place. (Scripts and tests may read the environment for harness plumbing;
+they still fall under (b).)
+
+(b) Every ``NICE_TPU_*`` name appearing as a string literal in Python
+source must be declared in the registry (exact knob or prefix family) —
+an undeclared name is either a typo or an undocumented knob.
+
+(c) Generated docs must not drift: ``docs/KNOBS.md`` must equal
+``knobs.render_markdown()`` and the README's generated knob block must
+equal the registry rendering. Regenerate with
+``python scripts/nicelint.py --write-docs``.
+
+The docs check only engages when the analyzed tree ships the real
+registry (``nice_tpu/utils/knobs.py`` exists), so fixture mini-projects
+in the rule tests are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from nice_tpu.analysis import astutil
+from nice_tpu.analysis.core import Project, Violation, rule
+
+KNOBS_PATH = "nice_tpu/utils/knobs.py"
+_KNOB_RE = re.compile(r"^NICE_TPU_[A-Z0-9_]*[A-Z0-9]$")
+
+README_BEGIN = "<!-- nicelint:knobs:begin"
+README_END = "<!-- nicelint:knobs:end -->"
+
+
+def _env_read_name(node: ast.Call) -> str:
+    """The literal knob name when this call reads the environment."""
+    name = astutil.call_name(node) or ""
+    if name.endswith(("os.environ.get", "environ.get")) or \
+            name in ("os.getenv", "getenv"):
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    return ""
+
+
+def _declared(name: str) -> bool:
+    from nice_tpu.utils import knobs
+    if knobs.is_declared(name):
+        return True
+    return any(fam.matches(name) for fam in knobs.PREFIXES)
+
+
+@rule("K1")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for src in project.python_files():
+        if src.relpath == KNOBS_PATH:
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        in_package = src.relpath.startswith("nice_tpu/")
+        # (a) direct env reads in the package
+        if in_package:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                env_name = _env_read_name(node)
+                if env_name.startswith("NICE_TPU_"):
+                    out.append(Violation(
+                        "K1", src.relpath, node.lineno,
+                        f"direct read of {env_name} — go through "
+                        "nice_tpu.utils.knobs",
+                        detail=f"direct-read:{env_name}",
+                    ))
+                # subscript reads: os.environ["NICE_TPU_X"]
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Subscript) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        (astutil.dotted(node.value) or "").endswith("environ"):
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, str) and \
+                            sl.value.startswith("NICE_TPU_"):
+                        out.append(Violation(
+                            "K1", src.relpath, node.lineno,
+                            f"direct read of {sl.value} — go through "
+                            "nice_tpu.utils.knobs",
+                            detail=f"direct-read:{sl.value}",
+                        ))
+        # (b) undeclared literals (everywhere, including scripts/tests)
+        seen = set()
+        for value, line in astutil.string_literals(tree):
+            if not _KNOB_RE.match(value):
+                continue
+            if value in seen:
+                continue
+            seen.add(value)
+            if not _declared(value):
+                out.append(Violation(
+                    "K1", src.relpath, line,
+                    f"undeclared knob {value} — declare it in "
+                    "nice_tpu/utils/knobs.py",
+                    detail=f"undeclared:{value}",
+                ))
+
+    # (c) generated-docs drift — only against the real registry tree
+    if project.get(KNOBS_PATH) is not None:
+        from nice_tpu.utils import knobs
+        docs_rel = os.path.join("docs", "KNOBS.md")
+        docs_path = os.path.join(project.root, docs_rel)
+        want = knobs.render_markdown()
+        if not os.path.exists(docs_path):
+            out.append(Violation(
+                "K1", docs_rel, 1,
+                "docs/KNOBS.md missing — run scripts/nicelint.py "
+                "--write-docs",
+                detail="docs-missing",
+            ))
+        else:
+            with open(docs_path, encoding="utf-8") as f:
+                have = f.read()
+            if have != want:
+                out.append(Violation(
+                    "K1", docs_rel, 1,
+                    "docs/KNOBS.md drifted from the knob registry — run "
+                    "scripts/nicelint.py --write-docs",
+                    detail="docs-drift",
+                ))
+        readme_path = os.path.join(project.root, "README.md")
+        if os.path.exists(readme_path):
+            with open(readme_path, encoding="utf-8") as f:
+                readme = f.read()
+            for group, block in _readme_blocks(readme):
+                want_block = knobs.render_group_markdown(group)
+                if block.strip() != want_block.strip():
+                    out.append(Violation(
+                        "K1", "README.md", 1,
+                        f"README generated knob table ({group}) drifted — "
+                        "run scripts/nicelint.py --write-docs",
+                        detail=f"readme-drift:{group}",
+                    ))
+    return out
+
+
+def _readme_blocks(readme: str):
+    """Yields (group, current_block_text) for every generated marker pair:
+    <!-- nicelint:knobs:begin GROUP --> ... <!-- nicelint:knobs:end -->"""
+    pos = 0
+    while True:
+        start = readme.find(README_BEGIN, pos)
+        if start < 0:
+            return
+        head_end = readme.index("-->", start) + 3
+        group = readme[start + len(README_BEGIN):head_end - 3].strip()
+        end = readme.find(README_END, head_end)
+        if end < 0:
+            return
+        yield group, readme[head_end:end]
+        pos = end + len(README_END)
+
+
+def rewrite_readme(readme: str) -> str:
+    """The --write-docs counterpart of the drift check."""
+    from nice_tpu.utils import knobs
+    out = []
+    pos = 0
+    while True:
+        start = readme.find(README_BEGIN, pos)
+        if start < 0:
+            out.append(readme[pos:])
+            return "".join(out)
+        head_end = readme.index("-->", start) + 3
+        group = readme[start + len(README_BEGIN):head_end - 3].strip()
+        end = readme.find(README_END, head_end)
+        if end < 0:
+            out.append(readme[pos:])
+            return "".join(out)
+        out.append(readme[pos:head_end])
+        out.append("\n" + knobs.render_group_markdown(group).strip() + "\n")
+        pos = end

@@ -1,0 +1,146 @@
+"""L1: event-loop purity.
+
+Roots are the async functions of ``server/async_core.py`` (they run ON the
+event loop) plus any function carrying a ``# nicelint: loop-thread`` marker
+(the limiter/shed/multiplier callables the async core invokes from the loop
+thread). From each root the rule follows same-module direct calls — NOT
+values handed to ``run_in_executor`` or the writer actor, which is exactly
+the sanctioned way to leave the loop — and flags reachable blocking
+operations: ``time.sleep``, file ``open()``, sqlite, blocking socket
+constructors, subprocess, and ``Future.result()``-style waits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from nice_tpu.analysis import astutil
+from nice_tpu.analysis.core import Project, SourceFile, Violation, rule
+
+ASYNC_CORE = "nice_tpu/server/async_core.py"
+SERVER_PREFIX = "nice_tpu/server/"
+
+BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the loop thread",
+    "open": "file I/O blocks the loop thread",
+    "sqlite3.connect": "sqlite access on the loop thread",
+    "socket.create_connection": "blocking socket connect",
+    "subprocess.run": "subprocess wait on the loop thread",
+    "subprocess.check_output": "subprocess wait on the loop thread",
+    "subprocess.check_call": "subprocess wait on the loop thread",
+}
+BLOCKING_SUFFIXES = {
+    ".result": "Future.result() waits on the loop thread",
+    ".execute": "DB execute on the loop thread",
+    ".executemany": "DB execute on the loop thread",
+    ".fsync": "fsync on the loop thread",
+}
+# Executor/actor dispatch: arguments to these run OFF the loop; the callee
+# is not loop-reachable through them.
+OFFLOAD_SUFFIXES = (".run_in_executor",)
+
+
+def _function_table(src: SourceFile) -> Dict[str, ast.AST]:
+    tree = src.tree()
+    if tree is None:
+        return {}
+    return {qn.rsplit(".", 1)[-1]: fn
+            for qn, fn in astutil.iter_functions(tree)}
+
+
+def _roots(src: SourceFile) -> Set[str]:
+    tree = src.tree()
+    if tree is None:
+        return set()
+    roots: Set[str] = set()
+    marks = src.loop_thread_lines()
+    for qn, fn in astutil.iter_functions(tree):
+        short = qn.rsplit(".", 1)[-1]
+        if src.relpath == ASYNC_CORE and isinstance(fn, ast.AsyncFunctionDef):
+            roots.add(short)
+        start = fn.lineno
+        # a marker on the def line, the decorator line, or the line above
+        if any(ln in marks for ln in (start, start - 1)):
+            roots.add(short)
+        else:
+            deco_lines = {d.lineno for d in getattr(fn, "decorator_list", [])}
+            if deco_lines & marks:
+                roots.add(short)
+    return roots
+
+
+def _direct_calls(fn: ast.AST) -> Set[str]:
+    """Same-module call targets, EXCLUDING anything passed as an argument
+    to an offload dispatcher (run_in_executor)."""
+    offload_arg_spans: List[Tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name and name.endswith(OFFLOAD_SUFFIXES):
+                for arg in node.args:
+                    offload_arg_spans.append(
+                        (arg.lineno, getattr(arg, "end_lineno", arg.lineno))
+                    )
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if any(a <= node.lineno <= b for a, b in offload_arg_spans):
+            continue
+        name = astutil.call_name(node)
+        if not name:
+            continue
+        if name.startswith("self."):
+            out.add(name.split(".", 1)[1].split(".", 1)[0])
+        elif "." not in name:
+            out.add(name)
+    return out
+
+
+def _blocking_calls(fn: ast.AST) -> List[Tuple[int, str, str]]:
+    found = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if not name:
+            continue
+        if name in BLOCKING_EXACT:
+            found.append((node.lineno, name, BLOCKING_EXACT[name]))
+            continue
+        for suffix, why in BLOCKING_SUFFIXES.items():
+            if name.endswith(suffix) and name != "self" + suffix:
+                found.append((node.lineno, name, why))
+                break
+    return found
+
+
+@rule("L1")
+def check(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for src in project.python_files(SERVER_PREFIX):
+        roots = _roots(src)
+        if not roots:
+            continue
+        table = _function_table(src)
+        # Reachable set via same-module direct calls.
+        reachable: Set[str] = set()
+        frontier = [r for r in roots if r in table]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            for callee in _direct_calls(table[name]):
+                if callee in table and callee not in reachable:
+                    frontier.append(callee)
+        for name in sorted(reachable):
+            for line, callee, why in _blocking_calls(table[name]):
+                out.append(Violation(
+                    "L1", src.relpath, line,
+                    f"{callee}() reachable from loop-thread root "
+                    f"({name}): {why}",
+                    detail=f"{name}->{callee}",
+                ))
+    return out

@@ -1,0 +1,148 @@
+"""W1: writer-actor discipline.
+
+Every mutating ``server/db.py`` method must be invoked from writer context
+— inside ``server/writer.py`` itself, inside ``server/db.py`` (methods
+compose), or from a function the writer actor runs (anything handed to
+``writer.call`` / ``writer.submit`` / ``ctx.write`` / ``add_periodic``,
+transitively through same-module helpers). A mutating call anywhere else in
+``nice_tpu/server/`` bypasses the single-writer funnel and reintroduces the
+multi-writer SQLite contention the actor exists to remove.
+
+Mutating methods are discovered from ``server/db.py`` itself: a ``Db``
+method whose body references ``self._txn`` (the write-transaction context
+manager), transitively closed over same-class method calls. Sanctioned init
+paths (crash recovery before the writer starts) carry an inline
+``# nicelint: allow W1 (reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from nice_tpu.analysis import astutil
+from nice_tpu.analysis.core import Project, Violation, rule
+
+DB_PATH = "nice_tpu/server/db.py"
+WRITER_PATH = "nice_tpu/server/writer.py"
+SERVER_PREFIX = "nice_tpu/server/"
+
+# Call targets whose function-valued arguments run on the writer thread.
+DISPATCH_SUFFIXES = (".call", ".submit", ".write", ".add_periodic")
+
+
+def mutating_db_methods(project: Project) -> Set[str]:
+    db = project.get(DB_PATH)
+    if db is None or db.tree() is None:
+        return set()
+    methods: Dict[str, ast.AST] = {}
+    for node in ast.walk(db.tree()):
+        if isinstance(node, ast.ClassDef) and node.name == "Db":
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[item.name] = item
+    mutating = {
+        name for name, fn in methods.items()
+        if any(
+            astutil.dotted(n) == "self._txn"
+            for n in ast.walk(fn) if isinstance(n, (ast.Attribute,))
+        )
+    }
+    # Transitive closure: a method that calls a mutating method mutates.
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in methods.items():
+            if name in mutating:
+                continue
+            if astutil.local_call_targets(fn) & mutating:
+                mutating.add(name)
+                changed = True
+    return mutating
+
+
+def _writer_context_functions(tree: ast.AST) -> Set[str]:
+    """Unqualified names of functions this module hands to the writer
+    actor, transitively closed over same-module calls."""
+    seeds: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if not name or not name.endswith(DISPATCH_SUFFIXES):
+            continue
+        for arg in node.args:
+            target = astutil.dotted(arg)
+            if target:
+                seeds.add(target.rsplit(".", 1)[-1])
+    if not seeds:
+        return seeds
+    bodies = [(qn.rsplit(".", 1)[-1], fn)
+              for qn, fn in astutil.iter_functions(tree)]
+    names = {short for short, _ in bodies}
+    changed = True
+    while changed:
+        changed = False
+        for short, fn in bodies:
+            if short in seeds:
+                for callee in astutil.local_call_targets(fn):
+                    if callee in names and callee not in seeds:
+                        seeds.add(callee)
+                        changed = True
+    return seeds
+
+
+def _dispatch_spans(tree: ast.AST) -> List[tuple]:
+    """Line spans of writer-dispatch call expressions: a mutating call
+    lexically inside one (a lambda handed to ctx.write) is sanctioned."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name and name.endswith(DISPATCH_SUFFIXES):
+                spans.append((node.lineno, getattr(node, "end_lineno",
+                                                   node.lineno)))
+    return spans
+
+
+@rule("W1")
+def check(project: Project) -> List[Violation]:
+    mutating = mutating_db_methods(project)
+    if not mutating:
+        return []
+    out: List[Violation] = []
+    for src in project.python_files(SERVER_PREFIX):
+        if src.relpath in (DB_PATH, WRITER_PATH):
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        writer_ctx = _writer_context_functions(tree)
+        spans = _dispatch_spans(tree)
+        enclosing = astutil.enclosing_function_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node)
+            if not name or "." not in name:
+                continue
+            obj, _, method = name.rpartition(".")
+            if method not in mutating:
+                continue
+            # Only db-object receivers: self.db / ctx.db / db / <x>.db
+            if not (obj == "db" or obj.endswith(".db")):
+                continue
+            line = node.lineno
+            if any(a <= line <= b for a, b in spans):
+                continue
+            fn = enclosing.get(line, "")
+            if fn.rsplit(".", 1)[-1] in writer_ctx or \
+                    fn.split(".", 1)[0] in writer_ctx:
+                continue
+            out.append(Violation(
+                "W1", src.relpath, line,
+                f"mutating Db call {name}() outside writer context — "
+                "route through the writer actor (ctx.write / writer.call)",
+                detail=f"{fn or '<module>'}->{method}",
+            ))
+    return out

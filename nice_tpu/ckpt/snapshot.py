@@ -28,6 +28,7 @@ import zlib
 import numpy as np
 
 from nice_tpu import faults
+from nice_tpu.utils import fsio
 
 MAGIC = b"NICECKPT"
 FORMAT_VERSION = 1
@@ -77,23 +78,7 @@ def write_snapshot(path: str, manifest: dict, arrays: dict[str, np.ndarray]) -> 
     if faults.fire("ckpt.write", path=path) == "truncate":
         blob = blob[: len(blob) // 2]
 
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    # fsync the directory so the rename itself survives power loss; skipped
-    # quietly on filesystems that refuse O_RDONLY directory fds.
-    try:
-        dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass
-    return len(blob)
+    return fsio.atomic_write_bytes(path, blob)
 
 
 def read_snapshot(path: str) -> tuple[dict, dict[str, np.ndarray]]:

@@ -43,6 +43,7 @@ from nice_tpu.core.types import (
 )
 from nice_tpu.ops import engine
 from nice_tpu.ops.stride_filter import get_stride_table
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.client")
 
@@ -142,7 +143,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--claim-block",
         type=int,
-        default=int(os.environ.get("NICE_TPU_CLAIM_BLOCK", 1)),
+        default=knobs.CLAIM_BLOCK.get(),
         help="fields per claim round-trip: >1 claims through the block-lease "
         "endpoints (/claim_block, /submit_block) with ONE lease covering the "
         "whole block; 1 = per-field compatibility path. Falls back to "
@@ -198,11 +199,9 @@ def _progress_logger(every_secs: float):
     engine may call it from a pipeline worker thread."""
     if not every_secs or every_secs <= 0:
         return None
-    import threading
-
     t0 = time.monotonic()
     state = {"last": t0}
-    lock = threading.Lock()
+    lock = lockdep.make_lock("client.main.progress_cb.lock")
 
     def cb(done: int, total: int) -> None:
         now = time.monotonic()
@@ -299,9 +298,7 @@ def compile_results(
 
 
 def _prefetch_enabled() -> bool:
-    return os.environ.get("NICE_TPU_PREFETCH", "1").strip().lower() not in (
-        "0", "false", "off"
-    )
+    return knobs.PREFETCH.get_bool()
 
 
 def _warm_field(data: DataToClient, mode: SearchMode, backend: str,

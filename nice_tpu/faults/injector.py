@@ -32,14 +32,13 @@ production code can leave the hooks permanently threaded through.
 from __future__ import annotations
 
 import logging
-import os
 import random
-import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from nice_tpu.obs import flight
 from nice_tpu.obs.series import FAULTS_INJECTED
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.faults")
 
@@ -149,7 +148,7 @@ class FaultPlan:
     concurrently from dispatch, collector, renewer, and server threads."""
 
     def __init__(self, rules: list[_Rule]):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("faults.injector.FaultPlan._lock")
         self.by_site: dict[str, list[_Rule]] = {}
         for r in rules:
             self.by_site.setdefault(r.site, []).append(r)
@@ -173,7 +172,7 @@ class FaultPlan:
 
 _EMPTY = FaultPlan([])
 _plan: Optional[FaultPlan] = None
-_plan_lock = threading.Lock()
+_plan_lock = lockdep.make_lock("faults.injector._plan_lock")
 
 
 def configure(spec: Optional[str] = None, seed: Optional[int] = None) -> None:
@@ -202,8 +201,8 @@ def _active() -> FaultPlan:
     if plan is None:
         with _plan_lock:
             if _plan is None:
-                spec = os.environ.get(ENV_SPEC, "")
-                seed = int(os.environ.get(ENV_SEED, DEFAULT_SEED))
+                spec = knobs.FAULTS.get() or ""
+                seed = knobs.FAULTS_SEED.get(default=DEFAULT_SEED)
                 _plan = (
                     FaultPlan(parse_spec(spec, seed)) if spec.strip() else _EMPTY
                 )

@@ -32,6 +32,7 @@ from nice_tpu.client import api_client
 from nice_tpu.core.types import DataToServer
 from nice_tpu.obs import flight
 from nice_tpu.obs.series import SPOOL_JOURNALED, SPOOL_REPLAYS
+from nice_tpu.utils import fsio
 
 log = logging.getLogger(__name__)
 
@@ -53,12 +54,7 @@ class SubmissionSpool:
     def add(self, data: DataToServer) -> str:
         """Atomically journal a submission; returns the entry path."""
         path = self._path_for(data)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(data.to_json(), f, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        fsio.atomic_write_json(path, data.to_json(), sort_keys=True)
         SPOOL_JOURNALED.inc()
         flight.record("spool", claim=data.claim_id, path=path)
         log.warning(

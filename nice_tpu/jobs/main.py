@@ -51,10 +51,10 @@ def _untrusted_submission_ids(
 
 def run_consensus_for_base(db: Db, base: int) -> int:
     """Returns the number of fields whose canon/check_level changed."""
-    import os
+    from nice_tpu.utils import knobs
 
     changed = 0
-    threshold = float(os.environ.get("NICE_TPU_TRUST_THRESHOLD", 0))
+    threshold = knobs.TRUST_THRESHOLD.get()
     trust_cache: dict = {}
     for field in db.get_fields_with_detailed_submissions(base):
         submissions = db.get_detailed_submissions_by_field(field.field_id)

@@ -22,6 +22,8 @@ import subprocess
 import threading
 from functools import lru_cache
 
+from nice_tpu.utils import lockdep
+
 log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -30,7 +32,7 @@ _LIB = os.path.join(_HERE, "libnice_native.so")
 _U64 = ctypes.c_uint64
 _MASK64 = (1 << 64) - 1
 
-_build_lock = threading.Lock()
+_build_lock = lockdep.make_lock("native._build_lock")
 
 
 def _build() -> bool:

@@ -27,7 +27,6 @@ wins, and a crash-looping client cannot fill the disk with dumps.
 from __future__ import annotations
 
 import collections
-import json
 import logging
 import os
 import signal
@@ -37,7 +36,6 @@ import threading
 import time
 from typing import Optional
 
-from . import metrics
 
 log = logging.getLogger("nice_tpu.obs")
 
@@ -46,31 +44,13 @@ __all__ = ["FlightRecorder", "RECORDER", "record", "snapshot", "dump",
 
 DEFAULT_CAPACITY = 512
 
-FLIGHT_EVENTS = metrics.counter(
-    "nice_flight_events_total",
-    "Structured events appended to the in-process flight-recorder ring, "
-    "by kind.",
-    labelnames=("kind",),
-)
-FLIGHT_DUMPS = metrics.counter(
-    "nice_flight_dumps_total",
-    "Flight-recorder ring dumps written to disk, by trigger reason.",
-    labelnames=("reason",),
-)
+from nice_tpu.utils import fsio, knobs, lockdep
 
-# Kinds the production hooks emit, pre-seeded so a scrape of a clean process
-# shows the series at zero (registry convention, see obs/series.py).
-_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint", "restore",
-                "downgrade", "spool", "quarantine", "submit", "claim",
-                "crash", "telemetry",
-                # elastic mesh + trust state transitions (PR 8 / PR 9 sites)
-                # and SLO alerting — a post-crash dump must explain them.
-                "mesh_reshard", "device_loss", "spot_check_fail",
-                "trust_slash", "consensus_hold", "slo_transition")
-for _k in _KNOWN_KINDS:
-    FLIGHT_EVENTS.labels(_k)
-for _r in ("crash", "sigusr2", "quarantine", "manual"):
-    FLIGHT_DUMPS.labels(_r)
+from .series import (  # declared centrally (M1)
+    FLIGHT_DUMPS,
+    FLIGHT_EVENTS,
+    FLIGHT_KNOWN_KINDS as _KNOWN_KINDS,
+)
 
 
 class FlightRecorder:
@@ -79,7 +59,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = capacity
         self._events: collections.deque = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.flight.FlightRecorder._lock")
         self._seq = 0
 
     def record(self, kind: str, **fields) -> None:
@@ -106,9 +86,7 @@ class FlightRecorder:
         write failed — dumping must never take the process down with it)."""
         events = self.snapshot()
         if path is None:
-            out_dir = os.environ.get(
-                "NICE_TPU_FLIGHT_DIR", tempfile.gettempdir()
-            )
+            out_dir = knobs.FLIGHT_DIR.get() or tempfile.gettempdir()
             try:
                 os.makedirs(out_dir, exist_ok=True)
             except OSError:
@@ -125,19 +103,10 @@ class FlightRecorder:
             "capacity": self.capacity,
             "events": events,
         }
-        tmp = f"{path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(payload, f, default=repr)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            fsio.atomic_write_json(path, payload, default=repr)
         except OSError as exc:
             log.warning("flight-recorder dump to %s failed: %s", path, exc)
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
             return None
         FLIGHT_DUMPS.labels(reason).inc()
         log.info("flight recorder dumped %d events to %s (reason=%s)",
@@ -147,9 +116,7 @@ class FlightRecorder:
 
 def _capacity() -> int:
     try:
-        return max(
-            16, int(os.environ.get("NICE_TPU_FLIGHT_EVENTS", DEFAULT_CAPACITY))
-        )
+        return max(16, knobs.FLIGHT_EVENTS.get(default=DEFAULT_CAPACITY))
     except ValueError:
         return DEFAULT_CAPACITY
 
@@ -161,7 +128,7 @@ snapshot = RECORDER.snapshot
 dump = RECORDER.dump
 
 _installed = False
-_install_lock = threading.Lock()
+_install_lock = lockdep.make_lock("obs.flight._install_lock")
 
 
 def install() -> None:

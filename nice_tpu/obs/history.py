@@ -31,13 +31,13 @@ into the ``metric_history`` table (``HistoryStore.drain_rows()`` +
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 import urllib.parse
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import metrics as metrics_mod
+from nice_tpu.utils import knobs, lockdep
 
 __all__ = [
     "TieredSeries",
@@ -53,9 +53,9 @@ TIERS = ("raw", "1m", "15m")
 # Per-tier point capacities: ~1 h of raw at 15 s, ~6 h of 1-min, ~7 d of
 # 15-min. All three are small fixed rings — a process that runs forever
 # holds a bounded history.
-RAW_CAP = int(os.environ.get("NICE_TPU_HISTORY_RAW_CAP", "240"))
-TIER1_CAP = int(os.environ.get("NICE_TPU_HISTORY_1M_CAP", "360"))
-TIER2_CAP = int(os.environ.get("NICE_TPU_HISTORY_15M_CAP", "672"))
+RAW_CAP = knobs.HISTORY_RAW_CAP.get()
+TIER1_CAP = knobs.HISTORY_1M_CAP.get()
+TIER2_CAP = knobs.HISTORY_15M_CAP.get()
 
 QUANTILES = ((50, 0.50), (95, 0.95), (99, 0.99))
 
@@ -66,7 +66,7 @@ _PENDING_CAP = 4096
 def sample_interval_secs() -> float:
     """The sampling cadence knob (0 disables the background sampler)."""
     try:
-        return float(os.environ.get("NICE_TPU_HISTORY_SECS", "15"))
+        return knobs.HISTORY_SECS.get()
     except ValueError:
         return 15.0
 
@@ -75,11 +75,11 @@ def _tier_secs() -> Tuple[float, float]:
     """Coarse-tier bucket widths; env-scalable so short harness runs (the
     perf gate) can exercise real bucket rollover in seconds."""
     try:
-        t1 = float(os.environ.get("NICE_TPU_HISTORY_1M_SECS", "60"))
+        t1 = knobs.HISTORY_1M_SECS.get()
     except ValueError:
         t1 = 60.0
     try:
-        t2 = float(os.environ.get("NICE_TPU_HISTORY_15M_SECS", "900"))
+        t2 = knobs.HISTORY_15M_SECS.get()
     except ValueError:
         t2 = 900.0
     return max(t1, 1e-6), max(t2, 1e-6)
@@ -213,7 +213,7 @@ class HistoryStore:
         t1, t2 = _tier_secs()
         self._t1 = tier1_secs if tier1_secs is not None else t1
         self._t2 = tier2_secs if tier2_secs is not None else t2
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.history.HistoryStore._lock")
         self._series: Dict[str, TieredSeries] = {}
         # Previous histogram bucket snapshots, for windowed quantiles.
         self._hist_prev: Dict[str, Tuple[Tuple[int, ...], float, int]] = {}
@@ -314,7 +314,7 @@ class HistoryStore:
 
 STORE = HistoryStore()
 
-_sampler_lock = threading.Lock()
+_sampler_lock = lockdep.make_lock("obs.history._sampler_lock")
 _sampler_started = False
 
 

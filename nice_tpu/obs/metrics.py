@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
+from nice_tpu.utils import lockdep
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -72,7 +74,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.metrics._Metric._lock")
 
     def _key(self, labelvalues: Sequence[str]) -> LabelKey:
         vals = tuple(str(v) for v in labelvalues)
@@ -302,7 +304,7 @@ class Registry:
     match)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.metrics.Registry._lock")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name, help_, labelnames, **kwargs):

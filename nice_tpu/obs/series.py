@@ -486,3 +486,35 @@ for _from, _to in (("pallas", "jnp"), ("jnp", "scalar")):
 for _slo in ("claim_p99", "submit_success", "feed_idle_p95",
              "spot_check_fail"):
     SLO_STATE.labels(_slo)
+
+# Flight-recorder + tracing series (M1: declared here, used by obs.flight /
+# obs.trace). Kinds the production hooks emit are pre-seeded so a scrape of
+# a clean process shows the series at zero.
+FLIGHT_EVENTS = metrics.counter(
+    "nice_flight_events_total",
+    "Structured events appended to the in-process flight-recorder ring, "
+    "by kind.",
+    labelnames=("kind",),
+)
+FLIGHT_DUMPS = metrics.counter(
+    "nice_flight_dumps_total",
+    "Flight-recorder ring dumps written to disk, by trigger reason.",
+    labelnames=("reason",),
+)
+TRACE_SPAN_SECONDS = metrics.histogram(
+    "nice_trace_span_seconds",
+    "Wall-clock duration of named trace spans.",
+    labelnames=("span",),
+)
+FLIGHT_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint",
+                      "restore", "downgrade", "spool", "quarantine",
+                      "submit", "claim", "crash", "telemetry",
+                      # elastic mesh + trust state transitions (PR 8 / PR 9
+                      # sites) and SLO alerting — a post-crash dump must
+                      # explain them.
+                      "mesh_reshard", "device_loss", "spot_check_fail",
+                      "trust_slash", "consensus_hold", "slo_transition")
+for _kind in FLIGHT_KNOWN_KINDS:
+    FLIGHT_EVENTS.labels(_kind)
+for _reason in ("crash", "sigusr2", "quarantine", "manual"):
+    FLIGHT_DUMPS.labels(_reason)

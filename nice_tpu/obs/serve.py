@@ -22,10 +22,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from . import flight, history, metrics, series
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.obs")
 
-_started_lock = threading.Lock()
+_started_lock = lockdep.make_lock("obs.serve._started_lock")
 _started: Optional[ThreadingHTTPServer] = None
 
 
@@ -87,7 +88,7 @@ def maybe_serve_metrics() -> Optional[ThreadingHTTPServer]:
     (0 = pick a free port). Idempotent per process; a busy port logs a
     warning instead of raising."""
     global _started
-    raw = os.environ.get("NICE_TPU_METRICS_PORT", "")
+    raw = knobs.METRICS_PORT.raw() or ""
     if not raw:
         return None
     with _started_lock:

@@ -29,27 +29,23 @@ block is surfaced in ``/status`` for the fleet.html alerts strip.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable, Dict, List, Optional
 
 from . import flight
 from .history import HistoryStore
+from nice_tpu.utils import knobs, lockdep
 
 __all__ = ["SloSpec", "SloEngine", "default_specs", "STATE_LEVELS"]
 
 STATE_LEVELS = {"ok": 0, "warn": 1, "page": 2}
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-
-
 def window_scale() -> float:
-    return max(_env_float("NICE_TPU_SLO_WINDOW_SCALE", 1.0), 1e-6)
+    try:
+        return max(knobs.SLO_WINDOW_SCALE.get(), 1e-6)
+    except (TypeError, ValueError):
+        return 1.0
 
 
 class SloSpec:
@@ -80,11 +76,14 @@ class SloSpec:
         self.label_filter = label_filter
         self.bad_filter = bad_filter
         env = name.upper()
-        self.threshold = _env_float(
+        self.threshold = knobs.SLO_OVERRIDES.get_float(
             f"NICE_TPU_SLO_{env}_THRESHOLD", threshold
         )
         self.objective = max(
-            _env_float(f"NICE_TPU_SLO_{env}_OBJECTIVE", objective), 1e-9
+            knobs.SLO_OVERRIDES.get_float(
+                f"NICE_TPU_SLO_{env}_OBJECTIVE", objective
+            ),
+            1e-9,
         )
         self.short_secs = short_secs
         self.long_secs = long_secs
@@ -205,7 +204,7 @@ class SloEngine:
                  specs: Optional[List[SloSpec]] = None):
         self.store = store
         self.specs = specs if specs is not None else default_specs()
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("obs.slo.SloEngine._lock")
         self._states: Dict[str, str] = {}
         self._last: List[dict] = []
         self.transitions = 0

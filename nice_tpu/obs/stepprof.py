@@ -37,10 +37,11 @@ diffs per mode.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, Optional
+
+from nice_tpu.utils import knobs, lockdep
 
 __all__ = [
     "PHASES",
@@ -62,7 +63,7 @@ PHASES = (
     "host_other",     # wall - sum(above): host loop, slicing, bookkeeping
 )
 
-_state_lock = threading.Lock()
+_state_lock = lockdep.make_lock("obs.stepprof._state_lock")
 _fence_count = 0
 _cumulative: Dict[str, Dict[str, float]] = {}
 LAST_BREAKDOWN: Dict[str, object] = {}
@@ -72,9 +73,7 @@ _tls = threading.local()
 
 def enabled() -> bool:
     """Read the knob at call time (not import) so tests/bench can flip it."""
-    return os.environ.get("NICE_TPU_STEPPROF", "0").strip().lower() in (
-        "1", "true", "on", "yes"
-    )
+    return knobs.STEPPROF.get_bool()
 
 
 def fence_count() -> int:
@@ -129,7 +128,7 @@ class StepProfiler:
             enabled_override
         )
         self._buckets = {p: 0.0 for p in PHASES} if self.enabled else None
-        self._lock = threading.Lock() if self.enabled else None
+        self._lock = lockdep.make_lock("obs.stepprof.StepProfile._lock") if self.enabled else None
         self._t_start = time.perf_counter() if self.enabled else 0.0
         self._finished = False
 

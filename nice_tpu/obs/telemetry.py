@@ -25,12 +25,13 @@ import time
 from typing import Optional
 
 from . import series
+from nice_tpu.utils import lockdep
 
 __all__ = ["snapshot", "client_id", "SNAPSHOT_VERSION"]
 
 SNAPSHOT_VERSION = 1
 
-_lock = threading.Lock()
+_lock = lockdep.make_lock("obs.telemetry._lock")
 _prev_numbers = 0.0
 _prev_time: Optional[float] = None
 

@@ -46,7 +46,6 @@ import threading
 import time
 from typing import Optional
 
-from . import metrics
 
 __all__ = [
     "span",
@@ -61,15 +60,12 @@ __all__ = [
     "parse_traceparent",
 ]
 
-SPAN_SECONDS = metrics.histogram(
-    "nice_trace_span_seconds",
-    "Wall-clock duration of named trace spans.",
-    labelnames=("span",),
-)
+from .series import TRACE_SPAN_SECONDS as SPAN_SECONDS  # declared centrally (M1)
+from nice_tpu.utils import knobs, lockdep
 
 DEFAULT_MAX_SINK_BYTES = 64 * 1024 * 1024
 
-_lock = threading.Lock()
+_lock = lockdep.make_lock("obs.trace._lock")
 _sink_env: Optional[str] = None
 _sink: Optional[io.TextIOBase] = None
 _sink_bytes = 0  # current file-sink size (tracked to trigger rotation)
@@ -131,16 +127,14 @@ def current_traceparent() -> Optional[str]:
 
 def _max_sink_bytes() -> int:
     try:
-        return int(
-            os.environ.get("NICE_TPU_TRACE_MAX_BYTES", DEFAULT_MAX_SINK_BYTES)
-        )
+        return knobs.TRACE_MAX_BYTES.get(default=DEFAULT_MAX_SINK_BYTES)
     except ValueError:
         return DEFAULT_MAX_SINK_BYTES
 
 
 def _get_sink() -> Optional[io.TextIOBase]:
     global _sink_env, _sink, _sink_bytes
-    env = os.environ.get("NICE_TPU_TRACE", "")
+    env = knobs.TRACE.get() or ""
     with _lock:
         if env == _sink_env:
             return _sink
@@ -157,6 +151,7 @@ def _get_sink() -> Optional[io.TextIOBase]:
             _sink = sys.stderr
         else:
             try:
+                # nicelint: allow A1 (streaming append-only trace sink)
                 _sink = open(env, "a", encoding="utf-8")
                 _sink_bytes = os.path.getsize(env)
             except OSError as exc:
@@ -179,6 +174,7 @@ def _rotate_locked() -> None:
     except OSError:
         pass  # rotation is best-effort; keep appending to the same file
     try:
+        # nicelint: allow A1 (streaming append-only trace sink)
         _sink = open(path, "a", encoding="utf-8")
         _sink_bytes = 0
     except OSError as exc:
@@ -304,7 +300,7 @@ def profiler(name: str):
     """Opt-in jax.profiler capture: active only when NICE_TPU_PROFILE names
     an output directory. Degrades to a no-op (with one warning) when jax or
     its profiler is unavailable."""
-    out_dir = os.environ.get("NICE_TPU_PROFILE", "")
+    out_dir = knobs.PROFILE.get() or ""
     if not out_dir:
         yield
         return

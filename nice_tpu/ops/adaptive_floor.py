@@ -25,7 +25,8 @@ NICE_GPU_MSD_FLOOR).
 from __future__ import annotations
 
 import os
-import threading
+
+from nice_tpu.utils import knobs, lockdep
 
 # Below ~250 the device receives virtually the dense range; the cap exists
 # only to bound descriptor-span growth (the reference sweep shows survival
@@ -71,7 +72,7 @@ class AdaptiveFloor:
     """Per-process controller; thread-safe (client workers share one)."""
 
     def __init__(self, pinned: int | None = None, seed: int | None = None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("ops.adaptive_floor.AdaptiveFloor._lock")
         self.pinned = pinned is not None
         if pinned is not None:
             self.floor = float(max(1, pinned))
@@ -140,7 +141,7 @@ class AdaptiveFloor:
 
 
 _CONTROLLERS: dict[str, AdaptiveFloor] = {}
-_CONTROLLERS_LOCK = threading.Lock()
+_CONTROLLERS_LOCK = lockdep.make_lock("ops.adaptive_floor._CONTROLLERS_LOCK")
 
 
 def get_floor_controller(pipeline: str = "strided") -> AdaptiveFloor:
@@ -153,7 +154,7 @@ def get_floor_controller(pipeline: str = "strided") -> AdaptiveFloor:
     with _CONTROLLERS_LOCK:
         ctrl = _CONTROLLERS.get(pipeline)
         if ctrl is None:
-            raw = os.environ.get("NICE_TPU_MSD_FLOOR")
+            raw = knobs.MSD_FLOOR.raw()
             pinned = None
             if raw:
                 try:

@@ -32,11 +32,10 @@ import json
 import os
 import subprocess
 import sys
-import tempfile
-import threading
 from pathlib import Path
 
 from nice_tpu.obs.series import AUTOTUNE_EVENTS
+from nice_tpu.utils import fsio, knobs, lockdep
 
 # Knob -> operator env-var pin. The same vars steer scripts/tune_kernels.py
 # configs, so the sweep exercises exactly the precedence path it tunes.
@@ -46,7 +45,7 @@ ENV_VARS = {
     "carry_interval": "NICE_TPU_CARRY_INTERVAL",
 }
 
-_lock = threading.Lock()
+_lock = lockdep.make_lock("ops.autotune._lock")
 _cache: dict = {"path": None, "mtime": None, "table": None}
 
 
@@ -54,7 +53,7 @@ def winners_path() -> Path:
     """Where the winners table lives: NICE_TPU_AUTOTUNE_FILE wins; else
     beside the persistent compile cache (JAX_COMPILATION_CACHE_DIR); else a
     per-user cache dir (same fallback family as the compile cache docs)."""
-    p = os.environ.get("NICE_TPU_AUTOTUNE_FILE")
+    p = knobs.AUTOTUNE_FILE.get()
     if p:
         return Path(p)
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
@@ -133,7 +132,7 @@ def choose(mode: str, base: int, backend: str, param: str, default: int) -> int:
     """One knob under the env > tuned > default precedence (see module doc)."""
     env = ENV_VARS.get(param)
     if env:
-        raw = os.environ.get(env)
+        raw = knobs.lookup(env).raw()
         if raw:
             AUTOTUNE_EVENTS.labels("env_override").inc()
             return int(raw)
@@ -169,17 +168,9 @@ def record(mode: str, base: int, backend: str, new_params: dict,
             if isinstance(v, (int, float))
         }
     table[key(mode, base, backend)] = entry
-    fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(table, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    # A1: fsync-before-rename via the shared helper (the old mkstemp path
+    # skipped the fsync, so a crash could publish a truncated table).
+    fsio.atomic_write_json(str(path), table, indent=1, sort_keys=True)
     AUTOTUNE_EVENTS.labels("store").inc()
     reset_for_tests()  # next lookup re-reads the fresh file
     return path

@@ -32,8 +32,9 @@ import time
 
 from nice_tpu.obs import stepprof
 from nice_tpu.obs.series import COMPILE_CACHE_EVENTS
+from nice_tpu.utils import lockdep
 
-_lock = threading.Lock()
+_lock = lockdep.make_lock("ops.compile_cache._lock")
 _setup_done = [False]
 _executables: dict = {}
 

@@ -40,6 +40,7 @@ from nice_tpu.ops import pallas_engine as pe
 from nice_tpu.ops import scalar
 from nice_tpu.ops.limbs import get_plan, int_to_limbs, ints_to_limbs
 from nice_tpu.ops import vector_engine as ve
+from nice_tpu.utils import knobs
 from nice_tpu.obs.series import (
     CKPT_BATCHES_SKIPPED,
     CKPT_RESTORES,
@@ -185,11 +186,11 @@ class _CkptTicker:
     def __init__(self, every_batches=None, every_secs=None):
         self.every_batches = int(
             every_batches if every_batches is not None
-            else os.environ.get("NICE_TPU_CKPT_BATCHES", CKPT_EVERY_BATCHES)
+            else knobs.CKPT_BATCHES.get(default=CKPT_EVERY_BATCHES)
         )
         self.every_secs = float(
             every_secs if every_secs is not None
-            else os.environ.get("NICE_TPU_CKPT_SECS", CKPT_EVERY_SECS)
+            else knobs.CKPT_SECS.get(default=CKPT_EVERY_SECS)
         )
         self._batches = 0
         self._last = time.monotonic()
@@ -263,7 +264,7 @@ _FALLBACK_NEXT = {"pallas": "jnp", "jnp": "scalar"}
 
 
 def _fallback_enabled() -> bool:
-    return os.environ.get("NICE_TPU_NO_FALLBACK", "") != "1"
+    return not knobs.NO_FALLBACK.get_bool()
 
 
 def _fire_dispatch_fault(n_batch: int, backend: str, batch_start: int) -> None:
@@ -352,11 +353,9 @@ def _mesh_or_none():
     device and psum the stats over ICI (P8). The mesh (and the jitted sharded
     steps keyed on it) are cached so repeated process_range_* calls never
     retrace."""
-    import os
-
     import jax
 
-    if os.environ.get("NICE_TPU_SHARD", "1") == "0":
+    if not knobs.SHARD.get_bool():
         return None
     from nice_tpu.parallel import mesh as pmesh
 
@@ -398,7 +397,7 @@ FEED_DEPTH_DEFAULT = 2
 
 def _feed_depth() -> int:
     try:
-        d = int(os.environ.get("NICE_TPU_FEED_DEPTH", FEED_DEPTH_DEFAULT))
+        d = knobs.FEED_DEPTH.get(default=FEED_DEPTH_DEFAULT)
     except ValueError:
         d = FEED_DEPTH_DEFAULT
     return max(0, min(64, d))
@@ -408,7 +407,7 @@ def _elastic_enabled() -> bool:
     """Elastic mesh downshift (reshard onto survivors when a device drops
     mid-field) is on by default; NICE_TPU_ELASTIC=0 restores the PR 4
     behavior of degrading the whole field down the backend chain."""
-    return os.environ.get("NICE_TPU_ELASTIC", "1") != "0"
+    return knobs.ELASTIC.get_bool()
 
 
 # Feed/reshard stats of the most recent device dispatch loop, read by the
@@ -419,6 +418,7 @@ LAST_FEED_STATS: dict = {}
 
 def _record_feed_stats(mode, gaps, dispatches, n_dev_start, n_dev_end,
                        reshards, reshard_secs, depth) -> None:
+    # nicelint: allow D1 (gaps is a host-side list of floats)
     g = np.asarray(gaps, dtype=np.float64)
     LAST_FEED_STATS.clear()
     LAST_FEED_STATS.update({
@@ -673,11 +673,14 @@ def _rare_scan_survivors(plan, batch_start: int, valid: int, batch_size: int,
         count, idx, uniq = mod.survivors_batch(
             plan, sub_size, thresh, cap, start_limbs, np.int32(sub_valid),
         )
+        # nicelint: fence (survivor-count readback; metered below)
         count = int(np.asarray(count))
         if count == 0:
             ENGINE_READBACK_BYTES.labels("survivors").inc(4)
         elif count <= cap:
+            # nicelint: fence (compacted survivor index readback)
             idx = np.asarray(idx)
+            # nicelint: fence (compacted unique-count readback)
             uniq = np.asarray(uniq)
             ENGINE_READBACK_BYTES.labels("survivors").inc(
                 4 + idx.nbytes + uniq.nbytes
@@ -686,6 +689,7 @@ def _rare_scan_survivors(plan, batch_start: int, valid: int, batch_size: int,
                 yield sub_start + i, u
         else:
             ENGINE_SURVIVOR_OVERFLOW.inc()
+            # nicelint: fence (dense unique readback on overflow)
             u = np.asarray(mod.uniques_batch(plan, sub_size, start_limbs))
             ENGINE_READBACK_BYTES.labels("survivors-dense").inc(4 + u.nbytes)
             u = u[:sub_valid]
@@ -757,6 +761,7 @@ def _chunked_host_scan(
         if detailed:
             if resume.get("hist") is None:
                 raise ValueError("detailed resume state is missing a histogram")
+            # nicelint: allow D1 (resume histogram arrives as host JSON)
             h = np.asarray(resume["hist"], dtype=np.int64)
             if h.shape != hist.shape:
                 raise ValueError(
@@ -866,6 +871,7 @@ def _native_detailed(
                     "use backend='scalar'"
                 )
             sub_hist, misses = res
+            # nicelint: fence (per-subrange histogram fold to host)
             np.add(hist, np.asarray(sub_hist, dtype=np.int64), out=hist)
             nice_numbers.extend(
                 NiceNumberSimple(number=n, num_uniques=u) for n, u in misses
@@ -983,11 +989,9 @@ HOST_NICEONLY_MAX = 1 << 25
 
 
 def _host_route_niceonly(core: FieldSize, base: int) -> bool:
-    import os
-
     from nice_tpu import native
 
-    limit = int(os.environ.get("NICE_TPU_HOST_NICEONLY_MAX", HOST_NICEONLY_MAX))
+    limit = knobs.HOST_NICEONLY_MAX_KNOB.get(default=HOST_NICEONLY_MAX)
     if core.size() > limit or not native.available():
         return False
     # Mirror of the native fast-path eligibility (nice_native.cpp): candidate
@@ -1333,8 +1337,10 @@ def warm_niceonly(base: int, field_size: int = 0, field_start: int | None = None
         return
     packed = np.zeros((s.desc_max * s.n_dev, 12), dtype=np.uint32)
     if s.sharded_step is not None:
+        # nicelint: fence (warm-up: force compile + first step)
         np.asarray(s.sharded_step(packed, np.zeros(s.n_dev, dtype=np.int32)))
     else:
+        # nicelint: fence (warm-up: force compile + first step)
         np.asarray(
             pe.niceonly_strided_batch(
                 s.plan, s.spec, packed, periods=s.periods, n_real=0
@@ -1591,9 +1597,7 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None,
     def _at(cols, j: int, g: int) -> int:
         return int(cols[2 * j][g]) | (int(cols[2 * j + 1][g]) << 64)
 
-    import os
-
-    audit_every = int(os.environ.get("NICE_TPU_AUDIT_EVERY", STRIDE_AUDIT_EVERY))
+    audit_every = knobs.AUDIT_EVERY.get(default=STRIDE_AUDIT_EVERY)
     audit_seen = [0]  # zero-count descriptors seen so far (audit phase)
     ticker = (
         _CkptTicker(checkpoint_batches, checkpoint_secs)
@@ -1603,6 +1607,7 @@ def _niceonly_pallas(core: FieldSize, base: int, progress=None,
     def collect_item(cols, counts_dev):
         # Per-device (8, 128) tiles: descriptor (dev d, local i) count lands
         # flat at [d, i] after collapsing each device's tile.
+        # nicelint: fence (descriptor-count tile readback)
         counts = np.asarray(counts_dev).reshape(n_dev, -1)
         k = len(cols[0])
         flat = counts[:, :desc_max].reshape(-1)[:k]
@@ -1886,6 +1891,7 @@ def _process_range_detailed(
 
             def fold_np(acc_):
                 # ONE psum per field/flush, off the dispatch thread.
+                # nicelint: fence (single psum readback per field/flush)
                 return np.asarray(fold(acc_), dtype=np.int64)[: plan.base + 2]
         else:
             # Tuned shape knobs apply on the single-device path; the sharded
@@ -1904,6 +1910,7 @@ def _process_range_detailed(
                 return np.zeros(plan.base + 2, dtype=np.int32)
 
             def fold_np(acc_):
+                # nicelint: fence (accumulator readback at fold time)
                 return np.asarray(acc_, dtype=np.int64)[: plan.base + 2]
 
         return disp, mk_acc, fold_np
@@ -1918,6 +1925,7 @@ def _process_range_detailed(
     if resume is not None:
         if resume.get("hist") is None:
             raise ValueError("detailed resume state is missing a histogram")
+        # nicelint: allow D1 (resume histogram arrives as host JSON)
         h = np.asarray(resume["hist"], dtype=np.int64)
         if h.shape != hist.shape:
             raise ValueError(
@@ -1956,6 +1964,7 @@ def _process_range_detailed(
         if kind == "nm":
             segs, nm = payload
             ENGINE_READBACK_BYTES.labels("nm").inc(4)
+            # nicelint: fence (nm flag readback gates the rare path)
             if int(np.asarray(nm)) > 0:
                 # Rare path: compacted survivor extraction, per slice seg.
                 for seg_start, seg_valid in segs:
@@ -2499,6 +2508,7 @@ def _process_range_niceonly(
         if kind == "count":
             segs, count = payload
             ENGINE_READBACK_BYTES.labels("count").inc(4)
+            # nicelint: fence (count flag readback gates extraction)
             if int(np.asarray(count)) > 0:
                 # uniques > base-1 <=> == base: compacted nice extraction,
                 # per slice seg.

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nice_tpu.obs.series import MESH_DEVICES, MESH_DISPATCH_SECONDS
 from nice_tpu.ops import vector_engine as ve
 from nice_tpu.ops.limbs import BasePlan
+from nice_tpu.utils import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +48,7 @@ class MeshDeviceLost(RuntimeError):
 
 # --- device liveness (real probes + simulated loss for chaos tests) -------
 
-_dead_lock = threading.Lock()
+_dead_lock = lockdep.make_lock("parallel.mesh._dead_lock")
 _simulated_dead: set[int] = set()
 
 
@@ -84,6 +85,7 @@ def probe_devices(devices) -> tuple[list, list]:
             continue
         try:
             x = jax.device_put(np.ones((), dtype=np.int32), d) + 1
+            # nicelint: fence (probe readback proves the device computes)
             if int(np.asarray(x)) != 2:
                 raise RuntimeError("device probe computed garbage")
             alive.append(d)
@@ -104,7 +106,7 @@ def mesh_device_ids(mesh: Mesh) -> tuple[int, ...]:
 # can be evicted on downshift instead of pinning the dead Mesh (and its
 # compiled executables) for the life of the process.
 
-_step_lock = threading.Lock()
+_step_lock = lockdep.make_lock("parallel.mesh._step_lock")
 _STEP_CACHE: dict = {}
 
 
@@ -202,7 +204,7 @@ def _shard_map(f, mesh: Mesh, in_specs, out_specs):
 # classic collective deadlock (observed on the 8-virtual-device CPU mesh).
 # Holding the lock across the jit call makes the cross-device enqueue order
 # consistent; execution itself stays async and overlapped.
-_DISPATCH_LOCK = threading.RLock()
+_DISPATCH_LOCK = lockdep.make_rlock("parallel.mesh._DISPATCH_LOCK")
 
 
 def _timed_step(fn, mode: str):
@@ -230,6 +232,7 @@ def make_mesh(devices=None) -> Mesh:
     """1-D mesh over all (or given) devices; the axis shards the number line."""
     devices = devices if devices is not None else jax.devices()
     MESH_DEVICES.set(len(devices))
+    # nicelint: allow D1 (host-side device list, no transfer)
     return Mesh(np.asarray(devices), (FIELD_AXIS,))
 
 

@@ -82,6 +82,7 @@ from nice_tpu.server.async_core import (
 from nice_tpu.server.db import Db
 from nice_tpu.server.field_queue import U128_MAX, FieldQueue
 from nice_tpu.server.writer import DirectWriter, WriteActor
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.server")
 
@@ -155,13 +156,14 @@ class ApiContext:
         # telemetry upserts) is enqueued here and coalesced into batched
         # transactions. NICE_TPU_WRITER=0 falls back to direct per-call
         # transactions (useful for debugging; semantics are identical).
-        if os.environ.get("NICE_TPU_WRITER", "1") != "0":
+        if knobs.WRITER.get_bool():
             self.writer = WriteActor(db)
         else:
             self.writer = DirectWriter(db)
         # Crash counterpart of FieldQueue.close(): a SIGKILLed server's
         # in-memory inventory left lease stamps with no claims rows; release
         # them before this process's queue starts bulk-claiming.
+        # nicelint: allow W1 (sanctioned init: crash recovery runs before the writer accepts work)
         orphaned = db.release_orphaned_inventory()
         if orphaned:
             log.info(
@@ -180,34 +182,32 @@ class ApiContext:
         # in-memory cache — it is consulted on the event-loop thread.
         self.trust = trust_mod.TrustStore(db)
         self.limiter = None
-        if os.environ.get("NICE_TPU_RATE_BUCKET"):
+        if knobs.RATE_BUCKET.get():
             self.limiter = TokenBucketLimiter(
                 multiplier=self._bucket_multiplier
             )
         # Lease-expiry sweep: abandoned micro-field claims are released on
         # the writer thread so re-issue never waits out the global claim
         # expiry cutoff. NICE_TPU_LEASE_SWEEP_SECS=0 disables.
-        sweep_secs = float(os.environ.get("NICE_TPU_LEASE_SWEEP_SECS", 5.0))
+        sweep_secs = knobs.LEASE_SWEEP_SECS.get()
         if sweep_secs > 0:
             self.writer.add_periodic(self._sweep_leases, sweep_secs)
         # Overload shed: when more than max_inflight requests are being
         # handled at once, new ones (except /metrics) get 503 + Retry-After
         # instead of queueing unboundedly behind the worker pool. Clients
         # honor the hint in retry_request.
-        self.max_inflight = int(os.environ.get("NICE_TPU_MAX_INFLIGHT", 128))
-        self.retry_after_secs = int(os.environ.get("NICE_TPU_RETRY_AFTER_SECS", 2))
+        self.max_inflight = knobs.MAX_INFLIGHT.get()
+        self.retry_after_secs = knobs.RETRY_AFTER_SECS.get()
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = lockdep.make_lock("server.app.ApiContext._inflight_lock")
         # Read-snapshot cache for the /status fleet block: dashboard polling
         # is served from this instead of re-running the fleet queries every
         # poll. Writes that change what the block reports (submissions,
         # telemetry) invalidate it, so tests and operators never see stale
         # data after their own write.
-        self.status_cache_ttl = float(
-            os.environ.get("NICE_TPU_STATUS_CACHE_SECS", 2.0)
-        )
+        self.status_cache_ttl = knobs.STATUS_CACHE_SECS.get()
         self._status_cache: dict = {}
-        self._status_cache_lock = threading.Lock()
+        self._status_cache_lock = lockdep.make_lock("server.app.ApiContext._status_cache_lock")
         # Performance observatory: one writer-actor periodic samples every
         # nice_* series (process-global registry + this context's private
         # API-latency registry) into the in-memory ring history, persists
@@ -216,10 +216,7 @@ class ApiContext:
         # — they never touch SQLite. NICE_TPU_HISTORY_SECS=0 disables.
         self.history = obs.history.HistoryStore()
         self.slo = obs.slo.SloEngine(self.history)
-        self.history_retention_secs = float(
-            os.environ.get("NICE_TPU_HISTORY_RETENTION_SECS",
-                           7 * 24 * 3600.0)
-        )
+        self.history_retention_secs = knobs.HISTORY_RETENTION_SECS.get()
         self._last_history_prune = time.monotonic()
         history_secs = obs.history.sample_interval_secs()
         if history_secs > 0:
@@ -309,13 +306,13 @@ class ApiError(Exception):
 
 
 def _max_claim_block() -> int:
-    return max(1, int(os.environ.get("NICE_TPU_MAX_CLAIM_BLOCK", 128)))
+    return max(1, knobs.MAX_CLAIM_BLOCK.get())
 
 
 def _untrusted_lease_secs() -> float:
     """Lease window for claims issued to below-threshold clients: short, so
     an abandoner's fields recycle in seconds."""
-    return float(os.environ.get("NICE_TPU_UNTRUSTED_LEASE_SECS", 120))
+    return knobs.UNTRUSTED_LEASE_SECS.get()
 
 
 def _claim_lease_secs(untrusted: bool) -> float:
@@ -324,23 +321,21 @@ def _claim_lease_secs(untrusted: bool) -> float:
     expiry window, untrusted ones the short micro-lease."""
     if untrusted:
         return _untrusted_lease_secs()
-    return float(
-        os.environ.get("NICE_TPU_CLAIM_EXPIRY_SECS", CLAIM_DURATION_HOURS * 3600)
-    )
+    return knobs.CLAIM_EXPIRY_SECS.get(default=CLAIM_DURATION_HOURS * 3600)
 
 
 def _untrusted_max_field() -> int:
     """Range-size cap for untrusted claims (micro-fields): a forged or
     abandoned result costs at most this much honest recomputation."""
-    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_FIELD", 1_000_000))
+    return knobs.UNTRUSTED_MAX_FIELD.get()
 
 
 def _untrusted_max_claims() -> int:
-    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_CLAIMS", 16))
+    return knobs.UNTRUSTED_MAX_CLAIMS.get()
 
 
 def _untrusted_max_claims_per_ip() -> int:
-    return int(os.environ.get("NICE_TPU_UNTRUSTED_MAX_CLAIMS_PER_IP", 256))
+    return knobs.UNTRUSTED_MAX_CLAIMS_PER_IP.get()
 
 
 def _enforce_claim_cap(
@@ -1036,7 +1031,7 @@ def _percentile(sorted_vals: list, q: float) -> float:
 
 
 def fleet_active_secs() -> float:
-    return float(os.environ.get("NICE_TPU_FLEET_ACTIVE_SECS", 900))
+    return knobs.FLEET_ACTIVE_SECS.get()
 
 
 def build_fleet_block(ctx: ApiContext) -> dict:
@@ -1621,7 +1616,7 @@ def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True):
     if prefill:
         ctx.queue.refill_niceonly()
         ctx.queue.refill_detailed_thin()
-    core = os.environ.get("NICE_TPU_SERVER_CORE", "async").lower()
+    core = (knobs.SERVER_CORE.get() or "async").lower()
     if core == "thread":
         server = ThreadingHTTPServer((host, port), make_handler(ctx))
     else:
@@ -1677,6 +1672,7 @@ def main(argv=None) -> int:
     if args.init_base:
         db = Db(args.db)
         for base in args.init_base:
+            # nicelint: allow W1 (sanctioned init: --init-base seeds before the server exists)
             n = db.seed_base(base, args.field_size)
             log.info("seeded base %d with %d fields", base, n)
         db.close()

@@ -21,7 +21,6 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-import os
 import socket
 import threading
 import time
@@ -30,6 +29,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.client import responses as _REASONS
 from typing import Callable, Optional
+
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -63,7 +64,7 @@ class TokenBucketLimiter:
         max_keys: int = 10_000,
         multiplier: Optional[Callable[[str], float]] = None,
     ):
-        spec = os.environ.get("NICE_TPU_RATE_BUCKET", "300:100")
+        spec = knobs.RATE_BUCKET.get() or "300:100"
         cap_s, _, refill_s = spec.partition(":")
         self.capacity = float(capacity if capacity is not None else cap_s or 300)
         self.refill = float(
@@ -72,7 +73,7 @@ class TokenBucketLimiter:
         self.max_keys = max_keys
         self.multiplier = multiplier
         self._buckets: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("server.async_core.TokenBucketLimiter._lock")
 
     @staticmethod
     def classify(path: str) -> str:
@@ -184,9 +185,7 @@ class AsyncHTTPServer:
         )
         self._sock.setblocking(False)
         self.server_address = self._sock.getsockname()[:2]
-        workers = max_workers or int(
-            os.environ.get("NICE_TPU_SERVER_WORKERS", 32)
-        )
+        workers = max_workers or knobs.SERVER_WORKERS.get()
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="nice-srv"
         )
@@ -200,6 +199,9 @@ class AsyncHTTPServer:
 
     def serve_forever(self) -> None:
         asyncio.set_event_loop(self._loop)
+        # Lockdep long-hold attribution: any project lock held too long on
+        # THIS thread starves every open connection at once.
+        lockdep.mark_loop_thread()
         self._started.set()
         try:
             self._loop.run_until_complete(self._main())

@@ -35,6 +35,7 @@ from nice_tpu.obs.series import (
     SERVER_LEASES_EXPIRED,
     SERVER_SQLITE_BUSY_RETRIES,
 )
+from nice_tpu.utils import knobs, lockdep
 from nice_tpu.core.types import (
     ClaimRecord,
     FieldClaimStrategy,
@@ -139,7 +140,7 @@ class Db:
 
     def __init__(self, path: str = None):
         self.path = path or os.environ.get("NICE_DATABASE_PATH", "nice.db")
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_rlock("server.db.Db._lock")
         self._conn = self._connect()  # write connection (claim path)
         # Read pool: one connection per server thread (WAL readers never
         # block each other or the writer), so analytics endpoints and submit
@@ -159,7 +160,7 @@ class Db:
         self._pool: list[tuple[Optional[threading.Thread], sqlite3.Connection]] = [
             (None, self._conn)
         ]
-        self._pool_lock = threading.Lock()
+        self._pool_lock = lockdep.make_lock("server.db.Db._pool_lock")
         self._closed = False
         # Savepoint-nesting depth of the write connection. Only read/written
         # with _lock held (RLock, so nested _txn() blocks on one thread are
@@ -656,11 +657,7 @@ class Db:
         overrides the CLAIM_DURATION_HOURS default so deployments with long
         fields (or aggressive clients) can widen/narrow the window without a
         code change; the active window is surfaced in /metrics."""
-        secs = float(
-            os.environ.get(
-                "NICE_TPU_CLAIM_EXPIRY_SECS", CLAIM_DURATION_HOURS * 3600
-            )
-        )
+        secs = knobs.CLAIM_EXPIRY_SECS.get(default=CLAIM_DURATION_HOURS * 3600)
         SERVER_CLAIM_EXPIRY.set(secs)
         return now_utc() - timedelta(seconds=secs)
 

@@ -12,7 +12,6 @@ pipeline feeding block claims.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from collections import deque
 from typing import Optional
@@ -21,6 +20,7 @@ from nice_tpu.core.constants import DETAILED_SEARCH_MAX_FIELD_SIZE
 from nice_tpu.core.types import FieldRecord
 from nice_tpu.obs.series import SERVER_FIELD_QUEUE_REFILLS
 from nice_tpu.server.db import Db
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -33,7 +33,7 @@ U128_MAX = (1 << 128) - 1
 
 
 def _poll_secs() -> float:
-    return float(os.environ.get("NICE_TPU_QUEUE_POLL_SECS", 5.0))
+    return knobs.QUEUE_POLL_SECS.get()
 
 
 class FieldQueue:
@@ -57,7 +57,7 @@ class FieldQueue:
         self.writer = writer
         self._niceonly: deque[FieldRecord] = deque()
         self._detailed_thin: deque[FieldRecord] = deque()
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("server.field_queue.FieldQueue._lock")
         self._refill_wanted = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -87,6 +87,7 @@ class FieldQueue:
             # Direct DB call on purpose: close() may run after (or during)
             # writer shutdown, and the release must not depend on actor
             # ordering.
+            # nicelint: allow W1 (shutdown path must not depend on writer-actor ordering)
             released = self.db.release_field_claims(stranded)
             log.info(
                 "released %d pre-claimed queue fields back to the DB", released

@@ -34,10 +34,8 @@ deployments keep the original single-submission promotion semantics.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import secrets
-import threading
 from typing import Optional
 
 from nice_tpu.core import number_stats
@@ -45,18 +43,19 @@ from nice_tpu.core.types import NiceNumber, UniquesDistribution
 from nice_tpu.obs.series import SERVER_SPOT_CHECKS
 from nice_tpu.ops import scalar
 from nice_tpu.server.db import Db
+from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.server.trust")
 
 
 def trust_threshold() -> float:
     """Trust score below which a client is untrusted (0 disables gating)."""
-    return float(os.environ.get("NICE_TPU_TRUST_THRESHOLD", 0))
+    return knobs.TRUST_THRESHOLD.get()
 
 
 def spot_rate_floor() -> float:
     """Veteran-client sampling-rate floor (~1% by default)."""
-    return min(1.0, max(0.0, float(os.environ.get("NICE_TPU_SPOT_RATE", 0.01))))
+    return min(1.0, max(0.0, knobs.SPOT_RATE.get()))
 
 
 # Secret per-process default for the spot-check RNG seed. The other seed
@@ -68,12 +67,12 @@ _RUNTIME_SPOT_SEED = secrets.token_hex(16)
 def spot_seed() -> str:
     """NICE_TPU_SPOT_SEED is a TEST override; unset (the production
     default) uses a random secret generated at process start."""
-    return os.environ.get("NICE_TPU_SPOT_SEED") or _RUNTIME_SPOT_SEED
+    return knobs.SPOT_SEED.get() or _RUNTIME_SPOT_SEED
 
 
 def spot_slice_len() -> int:
     """Numbers re-scanned per sampled submission (0 disables spot checks)."""
-    return int(os.environ.get("NICE_TPU_SPOT_SLICE", 256))
+    return knobs.SPOT_SLICE.get()
 
 
 def sample_rate(trust: float) -> float:
@@ -123,7 +122,7 @@ class TrustStore:
     def __init__(self, db: Db):
         self.db = db
         self._cache: dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("server.trust.TrustLedger._lock")
 
     def get(self, client_token: str) -> dict:
         with self._lock:

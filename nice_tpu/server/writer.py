@@ -19,7 +19,6 @@ insert_submission, just via the future.
 from __future__ import annotations
 
 import logging
-import os
 import queue
 import threading
 import time
@@ -31,6 +30,7 @@ from nice_tpu.obs.series import (
     SERVER_WRITER_QUEUE_DEPTH,
 )
 from nice_tpu.server.db import Db
+from nice_tpu.utils import knobs
 
 log = logging.getLogger(__name__)
 
@@ -59,11 +59,9 @@ class WriteActor:
         start: bool = True,
     ):
         self.db = db
-        self.max_batch = max_batch or int(
-            os.environ.get("NICE_TPU_WRITER_MAX_BATCH", 64)
-        )
+        self.max_batch = max_batch or knobs.WRITER_MAX_BATCH.get()
         self.coalesce_secs = (
-            float(os.environ.get("NICE_TPU_WRITER_COALESCE_SECS", 0.002))
+            knobs.WRITER_COALESCE_SECS.get()
             if coalesce_secs is None
             else coalesce_secs
         )

@@ -1,0 +1,610 @@
+"""Central registry of NICE_TPU_* environment knobs.
+
+Every NICE_TPU_* environment variable the project reads is declared here
+exactly once, with its type, canonical default, owning module, and one-line
+doc. Call sites read through the returned :class:`Knob` (``knob.get()``,
+``knob.get_bool()``, ``knob.raw()``) instead of touching ``os.environ``
+directly — the K1 nicelint rule enforces that statically, and
+``docs/KNOBS.md`` plus the README knob tables are generated from this
+catalog (drift is a K1 violation too).
+
+Design constraints:
+
+* **Import-light.** This module imports only the stdlib (``os``), so the
+  jax-free server, conftest (pre-jax), and the analysis suite can all use
+  it freely.
+* **Call-time reads.** ``get()`` consults ``os.environ`` on every call —
+  never caches — because tests monkeypatch the environment mid-process and
+  several knobs are documented as flippable at runtime (NICE_TPU_STEPPROF,
+  NICE_TPU_TRACE).
+* **Behavior-preserving coercion.** ``get()`` coerces exactly like the
+  historical inline ``int(os.environ.get(...))`` sites did (a malformed
+  value raises ValueError); sites that historically guarded with
+  try/except keep their guards around ``get()``. Boolean knobs accept the
+  unified spelling sets ``{"1","true","on","yes"}`` / ``{"0","false",
+  "off","no"}``; a default-on knob stays on for unrecognized values, a
+  default-off knob stays off.
+* **Computed defaults stay at the call site.** A knob whose default is
+  derived from another module's constant (e.g. NICE_TPU_CLAIM_EXPIRY_SECS
+  defaulting to CLAIM_DURATION_HOURS) passes ``default=`` to ``get()``;
+  the registry carries a human-readable ``default_doc`` for the tables.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Knob",
+    "PrefixFamily",
+    "REGISTRY",
+    "PREFIXES",
+    "lookup",
+    "is_declared",
+    "all_knobs",
+    "render_markdown",
+    "render_group_markdown",
+]
+
+_UNSET = object()
+
+_TRUE_SET = ("1", "true", "on", "yes")
+_FALSE_SET = ("0", "false", "off", "no")
+
+
+class Knob:
+    """One declared environment knob. Immutable after registration."""
+
+    __slots__ = ("name", "kind", "default", "doc", "owner", "group",
+                 "default_doc")
+
+    def __init__(self, name: str, kind: str, default: Any, doc: str,
+                 owner: str, group: str, default_doc: Optional[str]):
+        self.name = name
+        self.kind = kind  # "int" | "float" | "str" | "bool" | "spec"
+        self.default = default
+        self.doc = doc
+        self.owner = owner
+        self.group = group
+        self.default_doc = default_doc
+
+    def raw(self) -> Optional[str]:
+        """The uninterpreted environment value (None when unset)."""
+        return os.environ.get(self.name)
+
+    def is_set(self) -> bool:
+        return self.name in os.environ
+
+    def get(self, default: Any = _UNSET) -> Any:
+        """Coerced value: env wins, else ``default`` (call-site override),
+        else the registry default. Coercion errors propagate (ValueError),
+        matching the historical inline-read behavior."""
+        fallback = self.default if default is _UNSET else default
+        value = os.environ.get(self.name)
+        if value is None:
+            return fallback
+        if self.kind == "int":
+            return int(value)
+        if self.kind == "float":
+            return float(value)
+        if self.kind == "bool":
+            return self.get_bool(
+                bool(fallback) if fallback is not None else False
+            )
+        return value
+
+    def get_bool(self, default: Any = _UNSET) -> bool:
+        """Unified boolean parse. The empty string counts as unset, and
+        unrecognized spellings keep the default, so a default-on knob only
+        turns off for an explicit falsy value and vice versa."""
+        fallback = bool(self.default if default is _UNSET else default)
+        value = os.environ.get(self.name)
+        if value is None:
+            return fallback
+        v = value.strip().lower()
+        if v in _TRUE_SET:
+            return True
+        if v in _FALSE_SET:
+            return False
+        return fallback
+
+    @property
+    def default_text(self) -> str:
+        if self.default_doc:
+            return self.default_doc
+        if self.default is None:
+            return "unset"
+        if self.kind == "bool":
+            return "on" if self.default else "off"
+        return repr(self.default).strip("'\"") or '""'
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Knob({self.name}, {self.kind}, default={self.default!r})"
+
+
+class PrefixFamily:
+    """A family of dynamically named knobs sharing a prefix (the per-SLO
+    NICE_TPU_SLO_<NAME>_THRESHOLD / _OBJECTIVE overrides). ``matches``
+    makes the K1 literal check accept any member name."""
+
+    __slots__ = ("prefix", "suffixes", "kind", "doc", "owner", "group")
+
+    def __init__(self, prefix: str, suffixes: tuple, kind: str, doc: str,
+                 owner: str, group: str):
+        self.prefix = prefix
+        self.suffixes = suffixes
+        self.kind = kind
+        self.doc = doc
+        self.owner = owner
+        self.group = group
+
+    def matches(self, name: str) -> bool:
+        return name.startswith(self.prefix) and (
+            not self.suffixes or name.endswith(self.suffixes)
+        )
+
+    def get_float(self, name: str, default: float) -> float:
+        if not self.matches(name):
+            raise KeyError(
+                f"{name} is not a member of knob family {self.prefix}*"
+            )
+        try:
+            return float(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
+    @property
+    def name(self) -> str:
+        suff = "|".join(self.suffixes) if self.suffixes else "*"
+        return f"{self.prefix}<NAME>{{{suff}}}"
+
+
+REGISTRY: Dict[str, Knob] = {}
+PREFIXES: List[PrefixFamily] = []
+
+
+def _k(name: str, kind: str, default: Any, doc: str, *, owner: str,
+       group: str = "general", default_doc: Optional[str] = None) -> Knob:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate knob declaration: {name}")
+    knob = Knob(name, kind, default, doc, owner, group, default_doc)
+    REGISTRY[name] = knob
+    return knob
+
+
+def _family(prefix: str, suffixes: tuple, kind: str, doc: str, *,
+            owner: str, group: str = "general") -> PrefixFamily:
+    fam = PrefixFamily(prefix, suffixes, kind, doc, owner, group)
+    PREFIXES.append(fam)
+    return fam
+
+
+def lookup(name: str) -> Knob:
+    """The declared knob for ``name``; KeyError for undeclared names (the
+    runtime arm of the K1 discipline — dynamic lookups can't bypass the
+    catalog either)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a declared knob; add it to"
+            " nice_tpu/utils/knobs.py"
+        ) from None
+
+
+def is_declared(name: str) -> bool:
+    if name in REGISTRY:
+        return True
+    return any(f.matches(name) for f in PREFIXES)
+
+
+def all_knobs() -> List[Knob]:
+    return sorted(REGISTRY.values(), key=lambda k: (k.group, k.name))
+
+
+# ---------------------------------------------------------------------------
+# The catalog. Grouped the way docs/KNOBS.md renders them.
+# ---------------------------------------------------------------------------
+
+# -- engine / device pipeline (ops/) ---------------------------------------
+BATCH = _k(
+    "NICE_TPU_BATCH", "int", None,
+    "Per-dispatch batch size override (env > autotuned > default).",
+    owner="ops/autotune.py", group="engine",
+    default_doc="autotuned per (mode, base, backend)",
+)
+BLOCK_ROWS = _k(
+    "NICE_TPU_BLOCK_ROWS", "int", None,
+    "Pallas kernel block-rows override (env > autotuned > default).",
+    owner="ops/autotune.py", group="engine",
+    default_doc="autotuned per (mode, base, backend)",
+)
+CARRY_INTERVAL = _k(
+    "NICE_TPU_CARRY_INTERVAL", "int", None,
+    "Carry-save limb-product carry interval override (env > autotuned >"
+    " default).",
+    owner="ops/autotune.py", group="engine",
+    default_doc="autotuned per (mode, base, backend)",
+)
+AUTOTUNE_FILE = _k(
+    "NICE_TPU_AUTOTUNE_FILE", "str", None,
+    "Path of the persisted autotuner winners table (falls back to"
+    " JAX_COMPILATION_CACHE_DIR, then ~/.cache/nice_tpu/).",
+    owner="ops/autotune.py", group="engine",
+)
+NO_FALLBACK = _k(
+    "NICE_TPU_NO_FALLBACK", "bool", False,
+    "Disable the pallas -> jnp -> scalar mid-field backend fallback chain"
+    " (dispatch failures become fatal).",
+    owner="ops/engine.py", group="engine",
+)
+SHARD = _k(
+    "NICE_TPU_SHARD", "bool", True,
+    "Multi-chip sharded dispatch (0 forces single-device execution).",
+    owner="ops/engine.py", group="engine",
+)
+ELASTIC = _k(
+    "NICE_TPU_ELASTIC", "bool", True,
+    "Elastic mesh downshift: reshard a field onto surviving devices on"
+    " device loss instead of degrading down the backend chain.",
+    owner="ops/engine.py", group="engine",
+)
+FEED_DEPTH = _k(
+    "NICE_TPU_FEED_DEPTH", "int", 2,
+    "Depth of the double-buffered host->device feed queue (0 = synchronous"
+    " feed on the dispatch thread; clamped to 64).",
+    owner="ops/engine.py", group="engine",
+)
+HOST_NICEONLY_MAX_KNOB = _k(
+    "NICE_TPU_HOST_NICEONLY_MAX", "int", 1 << 25,
+    "Small-field host-route threshold for niceonly scans (0 disables the"
+    " native host route).",
+    owner="ops/engine.py", group="engine",
+    default_doc="HOST_NICEONLY_MAX (2^25)",
+)
+AUDIT_EVERY = _k(
+    "NICE_TPU_AUDIT_EVERY", "int", 1024,
+    "Device-vs-host audit cadence for strided batches (every Nth batch).",
+    owner="ops/engine.py", group="engine",
+    default_doc="STRIDE_AUDIT_EVERY (1024)",
+)
+MSD_FLOOR = _k(
+    "NICE_TPU_MSD_FLOOR", "str", None,
+    "Pin the adaptive niceonly MSD host-filter floor for every pipeline"
+    " (integer; unset = adaptive controller).",
+    owner="ops/adaptive_floor.py", group="engine",
+)
+CKPT_BATCHES = _k(
+    "NICE_TPU_CKPT_BATCHES", "int", 256,
+    "Checkpoint cadence in dispatch batches (0 disables this trigger).",
+    owner="ops/engine.py", group="engine",
+    default_doc="CKPT_EVERY_BATCHES (256)",
+)
+CKPT_SECS = _k(
+    "NICE_TPU_CKPT_SECS", "float", 30.0,
+    "Checkpoint cadence in seconds (0 disables this trigger).",
+    owner="ops/engine.py", group="engine",
+    default_doc="CKPT_EVERY_SECS (30)",
+)
+
+# -- client ----------------------------------------------------------------
+CLAIM_BLOCK = _k(
+    "NICE_TPU_CLAIM_BLOCK", "int", 1,
+    "Fields requested per /claim_block lease (client-side block size).",
+    owner="client/main.py", group="client",
+)
+PREFETCH = _k(
+    "NICE_TPU_PREFETCH", "bool", True,
+    "AOT-warm the next field's executable while the current one scans.",
+    owner="client/main.py", group="client",
+)
+
+# -- server coordination tier ----------------------------------------------
+SERVER_CORE = _k(
+    "NICE_TPU_SERVER_CORE", "str", "async",
+    "Request core: 'async' (event loop + bounded worker pool) or 'thread'"
+    " (legacy thread-per-connection).",
+    owner="server/app.py", group="server",
+)
+SERVER_WORKERS = _k(
+    "NICE_TPU_SERVER_WORKERS", "int", 32,
+    "Bounded handler worker-pool size of the async core.",
+    owner="server/async_core.py", group="server",
+)
+MAX_INFLIGHT = _k(
+    "NICE_TPU_MAX_INFLIGHT", "int", 128,
+    "In-flight request ceiling before the loop sheds with 503 +"
+    " Retry-After.",
+    owner="server/app.py", group="server",
+)
+RETRY_AFTER_SECS = _k(
+    "NICE_TPU_RETRY_AFTER_SECS", "int", 2,
+    "Retry-After hint attached to 503 overload sheds.",
+    owner="server/app.py", group="server",
+)
+WRITER = _k(
+    "NICE_TPU_WRITER", "bool", True,
+    "Single-writer DB actor (0 = direct per-call transactions, debugging"
+    " only; semantics identical).",
+    owner="server/app.py", group="server",
+)
+WRITER_MAX_BATCH = _k(
+    "NICE_TPU_WRITER_MAX_BATCH", "int", 64,
+    "Max mutations coalesced into one writer-actor transaction.",
+    owner="server/writer.py", group="server",
+)
+WRITER_COALESCE_SECS = _k(
+    "NICE_TPU_WRITER_COALESCE_SECS", "float", 0.002,
+    "How long the writer drain loop lingers for stragglers after the queue"
+    " empties.",
+    owner="server/writer.py", group="server",
+)
+STATUS_CACHE_SECS = _k(
+    "NICE_TPU_STATUS_CACHE_SECS", "float", 2.0,
+    "TTL of the /status fleet-block read-snapshot cache.",
+    owner="server/app.py", group="server",
+)
+MAX_CLAIM_BLOCK = _k(
+    "NICE_TPU_MAX_CLAIM_BLOCK", "int", 128,
+    "Server-side cap on fields per /claim_block lease.",
+    owner="server/app.py", group="server",
+)
+CLAIM_EXPIRY_SECS = _k(
+    "NICE_TPU_CLAIM_EXPIRY_SECS", "float", None,
+    "Claim-lease window; leases older than this are re-claimable.",
+    owner="server/db.py", group="server",
+    default_doc="CLAIM_DURATION_HOURS * 3600 (1h)",
+)
+QUEUE_POLL_SECS = _k(
+    "NICE_TPU_QUEUE_POLL_SECS", "float", 5.0,
+    "Low-water poll cadence of the field pre-generation pipeline.",
+    owner="server/field_queue.py", group="server",
+)
+FLEET_ACTIVE_SECS = _k(
+    "NICE_TPU_FLEET_ACTIVE_SECS", "float", 900.0,
+    "Telemetry freshness window for counting a client as active in the"
+    " fleet block.",
+    owner="server/app.py", group="server",
+)
+
+# -- untrusted-client hardening --------------------------------------------
+TRUST_THRESHOLD = _k(
+    "NICE_TPU_TRUST_THRESHOLD", "float", 0.0,
+    "Trust needed to make canon directly (0 = consensus gating off).",
+    owner="server/trust.py", group="untrusted",
+)
+SPOT_RATE = _k(
+    "NICE_TPU_SPOT_RATE", "float", 0.01,
+    "Spot-check sampling floor for veteran clients.",
+    owner="server/trust.py", group="untrusted",
+)
+SPOT_SEED = _k(
+    "NICE_TPU_SPOT_SEED", "str", None,
+    "Spot-check RNG seed override — tests only.",
+    owner="server/trust.py", group="untrusted",
+    default_doc="random per-process secret",
+)
+SPOT_SLICE = _k(
+    "NICE_TPU_SPOT_SLICE", "int", 256,
+    "Numbers re-run per spot check (0 disables slices).",
+    owner="server/trust.py", group="untrusted",
+)
+UNTRUSTED_LEASE_SECS = _k(
+    "NICE_TPU_UNTRUSTED_LEASE_SECS", "float", 120.0,
+    "Lease window for untrusted claims.",
+    owner="server/app.py", group="untrusted",
+)
+UNTRUSTED_MAX_FIELD = _k(
+    "NICE_TPU_UNTRUSTED_MAX_FIELD", "int", 1_000_000,
+    "Range-size cap (micro-fields) for untrusted claims.",
+    owner="server/app.py", group="untrusted",
+)
+UNTRUSTED_MAX_CLAIMS = _k(
+    "NICE_TPU_UNTRUSTED_MAX_CLAIMS", "int", 16,
+    "Outstanding-claim cap per untrusted client.",
+    owner="server/app.py", group="untrusted",
+)
+UNTRUSTED_MAX_CLAIMS_PER_IP = _k(
+    "NICE_TPU_UNTRUSTED_MAX_CLAIMS_PER_IP", "int", 256,
+    "Aggregate outstanding-claim ceiling per source IP.",
+    owner="server/app.py", group="untrusted",
+)
+LEASE_SWEEP_SECS = _k(
+    "NICE_TPU_LEASE_SWEEP_SECS", "float", 5.0,
+    "Cadence of the writer-thread expired-lease sweep (0 disables).",
+    owner="server/app.py", group="untrusted",
+)
+RATE_BUCKET = _k(
+    "NICE_TPU_RATE_BUCKET", "spec", None,
+    'Opt-in per-client token buckets, "capacity:refill_per_sec" (reads get'
+    " 4x; unset = limiter off).",
+    owner="server/async_core.py", group="untrusted",
+    default_doc='off (opt-in; "300:100" once set empty)',
+)
+
+# -- observability ---------------------------------------------------------
+METRICS_PORT = _k(
+    "NICE_TPU_METRICS_PORT", "str", None,
+    "Serve the local /metrics endpoint on this port (0 = ephemeral; unset ="
+    " off).",
+    owner="obs/serve.py", group="obs",
+)
+TRACE = _k(
+    "NICE_TPU_TRACE", "str", None,
+    'Structured trace sink: "stderr" or a file path (unset = tracing off).',
+    owner="obs/trace.py", group="obs",
+)
+TRACE_MAX_BYTES = _k(
+    "NICE_TPU_TRACE_MAX_BYTES", "int", 64 * 1024 * 1024,
+    "File trace sink size cap before one-shot rotation to <path>.1.",
+    owner="obs/trace.py", group="obs",
+    default_doc="DEFAULT_MAX_SINK_BYTES (64 MiB)",
+)
+PROFILE = _k(
+    "NICE_TPU_PROFILE", "str", None,
+    "jax.profiler capture output directory (unset = no capture).",
+    owner="obs/trace.py", group="obs",
+)
+STEPPROF = _k(
+    "NICE_TPU_STEPPROF", "bool", False,
+    "Device-step profiler: per-field phase-attributed wall time with zero"
+    " added device syncs while disabled.",
+    owner="obs/stepprof.py", group="obs",
+)
+FLIGHT_DIR = _k(
+    "NICE_TPU_FLIGHT_DIR", "str", None,
+    "Directory for flight-recorder dumps.",
+    owner="obs/flight.py", group="obs",
+    default_doc="system temp dir",
+)
+FLIGHT_EVENTS = _k(
+    "NICE_TPU_FLIGHT_EVENTS", "int", 512,
+    "Flight-recorder ring capacity (min 16).",
+    owner="obs/flight.py", group="obs",
+    default_doc="DEFAULT_CAPACITY (512)",
+)
+HISTORY_SECS = _k(
+    "NICE_TPU_HISTORY_SECS", "float", 15.0,
+    "History sampling cadence (0 disables the sampler).",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_RAW_CAP = _k(
+    "NICE_TPU_HISTORY_RAW_CAP", "int", 240,
+    "Raw-tier ring capacity per history series.",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_1M_CAP = _k(
+    "NICE_TPU_HISTORY_1M_CAP", "int", 360,
+    "1-minute-tier ring capacity per history series.",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_15M_CAP = _k(
+    "NICE_TPU_HISTORY_15M_CAP", "int", 672,
+    "15-minute-tier ring capacity per history series.",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_1M_SECS = _k(
+    "NICE_TPU_HISTORY_1M_SECS", "float", 60.0,
+    "Width of the first coarse history tier's buckets (env-scalable for"
+    " short harness runs).",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_15M_SECS = _k(
+    "NICE_TPU_HISTORY_15M_SECS", "float", 900.0,
+    "Width of the second coarse history tier's buckets.",
+    owner="obs/history.py", group="obs",
+)
+HISTORY_RETENTION_SECS = _k(
+    "NICE_TPU_HISTORY_RETENTION_SECS", "float", 7 * 24 * 3600.0,
+    "Server-side metric_history table retention (pruned on the writer"
+    " periodic).",
+    owner="server/app.py", group="obs",
+)
+SLO_WINDOW_SCALE = _k(
+    "NICE_TPU_SLO_WINDOW_SCALE", "float", 1.0,
+    "Scales every SLO burn-rate window (short harness runs exercise real"
+    " transitions in seconds).",
+    owner="obs/slo.py", group="obs",
+)
+SLO_OVERRIDES = _family(
+    "NICE_TPU_SLO_", ("_THRESHOLD", "_OBJECTIVE"), "float",
+    "Per-SLO threshold/objective overrides, e.g."
+    " NICE_TPU_SLO_CLAIM_P99_THRESHOLD.",
+    owner="obs/slo.py", group="obs",
+)
+
+# -- chaos / fault injection -----------------------------------------------
+FAULTS = _k(
+    "NICE_TPU_FAULTS", "spec", None,
+    'Fault-injection spec, "site:action@prob,..." (unset = chaos off).',
+    owner="faults/injector.py", group="faults",
+)
+FAULTS_SEED = _k(
+    "NICE_TPU_FAULTS_SEED", "int", 0,
+    "Deterministic seed for the per-site fault RNGs.",
+    owner="faults/injector.py", group="faults",
+)
+
+# -- lock diagnostics ------------------------------------------------------
+LOCKDEP = _k(
+    "NICE_TPU_LOCKDEP", "bool", False,
+    "Runtime lock-order instrumentation: record cross-thread lock"
+    " acquisition order, fail tests on cycles ('2'/'strict' additionally"
+    " fails on long holds under a loop thread).",
+    owner="utils/lockdep.py", group="lockdep",
+)
+LOCKDEP_HOLD_SECS = _k(
+    "NICE_TPU_LOCKDEP_HOLD_SECS", "float", 0.25,
+    "Hold-duration threshold above which a lock held on an event-loop"
+    " thread is recorded as a long-hold violation.",
+    owner="utils/lockdep.py", group="lockdep",
+)
+
+
+# ---------------------------------------------------------------------------
+# Documentation rendering (docs/KNOBS.md + README tables). nicelint's K1
+# rule regenerates these and diffs against the committed files.
+# ---------------------------------------------------------------------------
+
+_GROUP_TITLES = {
+    "engine": "Engine / device pipeline",
+    "client": "Client",
+    "server": "Server coordination tier",
+    "untrusted": "Untrusted-client hardening",
+    "obs": "Observability",
+    "faults": "Chaos / fault injection",
+    "lockdep": "Lock diagnostics",
+    "general": "General",
+}
+
+
+def _table(knobs: List[Knob], families: List[PrefixFamily]) -> List[str]:
+    lines = [
+        "| Knob | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for k in knobs:
+        lines.append(
+            f"| `{k.name}` | {k.kind} | `{k.default_text}` | {k.doc} |"
+        )
+    for f in families:
+        lines.append(f"| `{f.name}` | {f.kind} | per-spec | {f.doc} |")
+    return lines
+
+
+def render_group_markdown(group: str) -> str:
+    """One group's knob table (the README embeds the 'untrusted' group)."""
+    knobs = [k for k in all_knobs() if k.group == group]
+    fams = [f for f in PREFIXES if f.group == group]
+    return "\n".join(_table(knobs, fams))
+
+
+def render_markdown() -> str:
+    """The full docs/KNOBS.md body."""
+    lines = [
+        "# Environment knobs",
+        "",
+        "Generated from `nice_tpu/utils/knobs.py` by"
+        " `python scripts/nicelint.py --write-docs` — do not edit by hand;"
+        " the K1 lint rule fails when this file drifts from the registry.",
+        "",
+        "All knobs are read at call time (never cached at import), so tests"
+        " and operators can flip them on a live process where the owning"
+        " module documents that.",
+    ]
+    groups: Dict[str, List[Knob]] = {}
+    for k in all_knobs():
+        groups.setdefault(k.group, []).append(k)
+    for f in PREFIXES:
+        groups.setdefault(f.group, [])
+    for group in sorted(groups, key=lambda g: list(_GROUP_TITLES).index(g)
+                        if g in _GROUP_TITLES else 99):
+        lines += ["", f"## {_GROUP_TITLES.get(group, group.title())}", ""]
+        lines += _table(
+            groups[group], [f for f in PREFIXES if f.group == group]
+        )
+        owners = sorted({k.owner for k in groups[group]}
+                        | {f.owner for f in PREFIXES if f.group == group})
+        lines += ["", f"Owning modules: {', '.join(f'`{o}`' for o in owners)}"]
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,260 @@
+"""Runtime lock-order instrumentation (the dynamic half of nicelint X1).
+
+``NICE_TPU_LOCKDEP=1`` swaps every project lock constructed through
+:func:`make_lock` / :func:`make_rlock` for an instrumented wrapper that
+records, per thread, the stack of currently held locks. Each time a thread
+acquires lock B while holding lock A, the directed edge A->B enters a
+process-global order graph; an acquisition that would close a cycle
+(B ⟶* A already exists) is recorded as an ``order-cycle`` violation with
+both acquisition sites. The test suite's autouse guard (tests/conftest.py)
+fails any test that produced a cycle, which is how an ABBA deadlock is
+caught deterministically in CI without ever having to actually deadlock.
+
+Secondary check: a lock held for longer than ``NICE_TPU_LOCKDEP_HOLD_SECS``
+on a thread registered via :func:`mark_loop_thread` (the async core's event
+loop) is recorded as a ``long-hold`` violation — the event loop must never
+sit behind a lock for macroscopic time. Long-holds only fail tests under
+``NICE_TPU_LOCKDEP=strict`` (or ``2``) because wall-time thresholds are
+load-sensitive on shared CI machines.
+
+Everything here is conventional threading underneath: the wrappers delegate
+to a real ``threading.Lock``/``RLock``, so blocking, timeout, and ownership
+semantics are unchanged. When lockdep is disabled the factories return the
+plain stdlib objects — zero overhead on the production path.
+
+Cycle detection is NAME-level (the label passed to make_lock), matching the
+static lock graph nicelint X1 extracts, so the two reports line up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set
+
+from nice_tpu.utils import knobs
+
+__all__ = [
+    "enabled",
+    "strict",
+    "make_lock",
+    "make_rlock",
+    "mark_loop_thread",
+    "violations",
+    "violation_count",
+    "order_edges",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """Read at call time so tests can flip the knob per-process; note locks
+    constructed before the flip stay whatever they were built as."""
+    return knobs.LOCKDEP.get_bool() or _is_strict_raw()
+
+
+def _is_strict_raw() -> bool:
+    raw = (knobs.LOCKDEP.raw() or "").strip().lower()
+    return raw in ("2", "strict")
+
+
+def strict() -> bool:
+    return _is_strict_raw()
+
+
+# Internal state. _state_lock is a PLAIN threading.Lock on purpose — the
+# instrumentation must never instrument itself.
+_state_lock = threading.Lock()
+_tls = threading.local()
+
+# name -> set of names acquired while holding <name>
+_graph: Dict[str, Set[str]] = {}
+# (outer, inner) -> first-observed acquisition site (formatted stack tail)
+_edge_sites: Dict[tuple, str] = {}
+_violations: List[dict] = []
+_loop_thread_ids: Set[int] = set()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def mark_loop_thread(ident: Optional[int] = None) -> None:
+    """Register the calling (or given) thread as an event-loop thread for
+    long-hold attribution. Cheap no-op when lockdep is off."""
+    if not enabled():
+        return
+    with _state_lock:
+        _loop_thread_ids.add(
+            threading.get_ident() if ident is None else ident
+        )
+
+
+def _site(skip: int = 3) -> str:
+    """A compact one-line acquisition site, e.g. 'writer.py:179 in _run_batch'."""
+    for frame in reversed(traceback.extract_stack(limit=skip + 4)[: -skip]):
+        fn = frame.filename
+        if "lockdep" in fn:
+            continue
+        return f"{fn.rsplit('/', 1)[-1]}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+def _path_exists(src: str, dst: str) -> bool:
+    """DFS: does src reach dst in the order graph? Caller holds _state_lock."""
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        node = frontier.pop()
+        if node == dst:
+            return True
+        for nxt in _graph.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return False
+
+
+def _record_acquire(name: str) -> None:
+    stack = _held_stack()
+    if any(entry[0] == name for entry in stack):
+        # Re-entrant hold of the same named lock (RLock recursion, or two
+        # sibling instances sharing a name): no ordering information.
+        stack.append((name, time.monotonic(), False))
+        return
+    if stack:
+        outer = stack[-1][0]
+        site = _site()
+        with _state_lock:
+            if name not in _graph.get(outer, ()):
+                # New edge outer->name: a cycle exists iff name already
+                # reaches outer.
+                if _path_exists(name, outer):
+                    _violations.append({
+                        "kind": "order-cycle",
+                        "edge": (outer, name),
+                        "site": site,
+                        "reverse_site": _edge_sites.get((name, outer))
+                        or _first_site_reaching(name, outer),
+                        "thread": threading.current_thread().name,
+                        "held": [e[0] for e in stack],
+                    })
+                _graph.setdefault(outer, set()).add(name)
+                _edge_sites.setdefault((outer, name), site)
+    stack.append((name, time.monotonic(), True))
+
+
+def _first_site_reaching(src: str, dst: str) -> Optional[str]:
+    """Best-effort site of the first edge on some src⟶dst path (for the
+    cycle report). Caller holds _state_lock."""
+    for nxt in _graph.get(src, ()):
+        if nxt == dst or _path_exists(nxt, dst):
+            return _edge_sites.get((src, nxt))
+    return None
+
+
+def _record_release(name: str) -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == name:
+            _, t0, outermost = stack.pop(i)
+            if outermost:
+                held_for = time.monotonic() - t0
+                threshold = knobs.LOCKDEP_HOLD_SECS.get()
+                if held_for > threshold:
+                    ident = threading.get_ident()
+                    with _state_lock:
+                        if ident in _loop_thread_ids:
+                            _violations.append({
+                                "kind": "long-hold",
+                                "lock": name,
+                                "held_secs": round(held_for, 4),
+                                "threshold_secs": threshold,
+                                "thread": threading.current_thread().name,
+                                "site": _site(),
+                            })
+            return
+    # Release of a lock this thread never recorded (acquired pre-flip or
+    # handed across threads): ignore — delegation below still releases.
+
+
+class _DepLock:
+    """Instrumented Lock/RLock wrapper: same acquire/release/context-manager
+    surface, recording order edges and hold times around the real lock."""
+
+    __slots__ = ("_name", "_lock")
+
+    def __init__(self, name: str, lock):
+        self._name = name
+        self._lock = lock
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        _record_release(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<DepLock {self._name} wrapping {self._lock!r}>"
+
+
+def make_lock(name: str):
+    """A threading.Lock, instrumented when NICE_TPU_LOCKDEP is on. ``name``
+    labels the lock in the order graph; use a stable dotted id matching the
+    attribute path (e.g. "server.db.Db._lock") so runtime reports line up
+    with the static X1 graph."""
+    return _DepLock(name, threading.Lock()) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    """A threading.RLock, instrumented when NICE_TPU_LOCKDEP is on."""
+    return (
+        _DepLock(name, threading.RLock()) if enabled() else threading.RLock()
+    )
+
+
+def violations() -> List[dict]:
+    with _state_lock:
+        return list(_violations)
+
+
+def violation_count() -> int:
+    with _state_lock:
+        return len(_violations)
+
+
+def order_edges() -> Dict[str, Set[str]]:
+    """Snapshot of the observed acquisition-order graph."""
+    with _state_lock:
+        return {k: set(v) for k, v in _graph.items()}
+
+
+def reset() -> None:
+    """Drop all recorded state (tests)."""
+    with _state_lock:
+        _graph.clear()
+        _edge_sites.clear()
+        _violations.clear()
+        _loop_thread_ids.clear()

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""nicelint CLI — project-invariant static analysis for nice_tpu.
+
+Usage:
+    python scripts/nicelint.py                 # report vs ratchet baseline
+    python scripts/nicelint.py --strict        # CI gate: also fail stale
+                                               # baseline entries
+    python scripts/nicelint.py --update-baseline
+    python scripts/nicelint.py --write-docs    # regenerate docs/KNOBS.md +
+                                               # README knob tables
+    python scripts/nicelint.py --json out.json # archive the full report
+    python scripts/nicelint.py --rules W1,X1   # run a subset
+    python scripts/nicelint.py --graph         # dump the static lock graph
+
+Exit codes: 0 clean, 1 new violations (or stale baseline entries under
+--strict), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from nice_tpu.analysis import core  # noqa: E402
+from nice_tpu.analysis.rules import k1_knobs, x1_lock_order  # noqa: E402
+from nice_tpu.utils import knobs  # noqa: E402
+
+
+def write_docs(root: str) -> list:
+    """Regenerate docs/KNOBS.md and the README generated blocks; returns
+    the list of files rewritten."""
+    changed = []
+    docs_dir = os.path.join(root, "docs")
+    os.makedirs(docs_dir, exist_ok=True)
+    knobs_md = os.path.join(docs_dir, "KNOBS.md")
+    want = knobs.render_markdown()
+    have = None
+    if os.path.exists(knobs_md):
+        with open(knobs_md, encoding="utf-8") as f:
+            have = f.read()
+    if have != want:
+        with open(knobs_md, "w", encoding="utf-8") as f:  # nicelint: allow A1 (generated docs, not state)
+            f.write(want)
+        changed.append(os.path.relpath(knobs_md, root))
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            text = f.read()
+        new_text = k1_knobs.rewrite_readme(text)
+        if new_text != text:
+            with open(readme, "w", encoding="utf-8") as f:  # nicelint: allow A1 (generated docs, not state)
+                f.write(new_text)
+            changed.append("README.md")
+    return changed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on stale baseline entries and docs drift")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the ratchet baseline to current findings")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate docs/KNOBS.md and README knob tables")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full violation report as JSON")
+    ap.add_argument("--rules", metavar="IDS",
+                    help="comma-separated rule subset (e.g. W1,X1)")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump the static lock-order graph and exit")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    project = core.Project(root)
+
+    if args.write_docs:
+        for rel in write_docs(root):
+            print(f"nicelint: rewrote {rel}")
+
+    if args.graph:
+        graph = x1_lock_order.lock_graph(project)
+        for outer in sorted(graph):
+            for inner in sorted(graph[outer]):
+                print(f"{outer} -> {inner}")
+        return 0
+
+    only = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    violations = core.run_rules(project, only=only)
+    baseline = core.load_baseline(root)
+    if only:
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split("|", 1)[0] in only}
+    new, stale = core.diff_against_baseline(violations, baseline)
+
+    if args.update_baseline:
+        entries = {}
+        old = core.load_baseline(root)
+        for v in violations:
+            entries[v.key] = old.get(v.key, "TODO: justify or fix")
+        core.save_baseline(root, entries)
+        print(f"nicelint: baseline rewritten with {len(entries)} entries "
+              f"({len(new)} new, {len(stale)} removed)")
+        return 0
+
+    if args.json:
+        report = {
+            "violations": [v.to_json() for v in violations],
+            "new": [v.to_json() for v in new],
+            "stale_baseline_keys": stale,
+            "baselined": len(violations) - len(new),
+        }
+        with open(args.json, "w", encoding="utf-8") as f:  # nicelint: allow A1 (CI artifact, not state)
+            json.dump(report, f, indent=1)
+            f.write("\n")
+
+    for v in new:
+        print(f"{v.path}:{v.line}: {v.rule}: {v.message}")
+    if stale:
+        print(f"nicelint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed violations "
+              "still listed — run --update-baseline to burn them down):")
+        for key in stale:
+            print(f"  stale: {key}")
+
+    baselined = len(violations) - len(new)
+    print(f"nicelint: {len(new)} new, {baselined} baselined, "
+          f"{len(stale)} stale")
+    if new:
+        return 1
+    if args.strict and stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,3 +29,28 @@ jax.config.update("jax_platforms", "cpu")
 # OFF here so the suite keeps exercising the (scarcer) device path; tests
 # that target the host route set this env explicitly.
 os.environ.setdefault("NICE_TPU_HOST_NICEONLY_MAX", "0")
+
+# ---------------------------------------------------------------------------
+# Runtime lockdep guard: under NICE_TPU_LOCKDEP=1 every test fails if it
+# recorded a lock-order cycle; long holds on marked loop threads only fail
+# under NICE_TPU_LOCKDEP=strict (wall-time thresholds are load-sensitive).
+import pytest  # noqa: E402
+
+from nice_tpu.utils import lockdep  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard():
+    if not lockdep.enabled():
+        yield
+        return
+    before = lockdep.violation_count()
+    yield
+    new = lockdep.violations()[before:]
+    cycles = [v for v in new if v["kind"] == "order-cycle"]
+    if cycles:
+        pytest.fail(f"lockdep: lock-order cycle(s) during test: {cycles}")
+    if lockdep.strict():
+        holds = [v for v in new if v["kind"] == "long-hold"]
+        if holds:
+            pytest.fail(f"lockdep: long hold(s) on a loop thread: {holds}")

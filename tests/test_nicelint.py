@@ -1,0 +1,621 @@
+"""nicelint + lockdep tests: every rule has a good/bad fixture pair (the
+seeded regression must be caught; the disciplined version must pass), the
+ratchet baseline has add/burn-down semantics, and runtime lockdep catches
+an ABBA ordering deterministically without ever deadlocking."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from nice_tpu.analysis import core  # noqa: E402
+from nice_tpu.utils import knobs, lockdep  # noqa: E402
+
+NICELINT = os.path.join(REPO, "scripts", "nicelint.py")
+
+
+def project(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content), encoding="utf-8")
+    return core.Project(str(tmp_path))
+
+
+def run_rule(tmp_path, files, rule_id):
+    return core.run_rules(project(tmp_path, files), only=[rule_id])
+
+
+DB_FIXTURE = """
+    class Db:
+        def _txn(self):
+            pass
+
+        def add_row(self, x):
+            with self._txn():
+                pass
+
+        def bump(self, x):
+            self.add_row(x)
+
+        def read_rows(self):
+            return []
+"""
+
+
+# ---------------------------------------------------------------------------
+# W1: writer-actor discipline
+
+
+def test_w1_flags_mutating_call_outside_writer(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/db.py": DB_FIXTURE,
+        "nice_tpu/server/handlers.py": """
+            def handle(db):
+                db.add_row(1)
+        """,
+    }, "W1")
+    assert [v.rule for v in vs] == ["W1"]
+    assert "add_row" in vs[0].message
+
+
+def test_w1_transitive_mutator_counts(tmp_path):
+    # bump() only calls add_row(); it must still count as mutating.
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/db.py": DB_FIXTURE,
+        "nice_tpu/server/handlers.py": """
+            def handle(db):
+                db.bump(1)
+        """,
+    }, "W1")
+    assert len(vs) == 1 and "bump" in vs[0].message
+
+
+def test_w1_writer_dispatch_and_reads_are_clean(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/db.py": DB_FIXTURE,
+        "nice_tpu/server/handlers.py": """
+            def init(writer, db):
+                writer.call(do_add)
+                writer.submit(lambda: db.add_row(2))
+
+            def do_add(db):
+                db.add_row(1)
+                helper(db)
+
+            def helper(db):
+                db.bump(3)
+
+            def reads(db):
+                return db.read_rows()
+        """,
+    }, "W1")
+    assert vs == []
+
+
+def test_w1_inline_allow_sanctions_init_paths(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/db.py": DB_FIXTURE,
+        "nice_tpu/server/handlers.py": """
+            def boot(db):
+                # nicelint: allow W1 (crash recovery runs before the writer)
+                db.add_row(1)
+        """,
+    }, "W1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# L1: event-loop purity
+
+
+def test_l1_flags_blocking_call_reachable_from_async_root(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/async_core.py": """
+            import time
+
+            async def handle(self):
+                self._work()
+
+            def _work(self):
+                time.sleep(1)
+        """,
+    }, "L1")
+    assert len(vs) == 1 and "time.sleep" in vs[0].message
+
+
+def test_l1_run_in_executor_offload_is_sanctioned(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/async_core.py": """
+            import time
+
+            async def handle(self, loop):
+                await loop.run_in_executor(None, _work)
+
+            def _work():
+                time.sleep(1)
+        """,
+    }, "L1")
+    assert vs == []
+
+
+def test_l1_loop_thread_marker_extends_roots(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/shed.py": """
+            import time
+
+            # nicelint: loop-thread
+            def multiplier():
+                time.sleep(0.1)
+        """,
+    }, "L1")
+    assert len(vs) == 1 and vs[0].path.endswith("shed.py")
+
+
+# ---------------------------------------------------------------------------
+# D1: device-sync fences
+
+
+def test_d1_flags_unfenced_readback(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/ops/engine.py": """
+            import numpy as np
+
+            def readback(dev_array):
+                return int(np.asarray(dev_array))
+        """,
+    }, "D1")
+    assert len(vs) == 1 and "np.asarray" in vs[0].message
+
+
+def test_d1_fence_marker_and_host_literals_are_clean(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/ops/engine.py": """
+            import numpy as np
+
+            def readback(dev_array):
+                # nicelint: fence (survivor-count readback)
+                return int(np.asarray(dev_array))
+
+            def host_side():
+                return np.asarray([1, 2, 3])
+        """,
+    }, "D1")
+    assert vs == []
+
+
+def test_d1_outside_hot_modules_is_out_of_scope(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/obs/stats.py": """
+            import numpy as np
+
+            def f(x):
+                return np.asarray(x)
+        """,
+    }, "D1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# M1: metrics discipline
+
+SERIES_FIXTURE = """
+    from nice_tpu.obs import metrics
+
+    REQS = metrics.counter("nice_reqs_total", "requests",
+                           labelnames=("code",))
+"""
+
+
+def test_m1_flags_global_decl_outside_series(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/obs/series.py": SERIES_FIXTURE,
+        "nice_tpu/server/app.py": """
+            from nice_tpu.obs import metrics
+
+            ROGUE = metrics.counter("nice_rogue_total", "rogue")
+        """,
+    }, "M1")
+    assert any(v.detail.startswith("global-decl:nice_rogue_total")
+               for v in vs)
+
+
+def test_m1_flags_undeclared_usage_and_computed_labels(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/obs/series.py": SERIES_FIXTURE,
+        "nice_tpu/server/app.py": """
+            NAME = "nice_missing_total"
+        """,
+        "web/dash.js": """
+            fetch("/metrics").then(t => t.includes("nice_ghost_total"));
+        """,
+    }, "M1")
+    details = {v.detail for v in vs}
+    assert "undeclared:nice_missing_total" in details
+    assert "undeclared:nice_ghost_total" in details
+
+
+def test_m1_computed_labelnames_are_flagged(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/obs/series.py": SERIES_FIXTURE + """
+    BAD = metrics.gauge("nice_bad", "bad", labelnames=tuple(REQS))
+        """,
+    }, "M1")
+    assert any(v.detail == "labels:nice_bad" for v in vs)
+
+
+def test_m1_derived_suffixes_and_prefixes_resolve(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/obs/series.py": SERIES_FIXTURE + """
+    WAIT = metrics.histogram("nice_wait_seconds", "wait")
+        """,
+        "web/dash.js": """
+            rows.filter(r => r.startsWith("nice_reqs_"));
+            plot("nice_wait_seconds_p99");
+        """,
+    }, "M1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# K1: knob discipline (declaration checks run against the real registry)
+
+
+def test_k1_flags_direct_env_read_in_package(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/app.py": """
+            import os
+
+            WRITER = os.environ.get("NICE_TPU_WRITER", "1")
+            CORE = os.environ["NICE_TPU_SERVER_CORE"]
+        """,
+    }, "K1")
+    details = {v.detail for v in vs}
+    assert "direct-read:NICE_TPU_WRITER" in details
+    assert "direct-read:NICE_TPU_SERVER_CORE" in details
+
+
+def test_k1_flags_undeclared_knob_everywhere(tmp_path):
+    vs = run_rule(tmp_path, {
+        "scripts/tool.py": """
+            KNOB = "NICE_TPU_TOTALLY_BOGUS_KNOB"
+        """,
+    }, "K1")
+    assert [v.detail for v in vs] == \
+        ["undeclared:NICE_TPU_TOTALLY_BOGUS_KNOB"]
+
+
+def test_k1_declared_knobs_and_prefix_families_are_clean(tmp_path):
+    vs = run_rule(tmp_path, {
+        "scripts/tool.py": """
+            A = "NICE_TPU_WRITER"
+            B = "NICE_TPU_SLO_CLAIM_P99_THRESHOLD"
+        """,
+    }, "K1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# A1: atomic writes
+
+
+def test_a1_flags_raw_write_open(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/ckpt/writer.py": """
+            def save(path, blob):
+                with open(path, "w") as f:
+                    f.write(blob)
+        """,
+    }, "A1")
+    assert len(vs) == 1 and "fsio" in vs[0].message
+
+
+def test_a1_reads_fsio_and_allows_are_clean(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/ckpt/writer.py": """
+            def load(path):
+                with open(path) as f:
+                    return f.read()
+
+            def stream(path):
+                # nicelint: allow A1 (append-only log sink)
+                return open(path, "a")
+        """,
+        "nice_tpu/utils/fsio.py": """
+            def atomic_write_bytes(path, blob):
+                with open(path + ".tmp", "wb") as f:
+                    f.write(blob)
+        """,
+    }, "A1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# X1: static lock order
+
+
+def test_x1_flags_bare_lock(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/cache.py": """
+            import threading
+
+            _lock = threading.Lock()
+        """,
+    }, "X1")
+    assert len(vs) == 1 and vs[0].detail.startswith("bare-lock")
+
+
+def test_x1_detects_static_abba_cycle(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/cache.py": """
+            from nice_tpu.utils import lockdep
+
+            A = lockdep.make_lock("cache.A")
+            B = lockdep.make_lock("cache.B")
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with B:
+                    with A:
+                        pass
+        """,
+    }, "X1")
+    assert any(v.detail.startswith("cycle:") for v in vs)
+    assert any("cache.A" in v.message and "cache.B" in v.message
+               for v in vs)
+
+
+def test_x1_consistent_order_is_clean(tmp_path):
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/cache.py": """
+            from nice_tpu.utils import lockdep
+
+            A = lockdep.make_lock("cache.A")
+            B = lockdep.make_lock("cache.B")
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with A:
+                    with B:
+                        pass
+        """,
+    }, "X1")
+    assert vs == []
+
+
+def test_x1_cross_module_attr_resolution(tmp_path):
+    # self.db._lock in another module resolves through the attribute table;
+    # a consistent db-inside-writer order stays clean.
+    vs = run_rule(tmp_path, {
+        "nice_tpu/server/db.py": """
+            from nice_tpu.utils import lockdep
+
+            class Db:
+                def __init__(self):
+                    self._lock = lockdep.make_lock("server.db.Db._lock")
+        """,
+        "nice_tpu/server/writer.py": """
+            from nice_tpu.utils import lockdep
+
+            class Writer:
+                def __init__(self, db):
+                    self._lock = lockdep.make_lock("server.writer._lock")
+                    self.db = db
+
+                def flush(self):
+                    with self._lock:
+                        with self.db._lock:
+                            pass
+        """,
+    }, "X1")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# Ratchet baseline semantics (through the CLI, end to end)
+
+BAD_TREE = {
+    "nice_tpu/ckpt/writer.py": """
+        def save(path, blob):
+            with open(path, "w") as f:
+                f.write(blob)
+    """,
+}
+
+
+def nicelint(root, *args):
+    return subprocess.run(
+        [sys.executable, NICELINT, "--root", str(root), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_ratchet_new_violation_fails_then_baselines(tmp_path):
+    project(tmp_path, BAD_TREE)
+    r = nicelint(tmp_path)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "1 new" in r.stdout
+
+    r = nicelint(tmp_path, "--update-baseline")
+    assert r.returncode == 0
+    baseline = json.loads(
+        (tmp_path / "nice_tpu/analysis/baseline.json").read_text()
+    )
+    assert len(baseline["entries"]) == 1
+
+    r = nicelint(tmp_path)
+    assert r.returncode == 0
+    assert "0 new, 1 baselined, 0 stale" in r.stdout
+
+
+def test_ratchet_stale_entry_fails_only_strict(tmp_path):
+    project(tmp_path, BAD_TREE)
+    assert nicelint(tmp_path, "--update-baseline").returncode == 0
+    # Fix the violation: the baseline entry goes stale.
+    (tmp_path / "nice_tpu/ckpt/writer.py").write_text(
+        "def save(path, blob):\n    return None\n"
+    )
+    r = nicelint(tmp_path)
+    assert r.returncode == 0 and "1 stale" in r.stdout
+    r = nicelint(tmp_path, "--strict")
+    assert r.returncode == 1 and "stale" in r.stdout
+
+
+def test_ratchet_json_report(tmp_path):
+    project(tmp_path, BAD_TREE)
+    out = tmp_path / "report.json"
+    r = nicelint(tmp_path, "--json", str(out))
+    assert r.returncode == 1
+    report = json.loads(out.read_text())
+    assert report["new"] and report["new"][0]["rule"] == "A1"
+    assert report["baselined"] == 0
+
+
+def test_repo_tree_is_clean_strict():
+    r = nicelint(REPO, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Runtime lockdep
+
+
+@pytest.fixture
+def lockdep_on(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_LOCKDEP", "1")
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+def test_lockdep_disabled_returns_plain_locks(monkeypatch):
+    monkeypatch.delenv("NICE_TPU_LOCKDEP", raising=False)
+    lock = lockdep.make_lock("test.plain")
+    assert not hasattr(lock, "name")
+
+
+def test_lockdep_records_order_edges(lockdep_on):
+    a = lockdep.make_lock("test.A")
+    b = lockdep.make_lock("test.B")
+    with a:
+        with b:
+            pass
+    assert "test.B" in lockdep.order_edges().get("test.A", set())
+    assert lockdep.violation_count() == 0
+
+
+def test_lockdep_catches_abba_without_deadlocking(lockdep_on):
+    # Two threads acquire in opposite orders SEQUENTIALLY (the second
+    # starts after the first finished) — no wall-clock deadlock is
+    # possible, yet the name-level order graph still closes the A->B->A
+    # cycle. This is exactly how CI catches ABBA deterministically.
+    a = lockdep.make_lock("test.abba.A")
+    b = lockdep.make_lock("test.abba.B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+
+    cycles = [v for v in lockdep.violations() if v["kind"] == "order-cycle"]
+    assert len(cycles) == 1
+    assert set(cycles[0]["edge"]) == {"test.abba.A", "test.abba.B"}
+    assert cycles[0]["site"]  # acquisition site is attributed
+    lockdep.reset()  # clean slate so the conftest guard stays green
+
+
+def test_lockdep_rlock_reentrancy_is_not_a_cycle(lockdep_on):
+    r = lockdep.make_rlock("test.re.R")
+    with r:
+        with r:
+            pass
+    assert lockdep.violation_count() == 0
+
+
+def test_lockdep_long_hold_on_loop_thread(lockdep_on, monkeypatch):
+    monkeypatch.setenv("NICE_TPU_LOCKDEP_HOLD_SECS", "0.01")
+    lock = lockdep.make_lock("test.hold.L")
+    lockdep.mark_loop_thread()
+    with lock:
+        time.sleep(0.05)
+    holds = [v for v in lockdep.violations() if v["kind"] == "long-hold"]
+    assert len(holds) == 1 and holds[0]["lock"] == "test.hold.L"
+    lockdep.reset()
+
+
+def test_lockdep_long_hold_ignores_worker_threads(lockdep_on, monkeypatch):
+    monkeypatch.setenv("NICE_TPU_LOCKDEP_HOLD_SECS", "0.01")
+    lock = lockdep.make_lock("test.hold.W")
+    with lock:  # this thread is NOT marked as a loop thread
+        time.sleep(0.05)
+    assert lockdep.violation_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Knob registry
+
+
+def test_knobs_typed_get_and_bool_semantics(monkeypatch):
+    monkeypatch.delenv("NICE_TPU_WRITER_MAX_BATCH", raising=False)
+    assert knobs.WRITER_MAX_BATCH.get() == knobs.WRITER_MAX_BATCH.default
+    monkeypatch.setenv("NICE_TPU_WRITER_MAX_BATCH", "96")
+    assert knobs.WRITER_MAX_BATCH.get() == 96
+    assert knobs.WRITER_MAX_BATCH.get(default=7) == 96
+
+    monkeypatch.setenv("NICE_TPU_WRITER", "off")
+    assert knobs.WRITER.get_bool() is False
+    monkeypatch.setenv("NICE_TPU_WRITER", "yes")
+    assert knobs.WRITER.get_bool() is True
+    # Empty/unrecognized strings fall back to the default, matching the
+    # pre-registry call sites ('not in ("0","false","off")' style).
+    monkeypatch.setenv("NICE_TPU_WRITER", "")
+    assert knobs.WRITER.get_bool() is True
+
+
+def test_knobs_lookup_and_prefix_family(monkeypatch):
+    assert knobs.lookup("NICE_TPU_WRITER") is knobs.WRITER
+    assert knobs.is_declared("NICE_TPU_LOCKDEP")
+    # nicelint: allow K1 (intentionally-undeclared probe name)
+    assert not knobs.is_declared("NICE_TPU_NO_SUCH_KNOB")
+    monkeypatch.setenv("NICE_TPU_SLO_CLAIM_P99_THRESHOLD", "0.5")
+    got = knobs.SLO_OVERRIDES.get_float(
+        "NICE_TPU_SLO_CLAIM_P99_THRESHOLD", 1.0
+    )
+    assert got == 0.5
+
+
+def test_knobs_render_markdown_covers_registry():
+    md = knobs.render_markdown()
+    assert "NICE_TPU_LOCKDEP" in md
+    assert "NICE_TPU_WRITER_MAX_BATCH" in md
+    # docs/KNOBS.md in the tree matches the registry (K1 drift gate).
+    with open(os.path.join(REPO, "docs", "KNOBS.md"), encoding="utf-8") as f:
+        assert f.read() == md

@@ -32,7 +32,7 @@ import numpy as np
 
 from nice_tpu.ckpt.snapshot import SnapshotError, read_snapshot, write_snapshot
 from nice_tpu.core.types import DataToClient, SearchMode
-from nice_tpu.obs import flight
+from nice_tpu.obs import flight, journal
 from nice_tpu.obs.series import CKPT_BYTES, CKPT_REJECTED, CKPT_WRITES
 
 log = logging.getLogger("nice_tpu.ckpt")
@@ -143,6 +143,10 @@ class FieldCheckpointer:
             "checkpoint", claim=self.data.claim_id,
             cursor=str(manifest["cursor"]), bytes=nbytes,
         )
+        journal.record_client_event(
+            "ckpt_save", claim_id=self.data.claim_id,
+            cursor=str(manifest["cursor"]), bytes=nbytes,
+        )
         log.debug(
             "checkpoint: claim %d cursor %s (%d bytes)",
             self.data.claim_id, manifest["cursor"], nbytes,
@@ -179,6 +183,10 @@ class FieldCheckpointer:
             return None
         flight.record(
             "restore", claim=self.data.claim_id,
+            cursor=str(manifest.get("cursor")),
+        )
+        journal.record_client_event(
+            "ckpt_resume", claim_id=self.data.claim_id,
             cursor=str(manifest.get("cursor")),
         )
         return _snapshot_to_state(manifest, arrays)

@@ -290,6 +290,13 @@ def compile_results(
         nice_numbers=list(results.nice_numbers),
         backend_downgrades=list(results.backend_downgrades) or None,
     )
+    if results.backend_downgrades:
+        # Client-side journal event: the engine downgrade site has no claim
+        # context, so the claim<->downgrade join happens here.
+        obs.journal.record_client_event(
+            "downgrade", claim_id=data.claim_id,
+            downgrades=list(results.backend_downgrades),
+        )
     content = json.dumps(payload.to_json(), sort_keys=True).encode()
     payload.submit_id = (
         f"{data.claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
@@ -890,11 +897,9 @@ def run_block_pipelined_loop(
 
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    level = {"trace": logging.DEBUG, "debug": logging.DEBUG, "info": logging.INFO,
-             "warn": logging.WARNING, "error": logging.ERROR}[args.log_level]
-    logging.basicConfig(
-        level=level, format="%(asctime)s %(levelname)s %(name)s: %(message)s"
-    )
+    # Unified JSON-line sink (trace_id injection; NICE_TPU_LOG_LEVEL /
+    # NICE_TPU_LOG_FILE override the CLI flag).
+    obs.logsink.install(default_level=args.log_level)
     # Local /metrics endpoint (NICE_TPU_METRICS_PORT): exposes the client's
     # field/latency series plus the engine pipeline registry.
     obs.maybe_serve_metrics()

@@ -223,10 +223,9 @@ def main(argv=None) -> int:
         help="arguments passed through to the client",
     )
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    # Unified JSON-line sink (NICE_TPU_LOG_LEVEL / NICE_TPU_LOG_FILE
+    # override the CLI flag).
+    obs.logsink.install(default_level=args.log_level)
 
     # Local /metrics (NICE_TPU_METRICS_PORT): heartbeat gauge + restart
     # counter make a silently-dead supervisor loop externally detectable.

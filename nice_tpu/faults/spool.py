@@ -30,7 +30,7 @@ from typing import Optional
 
 from nice_tpu.client import api_client
 from nice_tpu.core.types import DataToServer
-from nice_tpu.obs import flight
+from nice_tpu.obs import flight, journal
 from nice_tpu.obs.series import SPOOL_JOURNALED, SPOOL_REPLAYS
 from nice_tpu.utils import fsio
 
@@ -117,6 +117,10 @@ class SubmissionSpool:
                     data.claim_id, e, path,
                 )
                 self._quarantine(path)
+                journal.record_client_event(
+                    "spool_replay", claim_id=data.claim_id,
+                    outcome="rejected", status=e.status,
+                )
                 return "rejected"
             log.warning(
                 "spooled submission for claim %d still undeliverable (%s); "
@@ -129,6 +133,10 @@ class SubmissionSpool:
             if resp.get("duplicate") else "",
         )
         self._remove(path)
+        journal.record_client_event(
+            "spool_replay", claim_id=data.claim_id, outcome="delivered",
+            duplicate=bool(resp.get("duplicate")),
+        )
         return "delivered"
 
     @staticmethod

@@ -25,6 +25,14 @@ Five pieces, all stdlib-only at import time:
   (NICE_TPU_STEPPROF=1; off = zero extra device syncs).
 - ``slo``: declarative SLOs with multi-window burn-rate alert states
   (ok / warn / page) evaluated over the history.
+- ``journal``: the field lifecycle audit vocabulary + row builders behind
+  the server's append-only ``field_events`` table and the client-side
+  event buffer that piggybacks on telemetry.
+- ``anomaly``: fleet-pathology detectors (stuck fields, claim churn,
+  lease-expiry storms, trust-slash bursts, throughput cliffs) over the
+  journal + history, with SLO-style ok/warn/page states.
+- ``logsink``: the unified JSON-line logging formatter/installer with
+  trace_id injection (NICE_TPU_LOG_LEVEL / NICE_TPU_LOG_FILE).
 
 Env vars: NICE_TPU_METRICS_PORT (serve /metrics locally; 0 = ephemeral
 port, exported as nice_metrics_bound_port), NICE_TPU_TRACE (span sink:
@@ -33,7 +41,17 @@ sinks), NICE_TPU_PROFILE (jax profiler output dir), NICE_TPU_FLIGHT_DIR /
 NICE_TPU_FLIGHT_EVENTS (flight-recorder dump dir / ring capacity).
 """
 
-from . import flight, history, series, slo, stepprof, telemetry  # noqa: F401 — importing pre-seeds
+from . import (  # noqa: F401 — importing pre-seeds
+    anomaly,
+    flight,
+    history,
+    journal,
+    logsink,
+    series,
+    slo,
+    stepprof,
+    telemetry,
+)
 from .metrics import (  # noqa: F401
     REGISTRY,
     Counter,
@@ -75,6 +93,9 @@ __all__ = [
     "slo",
     "stepprof",
     "telemetry",
+    "journal",
+    "anomaly",
+    "logsink",
     "serve_metrics",
     "maybe_serve_metrics",
     "span",

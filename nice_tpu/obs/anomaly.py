@@ -1,0 +1,271 @@
+"""Anomaly detectors over the audit journal + metric history.
+
+Where the SLO engine (obs/slo.py) guards *service* objectives (latency,
+error ratios), the anomaly engine watches for *fleet pathologies* that no
+single request can see: fields that churn through claims without ever
+reaching canon, lease-expiry storms from a crashing client cohort, bursts
+of trust slashes, and throughput falling off a cliff relative to its own
+recent history. Detectors read the ``field_events`` journal (server/db.py)
+and the PR 10 history store, so they see *resolved* churn that the live
+gauges have already forgotten.
+
+Each detector yields a value over the look-back window
+(``NICE_TPU_ANOMALY_WINDOW_SECS``, scaled by
+``NICE_TPU_ANOMALY_WINDOW_SCALE`` for short harness runs) and maps it onto
+the familiar ok/warn/page ladder (value < warn_at -> ok; warn_at <= value
+< page_at -> warn; value >= page_at -> page), with per-detector
+``NICE_TPU_ANOMALY_<NAME>_WARN`` / ``..._PAGE`` overrides. States land in
+``nice_anomaly_state{detector}``, transitions in
+``nice_anomaly_transitions_total{detector,state}`` plus an
+``anomaly_transition`` flight event, and the latest results surface in
+``/status`` for fleet.html's anomaly strip. The server evaluates the engine
+on the writer actor's history periodic, right after each SLO pass.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Callable, Dict, List, Optional
+
+from . import flight
+from nice_tpu.utils import knobs, lockdep
+
+__all__ = ["AnomalyDetector", "AnomalyEngine", "default_detectors",
+           "STATE_LEVELS"]
+
+STATE_LEVELS = {"ok": 0, "warn": 1, "page": 2}
+
+# Claim-churn needs a minimum event volume before a ratio means anything
+# (2 claims / 0 accepts on an idle fleet is not churn).
+MIN_CHURN_CLAIMS = 10
+
+# Throughput-cliff needs enough history points for a median to be a
+# baseline rather than noise.
+MIN_CLIFF_POINTS = 5
+
+
+def window_secs() -> float:
+    try:
+        base = max(knobs.ANOMALY_WINDOW_SECS.get(), 1.0)
+        scale = max(knobs.ANOMALY_WINDOW_SCALE.get(), 1e-6)
+        return base * scale
+    except (TypeError, ValueError):
+        return 900.0
+
+
+def _iso(unix_ts: float) -> str:
+    """Unix seconds -> the ledger's ISO-8601 UTC format (matches
+    server/db.py ts(): lexicographic comparison == time order)."""
+    dt = datetime.fromtimestamp(unix_ts, tz=timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+# --- history-store helpers -------------------------------------------------
+
+
+def _counter_delta(store, prefix: str, since: float) -> Optional[float]:
+    """Window delta summed over every series matching the prefix (counters
+    are cumulative: delta = last - first). None when no series has data."""
+    total, seen = 0.0, False
+    for name in store.series_names():
+        if not name.startswith(prefix):
+            continue
+        snap = store.query(name, since=since, tiers=("raw",))
+        raw = snap.get("raw", []) if snap else []
+        if raw:
+            seen = True
+            total += max(0.0, raw[-1][1] - raw[0][1])
+    return total if seen else None
+
+
+def _gauge_points(store, prefix: str, since: float) -> List[float]:
+    out: List[float] = []
+    for name in store.series_names():
+        if not name.startswith(prefix):
+            continue
+        snap = store.query(name, since=since, tiers=("raw",))
+        raw = snap.get("raw", []) if snap else []
+        out.extend(v for _t, v in raw)
+    return out
+
+
+# --- detectors -------------------------------------------------------------
+
+
+class AnomalyDetector:
+    """One pathology. value_fn(engine, now, since_unix, since_iso) returns
+    the window value, or None when the window holds no evidence (no_data ->
+    ok, matching the SLO engine's sparse-data behavior)."""
+
+    def __init__(
+        self,
+        name: str,
+        value_fn: Callable,
+        warn_at: float,
+        page_at: float,
+        description: str = "",
+    ):
+        self.name = name
+        self.value_fn = value_fn
+        env = name.upper()
+        self.warn_at = knobs.ANOMALY_OVERRIDES.get_float(
+            f"NICE_TPU_ANOMALY_{env}_WARN", warn_at
+        )
+        self.page_at = knobs.ANOMALY_OVERRIDES.get_float(
+            f"NICE_TPU_ANOMALY_{env}_PAGE", page_at
+        )
+        self.description = description
+
+    def evaluate(self, engine: "AnomalyEngine", now: float) -> dict:
+        win = window_secs()
+        since_unix = now - win
+        value = self.value_fn(engine, now, since_unix, _iso(since_unix))
+        if value is None:
+            state = "ok"
+        elif value >= self.page_at:
+            state = "page"
+        elif value >= self.warn_at:
+            state = "warn"
+        else:
+            state = "ok"
+        return {
+            "detector": self.name,
+            "state": state,
+            "level": STATE_LEVELS[state],
+            "value": value,
+            "warn_at": self.warn_at,
+            "page_at": self.page_at,
+            "window_secs": win,
+            "no_data": value is None,
+            "description": self.description,
+        }
+
+
+def _stuck_fields(engine, now, since_unix, since_iso):
+    """Fields claimed >= NICE_TPU_ANOMALY_STUCK_CLAIMS times in the window
+    without ever reaching canon. Any stuck field pages by default — each one
+    is work the fleet keeps burning without converging — and the detector
+    recovers on its own once canon_promoted lands on the timeline."""
+    min_claims = max(knobs.ANOMALY_STUCK_CLAIMS.get(), 1)
+    return float(engine.db.count_stuck_fields(min_claims, since_iso))
+
+
+def _claim_churn(engine, now, since_unix, since_iso):
+    """claims-per-accepted-submission ratio: a healthy fleet stays near 1;
+    crash-looping clients (or a poisoned field) drive it up."""
+    claims = engine.db.count_field_events(
+        ("claimed", "block_claimed"), since_iso
+    )
+    if claims < MIN_CHURN_CLAIMS:
+        return None
+    accepts = engine.db.count_field_events(("submit_accepted",), since_iso)
+    return claims / max(float(accepts), 1.0)
+
+
+def _lease_expiry_storm(engine, now, since_unix, since_iso):
+    return float(
+        engine.db.count_field_events(("lease_expired",), since_iso)
+    )
+
+
+def _trust_slash_burst(engine, now, since_unix, since_iso):
+    return _counter_delta(
+        engine.store, "nice_server_trust_slashes_total", since_unix
+    )
+
+
+def _throughput_cliff(engine, now, since_unix, since_iso):
+    """Fractional drop of fleet throughput vs its own window median
+    (0 = at baseline, 1 = stopped). Needs enough points for the median to
+    be a baseline, and a nonzero baseline (an idle fleet is not a cliff)."""
+    points = _gauge_points(
+        engine.store, "nice_fleet_numbers_per_sec", since_unix
+    )
+    if len(points) < MIN_CLIFF_POINTS:
+        return None
+    ordered = sorted(points)
+    median = ordered[len(ordered) // 2]
+    if median <= 0:
+        return None
+    current = points[-1]
+    return max(0.0, 1.0 - current / median)
+
+
+def default_detectors() -> List[AnomalyDetector]:
+    return [
+        AnomalyDetector(
+            "stuck_fields", _stuck_fields, warn_at=1, page_at=1,
+            description="fields claimed repeatedly without reaching canon",
+        ),
+        AnomalyDetector(
+            "claim_churn", _claim_churn, warn_at=3, page_at=10,
+            description="claims per accepted submission over the window",
+        ),
+        AnomalyDetector(
+            "lease_expiry_storm", _lease_expiry_storm,
+            warn_at=10, page_at=50,
+            description="leases swept as expired inside the window",
+        ),
+        AnomalyDetector(
+            "trust_slash_burst", _trust_slash_burst, warn_at=1, page_at=5,
+            description="trust slashes inside the window",
+        ),
+        AnomalyDetector(
+            "throughput_cliff", _throughput_cliff,
+            warn_at=0.5, page_at=0.8,
+            description="fleet throughput drop vs its own window median",
+        ),
+    ]
+
+
+class AnomalyEngine:
+    """Evaluates detectors against the journal (db) + history store,
+    tracking state transitions. Thread-safe: evaluate() runs on the writer
+    periodic while /status reads last()."""
+
+    def __init__(self, db, store,
+                 detectors: Optional[List[AnomalyDetector]] = None):
+        self.db = db
+        self.store = store
+        self.detectors = (
+            detectors if detectors is not None else default_detectors()
+        )
+        self._lock = lockdep.make_lock("obs.anomaly.AnomalyEngine._lock")
+        self._states: Dict[str, str] = {}
+        self._last: List[dict] = []
+        self.transitions = 0
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        import time
+
+        now = time.time() if now is None else now
+        from .series import ANOMALY_STATE, ANOMALY_TRANSITIONS
+
+        results = []
+        for det in self.detectors:
+            try:
+                res = det.evaluate(self, now)
+            except Exception:  # noqa: BLE001 — one bad detector can't take
+                continue       # down the writer periodic
+            results.append(res)
+            ANOMALY_STATE.labels(det.name).set(res["level"])
+            with self._lock:
+                prev = self._states.get(det.name, "ok")
+                if res["state"] != prev:
+                    self._states[det.name] = res["state"]
+                    self.transitions += 1
+                    ANOMALY_TRANSITIONS.labels(det.name, res["state"]).inc()
+                    flight.record(
+                        "anomaly_transition", detector=det.name,
+                        from_state=prev, to_state=res["state"],
+                        value=res["value"],
+                    )
+                else:
+                    self._states[det.name] = res["state"]
+        with self._lock:
+            self._last = results
+        return results
+
+    def last(self) -> List[dict]:
+        with self._lock:
+            return list(self._last)

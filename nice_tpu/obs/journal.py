@@ -1,0 +1,175 @@
+"""Field lifecycle audit journal — the "what happened to field N" layer.
+
+The ledger (server/db.py) stores only *current* state: once a claim churns,
+a lease expires, or a consensus hold resolves, the evidence is gone. The
+journal is the append-only complement: every field-state transition lands in
+the ``field_events`` table as a structured event with a monotonic per-field
+sequence number, so ``GET /fields/<id>/timeline`` replays one field's whole
+life — generated -> queued -> claimed -> renewed/lease_expired ->
+submit_accepted -> spot_check -> consensus_hold -> canon_promoted (or
+disqualified -> requeued) — and ``GET /events?since=<id>`` streams the global
+feed for external consumers (the delta substrate ROADMAP items 2/4 need).
+
+This module is the shared vocabulary + row builder. Server emission sites
+call :func:`event_row` and hand the rows to ``ApiContext.journal`` (async,
+through the writer actor) or append them inside an existing write
+transaction (atomic with the state change they describe). The journal is
+best-effort by design: a failed append increments
+``nice_server_journal_write_failures_total`` and records a
+``journal_write_failed`` flight event, but never fails the request.
+
+Client-side events (checkpoint save/resume, backend downgrades, spool
+replays) cannot reach the table directly — they buffer here via
+:func:`record_client_event`, piggyback on the next ``DataToServer.telemetry``
+snapshot, and the server merges them into the same timelines with a
+``client_`` kind prefix. Client events are keyed by *claim id* (the client
+never learns raw field ids); the server resolves claim -> field at merge
+time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import trace
+from nice_tpu.utils import lockdep
+
+__all__ = [
+    "EVENT_KINDS",
+    "CLIENT_EVENT_KINDS",
+    "event_row",
+    "record_client_event",
+    "drain_client_events",
+    "client_event_rows",
+]
+
+# Server-side transition vocabulary (the timeline's causal order for a
+# healthy field is roughly left to right).
+EVENT_KINDS = (
+    "generated",         # field row created by seed_base
+    "queued",            # pre-claimed into an in-memory refill queue
+    "claimed",           # single-field claim issued
+    "block_claimed",     # claimed as part of a /claim_block lease group
+    "renewed",           # lease renewed (single claim or whole block)
+    "lease_expired",     # sweep released an abandoned lease
+    "submit_accepted",   # submission persisted
+    "submit_duplicate",  # exactly-once replay (submit_id dedup hit)
+    "submit_rejected",   # submission refused (validation / conflict)
+    "spot_check",        # trust spot-check ran (detail.verdict pass|fail)
+    "consensus_hold",    # untrusted submission held awaiting corroboration
+    "canon_promoted",    # submission became canon / check_level advanced
+    "disqualified",      # canon submission struck (spot-check fail / admin)
+    "requeued",          # field returned to the claim pool after strike
+)
+
+# Client-side kinds (merged from telemetry with this exact prefix).
+CLIENT_EVENT_KINDS = (
+    "client_ckpt_save",
+    "client_ckpt_resume",
+    "client_downgrade",
+    "client_spool_replay",
+)
+
+
+def event_row(
+    field_id: int,
+    kind: str,
+    *,
+    claim_id: Optional[int] = None,
+    client: Optional[str] = None,
+    tier: Optional[str] = None,
+    check_level: Optional[int] = None,
+    ts: Optional[str] = None,
+    **detail,
+) -> dict:
+    """Build one journal row for Db.append_field_events.
+
+    trace_id: derived from the claim when one is in hand (client and server
+    compute the same id, so both sides' spans join the event), else the
+    ambient request trace context."""
+    trace_id = (
+        trace.claim_trace_id(claim_id)
+        if claim_id is not None
+        else trace.current_trace_id()
+    )
+    if claim_id is not None:
+        detail.setdefault("claim_id", claim_id)
+    row = {
+        "field_id": int(field_id),
+        "kind": str(kind),
+        "trace_id": trace_id,
+        "client": client,
+        "tier": tier,
+        "check_level": check_level,
+        "detail": detail,
+    }
+    if ts is not None:
+        row["ts"] = ts
+    return row
+
+
+# --- client-side event buffer ---------------------------------------------
+# Bounded: a client that cannot reach the server for a while must not grow
+# memory unboundedly — oldest events drop first (the journal is diagnostic,
+# not the ledger of record).
+
+_CLIENT_BUFFER_CAP = 256
+_client_lock = lockdep.make_lock("obs.journal._client_lock")
+_client_events: list[dict] = []
+
+
+def record_client_event(kind: str, *, claim_id: Optional[int] = None,
+                        **detail) -> None:
+    """Buffer one client-side lifecycle event for the next telemetry
+    snapshot. kind is recorded without the client_ prefix (e.g.
+    "ckpt_save"); the server prefixes it at merge time."""
+    evt = {"kind": str(kind)}
+    if claim_id is not None:
+        evt["claim_id"] = int(claim_id)
+    if detail:
+        evt["detail"] = detail
+    with _client_lock:
+        _client_events.append(evt)
+        if len(_client_events) > _CLIENT_BUFFER_CAP:
+            del _client_events[: len(_client_events) - _CLIENT_BUFFER_CAP]
+
+
+def drain_client_events() -> list[dict]:
+    """Take (and clear) the buffered client events for a telemetry snapshot."""
+    with _client_lock:
+        events, _client_events[:] = list(_client_events), []
+    return events
+
+
+def client_event_rows(snap: dict, *, client: Optional[str] = None,
+                      tier: Optional[str] = None,
+                      resolve_claim=None) -> list[dict]:
+    """Server-side merge: journal rows from a telemetry snapshot's "events"
+    list. Client events carry claim ids, not field ids — resolve_claim maps
+    claim_id -> field_id (returning None to skip an unresolvable event)."""
+    rows: list[dict] = []
+    for evt in snap.get("events") or []:
+        if not isinstance(evt, dict):
+            continue
+        claim_id = evt.get("claim_id")
+        field_id = None
+        if claim_id is not None and resolve_claim is not None:
+            try:
+                field_id = resolve_claim(int(claim_id))
+            except (ValueError, TypeError):
+                field_id = None
+        if field_id is None:
+            continue
+        kind = str(evt.get("kind") or "unknown")[:64]
+        detail = evt.get("detail") if isinstance(evt.get("detail"), dict) else {}
+        rows.append(
+            event_row(
+                field_id,
+                f"client_{kind}",
+                claim_id=int(claim_id),
+                client=client,
+                tier=tier,
+                **detail,
+            )
+        )
+    return rows

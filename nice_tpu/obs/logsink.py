@@ -1,0 +1,93 @@
+"""Unified JSON-line log sink for every nice-tpu entry point.
+
+Before this module, each main() called logging.basicConfig with its own
+format string and the 18 modules' ``logging.getLogger`` loggers emitted
+free-text lines that grep could not join with the structured trace/journal
+sinks. install() configures the root logger once with a JSON formatter
+that stamps every record with the ambient ``trace_id`` (obs/trace.py
+context), so a server handler's log lines group with the same request's
+spans and journal events on the one id.
+
+Knobs (typed registry, K1-clean):
+  NICE_TPU_LOG_LEVEL — root level (trace/debug/info/warn/error); unset
+      falls back to the installing main's default (e.g. the server's
+      --log-level flag).
+  NICE_TPU_LOG_FILE  — additionally append JSON lines to this file.
+
+install() is idempotent-by-force: it replaces root handlers
+(basicConfig(force=True)), so calling it from a main that already
+configured logging simply re-points the sink.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+from . import trace
+from nice_tpu.utils import knobs
+
+__all__ = ["JsonFormatter", "install", "resolve_level"]
+
+# "trace" is a client-CLI convention (extra-verbose debug), not a stdlib
+# level — map it onto DEBUG.
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def resolve_level(default: str = "info") -> int:
+    """Root level: NICE_TPU_LOG_LEVEL wins, else the caller's default."""
+    name = (knobs.LOG_LEVEL.get() or default or "info").strip().lower()
+    return _LEVELS.get(name, logging.INFO)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts/level/logger/msg, the ambient trace_id
+    when a trace context is active, and a formatted traceback under "exc"
+    for records carrying exc_info."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        tid = trace.current_trace_id()
+        if tid:
+            out["trace_id"] = tid
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=repr, separators=(",", ":"))
+
+
+def install(default_level: str = "info") -> None:
+    """Point the root logger at the JSON sink (stderr + optional file)."""
+    formatter = JsonFormatter()
+    handlers: list[logging.Handler] = [logging.StreamHandler(sys.stderr)]
+    log_file: Optional[str] = knobs.LOG_FILE.get()
+    if log_file:
+        try:
+            # nicelint: allow A1 (streaming append-only log sink)
+            handlers.append(logging.FileHandler(log_file, encoding="utf-8"))
+        except OSError as exc:
+            print(
+                f"nice_tpu.obs: cannot open log sink {log_file!r}: {exc}",
+                file=sys.stderr,
+            )
+    for h in handlers:
+        h.setFormatter(formatter)
+    logging.basicConfig(
+        level=resolve_level(default_level), handlers=handlers, force=True
+    )
+    # UTC everywhere, matching the trace sink and the ledger's timestamps.
+    logging.Formatter.converter = time.gmtime

@@ -405,6 +405,33 @@ HISTORY_PERSISTED_ROWS = metrics.counter(
     "metric_history rows persisted through the writer actor.",
 )
 
+# --- field lifecycle audit journal + anomaly engine ----------------------
+SERVER_JOURNAL_EVENTS = metrics.counter(
+    "nice_server_journal_events_total",
+    "field_events journal rows appended, by event kind.",
+    labelnames=("kind",),
+)
+SERVER_JOURNAL_WRITE_FAILURES = metrics.counter(
+    "nice_server_journal_write_failures_total",
+    "Journal appends that failed inside the writer actor (the audit plane "
+    "is best-effort: a failed append never fails the request it describes).",
+)
+SERVER_JOURNAL_PRUNED = metrics.counter(
+    "nice_server_journal_pruned_total",
+    "field_events rows dropped by the retention sweep.",
+)
+ANOMALY_STATE = metrics.gauge(
+    "nice_anomaly_state",
+    "Anomaly-detector alert state (0 = ok, 1 = warn, 2 = page), by "
+    "detector.",
+    labelnames=("detector",),
+)
+ANOMALY_TRANSITIONS = metrics.counter(
+    "nice_anomaly_transitions_total",
+    "Anomaly-detector state transitions, by detector and entered state.",
+    labelnames=("detector", "state"),
+)
+
 # --- local metrics endpoint (obs/serve.py) -------------------------------
 METRICS_BOUND_PORT = metrics.gauge(
     "nice_metrics_bound_port",
@@ -486,6 +513,14 @@ for _from, _to in (("pallas", "jnp"), ("jnp", "scalar")):
 for _slo in ("claim_p99", "submit_success", "feed_idle_p95",
              "spot_check_fail"):
     SLO_STATE.labels(_slo)
+for _detector in ("stuck_fields", "claim_churn", "lease_expiry_storm",
+                  "trust_slash_burst", "throughput_cliff"):
+    ANOMALY_STATE.labels(_detector)
+for _kind in ("generated", "queued", "claimed", "block_claimed", "renewed",
+              "lease_expired", "submit_accepted", "submit_duplicate",
+              "submit_rejected", "spot_check", "consensus_hold",
+              "canon_promoted", "disqualified", "requeued"):
+    SERVER_JOURNAL_EVENTS.labels(_kind)
 
 # Flight-recorder + tracing series (M1: declared here, used by obs.flight /
 # obs.trace). Kinds the production hooks emit are pre-seeded so a scrape of
@@ -513,7 +548,10 @@ FLIGHT_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint",
                       # sites) and SLO alerting — a post-crash dump must
                       # explain them.
                       "mesh_reshard", "device_loss", "spot_check_fail",
-                      "trust_slash", "consensus_hold", "slo_transition")
+                      "trust_slash", "consensus_hold", "slo_transition",
+                      # audit plane (journal write failures are silent
+                      # otherwise; anomaly transitions mirror slo_transition)
+                      "journal_write_failed", "anomaly_transition")
 for _kind in FLIGHT_KNOWN_KINDS:
     FLIGHT_EVENTS.labels(_kind)
 for _reason in ("crash", "sigusr2", "quarantine", "manual"):

@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Optional
 
-from . import series
+from . import journal, series
 from nice_tpu.utils import lockdep
 
 __all__ = ["snapshot", "client_id", "SNAPSHOT_VERSION"]
@@ -110,4 +110,11 @@ def snapshot(
     }
     if phase_breakdown:
         out["phase_breakdown"] = phase_breakdown
+    # Client-side audit events (ckpt save/resume, downgrade, spool replay)
+    # piggyback on the snapshot; the server merges them into the same
+    # field_events timeline (obs/journal.py). Omitted when empty to keep
+    # the wire size stable.
+    events = journal.drain_client_events()
+    if events:
+        out["events"] = events
     return out

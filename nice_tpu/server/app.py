@@ -62,6 +62,7 @@ from nice_tpu.obs.series import (
     SERVER_CONSENSUS_HOLDS,
     SERVER_DUPLICATE_SUBMITS,
     SERVER_FIELD_ELAPSED,
+    SERVER_JOURNAL_WRITE_FAILURES,
     SERVER_LEASES_EXPIRED,
     SERVER_OVERLOAD_RESPONSES,
     SERVER_RATE_LIMITED,
@@ -170,7 +171,7 @@ class ApiContext:
                 "released %d orphaned pre-claimed fields from a dead"
                 " server's queue inventory", orphaned,
             )
-        self.queue = FieldQueue(db, writer=self.writer)
+        self.queue = FieldQueue(db, writer=self.writer, journal=self.journal)
         self.metrics = Metrics()
         # Untrusted-client hardening: the trust ledger cache (spot-check
         # sampling rates, claim profiles) and the per-client token-bucket
@@ -216,7 +217,11 @@ class ApiContext:
         # — they never touch SQLite. NICE_TPU_HISTORY_SECS=0 disables.
         self.history = obs.history.HistoryStore()
         self.slo = obs.slo.SloEngine(self.history)
+        # Anomaly engine: fleet-pathology detectors over the audit journal
+        # + history store, evaluated on the same observatory beat.
+        self.anomaly = obs.anomaly.AnomalyEngine(db, self.history)
         self.history_retention_secs = knobs.HISTORY_RETENTION_SECS.get()
+        self.journal_retention_secs = knobs.JOURNAL_RETENTION_SECS.get()
         self._last_history_prune = time.monotonic()
         history_secs = obs.history.sample_interval_secs()
         if history_secs > 0:
@@ -234,19 +239,57 @@ class ApiContext:
         if rows:
             HISTORY_PERSISTED_ROWS.inc(self.db.insert_metric_history(rows))
         self.slo.evaluate()
+        self.anomaly.evaluate()
         now = time.monotonic()
-        if self.history_retention_secs > 0 and (
-            now - self._last_history_prune >= 600.0
-        ):
+        if now - self._last_history_prune >= 600.0:
             self._last_history_prune = now
-            self.db.prune_metric_history(
-                time.time() - self.history_retention_secs
-            )
+            if self.history_retention_secs > 0:
+                self.db.prune_metric_history(
+                    time.time() - self.history_retention_secs
+                )
+            if self.journal_retention_secs > 0:
+                from datetime import timedelta
+
+                from nice_tpu.server.db import now_utc, ts
+
+                cutoff = now_utc() - timedelta(
+                    seconds=self.journal_retention_secs
+                )
+                self.db.prune_field_events(ts(cutoff))
 
     def write(self, fn, *args, **kwargs):
         """Run one mutation through the writer actor, blocking for its
         result (exceptions — notably IntegrityError — re-raise here)."""
         return self.writer.call(fn, *args, **kwargs)
+
+    def journal(self, rows: list) -> None:
+        """Append audit-journal rows through the writer actor, fire and
+        forget: the audit plane never blocks a request and never fails
+        one. Emission sites that already run inside a writer op call
+        journal_now instead (their events commit atomically with the state
+        change they describe)."""
+        if not rows:
+            return
+        try:
+            self.writer.submit(self.journal_now, rows)
+        except Exception:  # noqa: BLE001 — WriterClosed during shutdown
+            pass
+
+    def journal_now(self, rows: list) -> None:
+        """Append journal rows in the current transaction context (writer
+        thread). Failure is contained here: append_field_events's nested
+        savepoint rolls back only the journal rows, the metric + flight
+        event record that evidence went missing, and the enclosing
+        operation proceeds untouched."""
+        if not rows:
+            return
+        try:
+            self.db.append_field_events(rows)
+        except Exception:  # noqa: BLE001 — the journal must never take
+            # down the mutation it annotates
+            SERVER_JOURNAL_WRITE_FAILURES.inc()
+            obs.flight.record("journal_write_failed", count=len(rows))
+            log.exception("audit journal append failed (%d events)", len(rows))
 
     def _bucket_multiplier(self, key: str) -> float:
         """Trusted veterans earn bigger rate-limit buckets (up to 4x).
@@ -258,7 +301,14 @@ class ApiContext:
         return 1.0 + min(3.0, float(row.get("trust", 0.0)) / 25.0)
 
     def _sweep_leases(self) -> None:
-        if self.db.release_expired_leases():
+        released = self.db.release_expired_leases()
+        if released:
+            self.journal_now(
+                [
+                    obs.journal.event_row(fid, "lease_expired")
+                    for fid in released
+                ]
+            )
             self.invalidate_status_cache()
 
     def cached_fleet_block(self) -> dict:
@@ -322,6 +372,16 @@ def _claim_lease_secs(untrusted: bool) -> float:
     if untrusted:
         return _untrusted_lease_secs()
     return knobs.CLAIM_EXPIRY_SECS.get(default=CLAIM_DURATION_HOURS * 3600)
+
+
+def _trust_tier(ctx: ApiContext, client_token) -> str:
+    """Resolved trust tier for journal events (cache-only read)."""
+    if client_token is None:
+        return "trusted"
+    row = ctx.trust.peek(client_token)
+    if row and row.get("suspect"):
+        return "suspect"
+    return "trusted" if ctx.trust.is_trusted(client_token) else "untrusted"
 
 
 def _untrusted_max_field() -> int:
@@ -456,6 +516,7 @@ def claim_helper(
         search_mode, untrusted
     )
     lease_secs = _claim_lease_secs(untrusted)
+    tier = _trust_tier(ctx, client_token)
 
     def op():
         fields = _claim_fields(
@@ -472,6 +533,13 @@ def claim_helper(
             field.field_id, search_mode, user_ip,
             client_token=client_token, lease_secs=lease_secs,
         )
+        ctx.journal_now([
+            obs.journal.event_row(
+                field.field_id, "claimed", claim_id=claim.claim_id,
+                client=client_token, tier=tier,
+                check_level=field.check_level, mode=search_mode.value,
+            )
+        ])
         return field, claim
 
     field, claim = ctx.write(op)
@@ -525,6 +593,7 @@ def handle_claim_block(
         search_mode, untrusted
     )
     lease_secs = _claim_lease_secs(untrusted)
+    tier = _trust_tier(ctx, client_token)
 
     def op():
         fields = _claim_fields(
@@ -542,6 +611,15 @@ def handle_claim_block(
             [f.field_id for f in fields], search_mode, user_ip, block_id,
             client_token=client_token, lease_secs=lease_secs,
         )
+        ctx.journal_now([
+            obs.journal.event_row(
+                field.field_id, "block_claimed", claim_id=claim.claim_id,
+                client=client_token, tier=tier,
+                check_level=field.check_level, block=block_id,
+                mode=search_mode.value,
+            )
+            for field, claim in zip(fields, claims)
+        ])
         return block_id, fields, claims
 
     block_id, fields, claims = ctx.write(op)
@@ -587,6 +665,17 @@ class PreparedSubmission:
 
 def _submit_duplicate_reply(ctx: ApiContext, data: DataToServer) -> dict:
     SERVER_DUPLICATE_SUBMITS.inc()
+    try:
+        claim = ctx.db.get_claim_by_id(data.claim_id)
+    except KeyError:
+        claim = None
+    if claim is not None:
+        ctx.journal([
+            obs.journal.event_row(
+                claim.field_id, "submit_duplicate", claim_id=data.claim_id,
+                submit_id=data.submit_id,
+            )
+        ])
     log.info(
         "Duplicate Submission replay: claim=%d submit_id=%s answered "
         "idempotently", data.claim_id, data.submit_id,
@@ -661,6 +750,10 @@ def _verify_submission(
                 ctx.db.update_field_canon_and_cl(
                     field.field_id, field.canon_submission_id, 1
                 )
+            _journal_submit_accepted(
+                ctx, field, data.claim_id, client_token, trusted,
+                "niceonly", sid,
+            )
             return sid
 
         return PreparedSubmission(
@@ -746,6 +839,10 @@ def _verify_submission(
                 )
             if field.check_level <= 1:
                 ctx.db.release_field_claims([field.field_id])
+        _journal_submit_accepted(
+            ctx, field, data.claim_id, client_token, trusted,
+            "detailed", sid,
+        )
         return sid
 
     return PreparedSubmission(
@@ -754,6 +851,52 @@ def _verify_submission(
         field=field, distribution_expanded=distribution_expanded,
         numbers_expanded=numbers_expanded, submit_key=submit_key,
     )
+
+
+def _journal_submit_accepted(
+    ctx: ApiContext, field, claim_id: int, client_token, trusted: bool,
+    mode_label: str, submission_id: int,
+) -> None:
+    """Journal rows for one accepted submission, called from INSIDE the
+    persist closure so the events commit atomically with the ledger change.
+    A trusted detailed submission that advances the field past the detailed
+    bar also lands its canon_promoted event here — the promotion and its
+    evidence are one commit."""
+    tier = _trust_tier(ctx, client_token)
+    rows = [
+        obs.journal.event_row(
+            field.field_id, "submit_accepted",
+            claim_id=claim_id, client=client_token,
+            tier=tier, check_level=field.check_level,
+            submission=submission_id, mode=mode_label,
+        )
+    ]
+    if mode_label == "detailed" and trusted and field.check_level < 2:
+        rows.append(
+            obs.journal.event_row(
+                field.field_id, "canon_promoted",
+                claim_id=claim_id, client=client_token,
+                tier=tier, check_level=2, submission=submission_id,
+                via="trusted_submit",
+            )
+        )
+    ctx.journal_now(rows)
+
+
+def _journal_submit_rejected(ctx: ApiContext, payload, err: ApiError) -> None:
+    """Best-effort submit_rejected event: the field is resolved through the
+    payload's claim id; an unresolvable claim has no timeline to annotate
+    and is skipped silently."""
+    try:
+        claim = ctx.db.get_claim_by_id(int(payload.get("claim_id")))
+    except (KeyError, TypeError, ValueError):
+        return
+    ctx.journal([
+        obs.journal.event_row(
+            claim.field_id, "submit_rejected", claim_id=claim.claim_id,
+            status=err.status, reason=err.message[:200],
+        )
+    ])
 
 
 def _submit_accounting(
@@ -802,6 +945,13 @@ def _streaming_consensus(ctx: ApiContext, field_id: int) -> None:
         ctx.write(
             ctx.db.update_field_canon_and_cl, field_id, canon_id, cl
         )
+        if canon_id is not None and canon_id != field.canon_submission_id:
+            ctx.journal([
+                obs.journal.event_row(
+                    field_id, "canon_promoted", check_level=cl,
+                    submission=canon_id, via="consensus",
+                )
+            ])
         ctx.invalidate_status_cache()
         log.info(
             "streaming consensus: field=%d canon=%s cl=%d (%d submissions)",
@@ -813,6 +963,12 @@ def _streaming_consensus(ctx: ApiContext, field_id: int) -> None:
             "consensus_hold", field=field_id, cl=field.check_level,
             submissions=len(subs), untrusted=len(untrusted_ids),
         )
+        ctx.journal([
+            obs.journal.event_row(
+                field_id, "consensus_hold", check_level=field.check_level,
+                submissions=len(subs), untrusted=len(untrusted_ids),
+            )
+        ])
 
 
 def _post_accept_trust(
@@ -851,6 +1007,24 @@ def _post_accept_trust(
         row = ctx.write(slash_op)
         ctx.trust.update(row)
         ctx.invalidate_status_cache()
+        ctx.journal([
+            obs.journal.event_row(
+                prep.field.field_id, "spot_check",
+                claim_id=prep.data.claim_id, client=prep.client_token,
+                tier="suspect", verdict="fail", submission=submission_id,
+            ),
+            obs.journal.event_row(
+                prep.field.field_id, "disqualified",
+                claim_id=prep.data.claim_id, client=prep.client_token,
+                tier="suspect", submission=submission_id,
+                reason="spot_check_fail",
+            ),
+            obs.journal.event_row(
+                prep.field.field_id, "requeued",
+                claim_id=prep.data.claim_id, client=prep.client_token,
+                tier="suspect",
+            ),
+        ])
         obs.flight.record(
             "spot_check_fail", client=prep.client_token,
             submission=submission_id, field=prep.field.field_id,
@@ -868,6 +1042,15 @@ def _post_accept_trust(
         passed_delta=1 if verdict == "pass" else 0,
     )
     ctx.trust.update(row)
+    if verdict == "pass":
+        ctx.journal([
+            obs.journal.event_row(
+                prep.field.field_id, "spot_check",
+                claim_id=prep.data.claim_id, client=prep.client_token,
+                tier=_trust_tier(ctx, prep.client_token), verdict="pass",
+                submission=submission_id,
+            )
+        ])
     if not prep.trusted and prep.mode_label == "detailed":
         _streaming_consensus(ctx, prep.field.field_id)
 
@@ -876,7 +1059,11 @@ def handle_submit(
     ctx: ApiContext, payload: dict, user_ip: str, headers=None
 ) -> dict:
     """Verify + persist a submission (reference api/src/main.rs:241-404)."""
-    prep = _verify_submission(ctx, payload, user_ip, headers)
+    try:
+        prep = _verify_submission(ctx, payload, user_ip, headers)
+    except ApiError as e:
+        _journal_submit_rejected(ctx, payload, e)
+        raise
     if prep.persist is None:
         return _submit_duplicate_reply(ctx, prep.data)
     try:
@@ -917,6 +1104,7 @@ def handle_submit_block(
         try:
             prepared.append(_verify_submission(ctx, item, user_ip, headers))
         except ApiError as e:
+            _journal_submit_rejected(ctx, item, e)
             prepared.append(e)
 
     def batch_op():
@@ -983,6 +1171,12 @@ def handle_renew_claim(ctx: ApiContext, payload: dict) -> dict:
         renewed_at, count = ctx.write(ctx.db.renew_block, block_id)
         if count == 0:
             raise ApiError(404, f"Invalid block_id {block_id!r}")
+        ctx.journal([
+            obs.journal.event_row(
+                c.field_id, "renewed", claim_id=c.claim_id, block=block_id,
+            )
+            for c in ctx.db.get_block_claims(block_id)
+        ])
         return {
             "status": "OK", "renewed_at": ts(renewed_at), "renewed": count,
         }
@@ -993,6 +1187,15 @@ def handle_renew_claim(ctx: ApiContext, payload: dict) -> dict:
         renewed_at = ctx.write(ctx.db.renew_claim, claim_id)
     except KeyError as e:
         raise ApiError(404, f"Invalid claim_id {claim_id}: {e}")
+    try:
+        claim = ctx.db.get_claim_by_id(claim_id)
+        ctx.journal([
+            obs.journal.event_row(
+                claim.field_id, "renewed", claim_id=claim_id,
+            )
+        ])
+    except KeyError:
+        pass
     return {"status": "OK", "renewed_at": ts(renewed_at)}
 
 
@@ -1009,9 +1212,27 @@ def _persist_telemetry(
     except (ValueError, sqlite3.Error) as e:
         log.warning("discarding bad telemetry snapshot (%s): %s", source, e)
         return False
+    # Client-side lifecycle events (ckpt save/resume, downgrades, spool
+    # replays) piggyback on the snapshot; merge them into the same
+    # field_events timelines, keyed claim -> field (clients never learn
+    # raw field ids).
+    rows = obs.journal.client_event_rows(
+        snap,
+        client=str(snap.get("client_id") or "") or None,
+        resolve_claim=lambda cid: _field_for_claim(ctx, cid),
+    )
+    if rows:
+        ctx.journal(rows)
     SERVER_TELEMETRY_REPORTS.labels(source).inc()
     ctx.invalidate_status_cache()
     return True
+
+
+def _field_for_claim(ctx: ApiContext, claim_id: int):
+    try:
+        return ctx.db.get_claim_by_id(claim_id).field_id
+    except KeyError:
+        return None
 
 
 def handle_telemetry(ctx: ApiContext, payload: dict, user_ip: str) -> dict:
@@ -1154,10 +1375,26 @@ def handle_disqualify(ctx: ApiContext, payload: dict, headers) -> dict:
             )
 
         def op():
+            try:
+                field_id = ctx.db.get_submission_by_id(
+                    submission_id
+                ).field_id
+            except KeyError:
+                field_id = None
             changed = ctx.db.disqualify_submission(submission_id)
             requeued = ctx.db.requeue_disqualified_fields(
                 submission_ids=[submission_id]
             )
+            if changed and field_id is not None:
+                ctx.journal_now([
+                    obs.journal.event_row(
+                        field_id, "disqualified", submission=submission_id,
+                        reason="admin",
+                    ),
+                    obs.journal.event_row(
+                        field_id, "requeued", reason="admin",
+                    ),
+                ])
             return changed, requeued
 
     elif "username" in payload:
@@ -1191,7 +1428,7 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root", "token", "history"}
+     "root", "token", "history", "fields", "events"}
 )
 
 _CORS_HEADERS = {
@@ -1408,6 +1645,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "writer_queue_depth": ctx.writer.queue_depth(),
                     "fleet": ctx.cached_fleet_block(),
                     "slo": ctx.slo.last(),
+                    "anomalies": ctx.anomaly.last(),
                 },
             )
         if method == "GET" and path == "/history":
@@ -1420,6 +1658,47 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                 status = h_status
                 return _json_response(h_status, h_body)
             return _json_response(200, h_body)
+        if (
+            method == "GET"
+            and path.startswith("/fields/")
+            and path.endswith("/timeline")
+        ):
+            # Field drill-down: the causally-ordered audit waterfall for
+            # one field (per-field seq is the order; the ts column is
+            # advisory).
+            fid_arg = path[len("/fields/"):-len("/timeline")]
+            try:
+                field_id = int(fid_arg)
+            except ValueError:
+                raise ApiError(400, f"Invalid field id {fid_arg!r}")
+            events = ctx.db.get_field_timeline(field_id)
+            if not events:
+                raise ApiError(404, f"no journal events for field {field_id}")
+            return _json_response(
+                200, {"field_id": field_id, "events": events},
+            )
+        if method == "GET" and path == "/events":
+            # Cursor-paginated global journal feed: ?since=<id> returns
+            # events with id > since, ascending; pass the reply's "cursor"
+            # back as the next since. limit is clamped server-side.
+            qs = parse_qs(parsed.query)
+            try:
+                since = int(qs.get("since", ["0"])[0])
+                limit = int(
+                    qs.get("limit", [str(knobs.JOURNAL_FEED_LIMIT.get())])[0]
+                )
+            except ValueError:
+                raise ApiError(400, "since and limit must be integers")
+            limit = max(1, min(limit, knobs.JOURNAL_FEED_LIMIT.get()))
+            events = ctx.db.get_events_since(since, limit)
+            return _json_response(
+                200,
+                {
+                    "events": events,
+                    "cursor": events[-1]["id"] if events else since,
+                    "more": len(events) == limit,
+                },
+            )
         if method == "GET" and path == "/debug/flight":
             return _json_response(
                 200,
@@ -1662,10 +1941,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("--log-level", default="info")
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    # Unified JSON log sink (trace_id-stamped lines; NICE_TPU_LOG_LEVEL /
+    # NICE_TPU_LOG_FILE override the CLI default).
+    obs.logsink.install(default_level=args.log_level)
     # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR); the live
     # ring is also served at GET /debug/flight.
     obs.flight.install()

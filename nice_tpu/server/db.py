@@ -32,6 +32,8 @@ from nice_tpu.obs.series import (
     SERVER_CLAIM_EXPIRY,
     SERVER_CLAIM_RENEWALS,
     SERVER_FIELDS_RELEASED,
+    SERVER_JOURNAL_EVENTS,
+    SERVER_JOURNAL_PRUNED,
     SERVER_LEASES_EXPIRED,
     SERVER_SQLITE_BUSY_RETRIES,
 )
@@ -346,6 +348,18 @@ class Db:
                 "INSERT INTO fields (base_id, chunk_id, range_start, range_end,"
                 " range_size) VALUES (?, ?, ?, ?, ?)",
                 _rows(),
+            )
+            # Journal birth: every field's timeline starts at seq 1 with a
+            # "generated" event, written in the same transaction as the field
+            # rows (one SELECT-driven insert, fast even for ~10^5-field
+            # bases). OR IGNORE keeps a re-seed of an existing base from
+            # tripping the (field_id, seq) uniqueness of the first run.
+            self._conn.execute(
+                "INSERT OR IGNORE INTO field_events"
+                " (field_id, seq, ts, kind, detail)"
+                " SELECT id, 1, ?, 'generated', '{}' FROM fields"
+                " WHERE base_id = ?",
+                (ts(now_utc()), base),
             )
         return len(fields)
 
@@ -679,21 +693,22 @@ class Db:
         SERVER_FIELDS_RELEASED.inc(released)
         return released
 
-    def release_expired_leases(self) -> int:
+    def release_expired_leases(self) -> list[int]:
         """Background sweep (writer-actor periodic): clear the field lease
         behind every claim whose explicit lease_expiry has passed without a
         submission, so abandoned micro-field claims re-enter the claim pool
         in seconds instead of waiting out the global expiry cutoff. A field
         is left alone while ANY unexpired unsubmitted claim still covers it
         (a re-issued field's second lease must not be swept by the first
-        client's corpse). Returns fields released; legacy NULL-expiry claims
-        are never touched."""
+        client's corpse). Returns the released field ids (the caller journals
+        a lease_expired event per field); legacy NULL-expiry claims are never
+        touched."""
         now = ts(now_utc())
         with self._lock, self._txn():
-            cur = self._conn.execute(
+            rows = self._conn.execute(
                 """
-                UPDATE fields SET last_claim_time = NULL
-                WHERE last_claim_time IS NOT NULL AND id IN (
+                SELECT f.id FROM fields f
+                WHERE f.last_claim_time IS NOT NULL AND f.id IN (
                   SELECT c.field_id FROM claims c
                   WHERE c.lease_expiry IS NOT NULL AND c.lease_expiry < :now
                     AND NOT EXISTS (SELECT 1 FROM submissions s
@@ -706,10 +721,15 @@ class Db:
                                         WHERE s2.claim_id = c2.id)))
                 """,
                 {"now": now},
-            )
-            released = cur.rowcount
+            ).fetchall()
+            released = [int(r["id"]) for r in rows]
+            if released:
+                self._conn.executemany(
+                    "UPDATE fields SET last_claim_time = NULL WHERE id = ?",
+                    [(fid,) for fid in released],
+                )
         if released:
-            SERVER_LEASES_EXPIRED.inc(released)
+            SERVER_LEASES_EXPIRED.inc(len(released))
         return released
 
     def release_orphaned_inventory(self) -> int:
@@ -1487,6 +1507,136 @@ class Db:
                 (float(cutoff_ts),),
             )
             return cur.rowcount
+
+    # -- field lifecycle audit journal ------------------------------------
+    # Append-only event rows written through the writer actor (or inside an
+    # existing write transaction: _txn nests as a savepoint, so emission
+    # sites inside claim/submit ops commit atomically with the state change
+    # they describe). Row shape comes from obs/journal.py:event_row.
+
+    def append_field_events(self, rows: list[dict]) -> int:
+        """Append journal events; assigns each row the next per-field seq.
+
+        The per-field MAX(seq)+1 read is race-free because every write path
+        runs under self._lock (single-writer actor); rows for the same field
+        within one batch sequence correctly because each insert lands before
+        the next row's MAX runs."""
+        if not rows:
+            return 0
+        with self._lock, self._txn():
+            for row in rows:
+                fid = int(row["field_id"])
+                seq = self._conn.execute(
+                    "SELECT COALESCE(MAX(seq), 0) + 1 FROM field_events"
+                    " WHERE field_id = ?",
+                    (fid,),
+                ).fetchone()[0]
+                self._conn.execute(
+                    "INSERT INTO field_events (field_id, seq, ts, kind,"
+                    " trace_id, client, tier, check_level, detail)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    (
+                        fid,
+                        seq,
+                        row.get("ts") or ts(now_utc()),
+                        str(row["kind"]),
+                        row.get("trace_id"),
+                        row.get("client"),
+                        row.get("tier"),
+                        row.get("check_level"),
+                        json.dumps(row.get("detail") or {}, sort_keys=True),
+                    ),
+                )
+        for row in rows:
+            SERVER_JOURNAL_EVENTS.labels(str(row["kind"])).inc()
+        return len(rows)
+
+    @staticmethod
+    def _event_row_to_dict(r) -> dict:
+        try:
+            detail = json.loads(r["detail"] or "{}")
+        except (ValueError, TypeError):
+            detail = {}
+        return {
+            "id": int(r["id"]),
+            "field_id": int(r["field_id"]),
+            "seq": int(r["seq"]),
+            "ts": r["ts"],
+            "kind": r["kind"],
+            "trace_id": r["trace_id"],
+            "client": r["client"],
+            "tier": r["tier"],
+            "check_level": r["check_level"],
+            "detail": detail,
+        }
+
+    def get_field_timeline(self, field_id: int) -> list[dict]:
+        """One field's full journal, causally ordered by per-field seq."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT * FROM field_events WHERE field_id = ?"
+                " ORDER BY seq ASC",
+                (int(field_id),),
+            ).fetchall()
+        return [self._event_row_to_dict(r) for r in rows]
+
+    def get_events_since(self, since_id: int = 0, limit: int = 500) -> list[dict]:
+        """Cursor-paginated global feed: events with id > since_id, ascending
+        (pass the last row's id back as the next cursor)."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT * FROM field_events WHERE id > ?"
+                " ORDER BY id ASC LIMIT ?",
+                (int(since_id), int(limit)),
+            ).fetchall()
+        return [self._event_row_to_dict(r) for r in rows]
+
+    def count_field_events(self, kinds: tuple, since_iso: str) -> int:
+        """How many journal events of the given kinds landed since the ISO
+        timestamp (anomaly-detector window counts)."""
+        if not kinds:
+            return 0
+        marks = ",".join("?" for _ in kinds)
+        with self._read_conn() as conn:
+            row = conn.execute(
+                f"SELECT COUNT(*) FROM field_events"
+                f" WHERE kind IN ({marks}) AND ts >= ?",
+                (*[str(k) for k in kinds], str(since_iso)),
+            ).fetchone()
+        return int(row[0])
+
+    def count_stuck_fields(self, min_claims: int, since_iso: str) -> int:
+        """Fields claimed >= min_claims times inside the window that have
+        never reached canon (no canon_promoted event on their timeline)."""
+        with self._read_conn() as conn:
+            row = conn.execute(
+                """
+                SELECT COUNT(*) FROM (
+                  SELECT field_id, COUNT(*) AS n FROM field_events
+                  WHERE kind IN ('claimed', 'block_claimed') AND ts >= ?
+                  GROUP BY field_id HAVING n >= ?
+                ) g
+                WHERE NOT EXISTS (
+                  SELECT 1 FROM field_events e
+                  WHERE e.field_id = g.field_id
+                    AND e.kind = 'canon_promoted')
+                """,
+                (str(since_iso), int(min_claims)),
+            ).fetchone()
+        return int(row[0])
+
+    def prune_field_events(self, cutoff_iso: str) -> int:
+        """Retention sweep: drop journal rows older than the ISO cutoff
+        (lexicographic comparison == time order for our fixed format)."""
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "DELETE FROM field_events WHERE ts < ?",
+                (str(cutoff_iso),),
+            )
+            pruned = cur.rowcount
+        if pruned:
+            SERVER_JOURNAL_PRUNED.inc(pruned)
+        return pruned
 
     def get_recent_field_elapsed(self, limit: int = 200) -> list[float]:
         """elapsed_secs of the most recent submissions (for the fleet p50/p95

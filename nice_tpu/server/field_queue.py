@@ -52,9 +52,13 @@ class FieldQueue:
     with the rest of the server's write traffic instead of competing for
     BEGIN IMMEDIATE."""
 
-    def __init__(self, db: Db, start_thread: bool = True, writer=None):
+    def __init__(self, db: Db, start_thread: bool = True, writer=None,
+                 journal=None):
         self.db = db
         self.writer = writer
+        # Optional audit-journal sink (ApiContext.journal): refills append a
+        # "queued" event per pre-claimed field, fire-and-forget.
+        self.journal = journal
         self._niceonly: deque[FieldRecord] = deque()
         self._detailed_thin: deque[FieldRecord] = deque()
         self._lock = lockdep.make_lock("server.field_queue.FieldQueue._lock")
@@ -173,6 +177,7 @@ class FieldQueue:
         with self._lock:
             self._niceonly.extend(fields)
         SERVER_FIELD_QUEUE_REFILLS.labels("niceonly").inc()
+        self._journal_queued(fields, "niceonly")
         log.info("refilled niceonly queue with %d fields", len(fields))
 
     def refill_detailed_thin(self) -> None:
@@ -190,4 +195,15 @@ class FieldQueue:
         with self._lock:
             self._detailed_thin.extend(fields)
         SERVER_FIELD_QUEUE_REFILLS.labels("detailed_thin").inc()
+        self._journal_queued(fields, "detailed_thin")
         log.info("refilled detailed-thin queue with %d fields", len(fields))
+
+    def _journal_queued(self, fields, queue_name: str) -> None:
+        if self.journal is None or not fields:
+            return
+        from nice_tpu.obs import journal as journal_mod
+
+        self.journal([
+            journal_mod.event_row(f.field_id, "queued", queue=queue_name)
+            for f in fields
+        ])

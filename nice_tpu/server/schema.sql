@@ -175,6 +175,33 @@ CREATE TABLE IF NOT EXISTS metric_history (
 
 CREATE INDEX IF NOT EXISTS idx_metric_history_ts ON metric_history(ts);
 
+-- Field lifecycle audit journal: one append-only row per field-state
+-- transition (generated -> queued -> claimed -> ... -> canon_promoted),
+-- written through the writer actor. id is the global feed cursor
+-- (GET /events?since=<id>); (field_id, seq) is the per-field monotonic
+-- timeline order (GET /fields/<id>/timeline). trace_id joins the claim's
+-- distributed trace; client/tier/check_level snapshot the resolved
+-- identity at event time. detail is a small JSON blob of kind-specific
+-- context. Pruned by retention sweep (NICE_TPU_JOURNAL_RETENTION_SECS).
+CREATE TABLE IF NOT EXISTS field_events (
+    id              INTEGER PRIMARY KEY AUTOINCREMENT,
+    field_id        INTEGER NOT NULL,
+    seq             INTEGER NOT NULL,              -- per-field monotonic
+    ts              TEXT NOT NULL,                 -- ISO-8601 UTC
+    kind            TEXT NOT NULL,
+    trace_id        TEXT,
+    client          TEXT,
+    tier            TEXT,
+    check_level     INTEGER,
+    detail          TEXT NOT NULL DEFAULT '{}',    -- JSON
+    UNIQUE (field_id, seq)
+);
+
+CREATE INDEX IF NOT EXISTS idx_field_events_field
+    ON field_events(field_id, seq);
+CREATE INDEX IF NOT EXISTS idx_field_events_ts ON field_events(ts);
+CREATE INDEX IF NOT EXISTS idx_field_events_kind_ts ON field_events(kind, ts);
+
 CREATE TABLE IF NOT EXISTS client_trust (
     client_token    TEXT PRIMARY KEY,
     trust           REAL NOT NULL DEFAULT 0,

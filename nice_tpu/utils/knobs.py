@@ -513,6 +513,52 @@ SLO_OVERRIDES = _family(
     " NICE_TPU_SLO_CLAIM_P99_THRESHOLD.",
     owner="obs/slo.py", group="obs",
 )
+LOG_LEVEL = _k(
+    "NICE_TPU_LOG_LEVEL", "str", None,
+    "Root log level for the unified JSON log sink (trace/debug/info/warn/"
+    "error; unset = the installing main's default).",
+    owner="obs/logsink.py", group="obs",
+)
+LOG_FILE = _k(
+    "NICE_TPU_LOG_FILE", "str", None,
+    "Append JSON log lines to this file in addition to stderr (unset ="
+    " stderr only).",
+    owner="obs/logsink.py", group="obs",
+)
+JOURNAL_RETENTION_SECS = _k(
+    "NICE_TPU_JOURNAL_RETENTION_SECS", "float", 7 * 24 * 3600.0,
+    "field_events audit-journal retention (pruned on the writer periodic;"
+    " 0 disables pruning).",
+    owner="server/app.py", group="obs",
+)
+JOURNAL_FEED_LIMIT = _k(
+    "NICE_TPU_JOURNAL_FEED_LIMIT", "int", 500,
+    "Max rows per GET /events page (the cursor feed's server-side clamp).",
+    owner="server/app.py", group="obs",
+)
+ANOMALY_WINDOW_SECS = _k(
+    "NICE_TPU_ANOMALY_WINDOW_SECS", "float", 900.0,
+    "Look-back window the anomaly detectors evaluate over.",
+    owner="obs/anomaly.py", group="obs",
+)
+ANOMALY_WINDOW_SCALE = _k(
+    "NICE_TPU_ANOMALY_WINDOW_SCALE", "float", 1.0,
+    "Scales every anomaly-detector window (short harness runs exercise"
+    " real ok->page->ok transitions in seconds).",
+    owner="obs/anomaly.py", group="obs",
+)
+ANOMALY_STUCK_CLAIMS = _k(
+    "NICE_TPU_ANOMALY_STUCK_CLAIMS", "int", 5,
+    "Claims inside the window after which a never-canon field counts as"
+    " stuck.",
+    owner="obs/anomaly.py", group="obs",
+)
+ANOMALY_OVERRIDES = _family(
+    "NICE_TPU_ANOMALY_", ("_WARN", "_PAGE"), "float",
+    "Per-detector warn/page threshold overrides, e.g."
+    " NICE_TPU_ANOMALY_CLAIM_CHURN_PAGE.",
+    owner="obs/anomaly.py", group="obs",
+)
 
 # -- chaos / fault injection -----------------------------------------------
 FAULTS = _k(

@@ -1,0 +1,310 @@
+"""Audit-journal smoke check: gap-free timelines across a SIGKILL, plus a
+forced stuck-field anomaly round trip.
+
+Runs a real server subprocess with a 1 s history cadence and drives the
+field lifecycle through the public API:
+
+  1. claim + submit one detailed field to canon, then SIGKILL the server
+     and restart it on the same ledger;
+  2. after the restart, claim a field and deliberately sit on it with
+     NICE_TPU_ANOMALY_STUCK_CLAIMS=1 — the stuck_fields detector must go
+     ok -> page in /status (and nice_anomaly_state in /metrics must read
+     2) while the claim is open;
+  3. submit the stuck field to canon — the detector must recover to ok,
+     and both transitions must be visible as anomaly_transition flight
+     events at /debug/flight;
+  4. every canon-promoted field's GET /fields/<id>/timeline must be
+     gap-free (contiguous per-field seq from 1) and causally ordered
+     (claimed before submit_accepted before canon_promoted) ACROSS the
+     kill — lifecycle events commit in the same transaction as the state
+     change they describe, so -9 can't shear the history.
+
+Artifacts: timelines.json (every field's reconstructed timeline) and
+anomalies.json (the observed /status anomaly snapshots + flight
+transitions) in the workdir. Prints ONE JSON line. Usage:
+
+    python scripts/audit_smoke.py [workdir]
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 10  # [47, 100) -> 3 fields at field_size=20
+FIELD_SIZE = 20
+POLL_SECS = 0.1
+ANOMALY_WAIT_SECS = 30.0
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_server(db_path: str, port: int, log_path: str, env: dict):
+    logf = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "nice_tpu.server",
+            "--db", db_path, "--host", "127.0.0.1", "--port", str(port),
+        ],
+        stdout=logf, stderr=subprocess.STDOUT, env=env,
+    )
+    return proc, logf
+
+
+def _wait_listening(port: int, proc, timeout: float = 30) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(POLL_SECS)
+    return False
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_text(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def _claim(api_base: str):
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import SearchMode
+
+    return api_client.get_field_from_server(
+        SearchMode.DETAILED, api_base, "audit-smoke", max_retries=2
+    )
+
+
+def _submit(api_base: str, data) -> dict:
+    """Scalar-oracle submission (no jax): same payload shape + submit_id
+    derivation as client/main.py compile_results."""
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import DataToServer, FieldSize
+    from nice_tpu.ops import scalar
+
+    results = scalar.process_range_detailed(
+        FieldSize(data.range_start, data.range_end), data.base
+    )
+    payload = DataToServer(
+        claim_id=data.claim_id,
+        username="audit-smoke",
+        client_version="audit-smoke",
+        unique_distribution=list(results.distribution),
+        nice_numbers=list(results.nice_numbers),
+    )
+    content = json.dumps(payload.to_json(), sort_keys=True).encode()
+    payload.submit_id = (
+        f"{data.claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return api_client.submit_field_to_server(api_base, payload, max_retries=2)
+
+
+def _stuck_state(api_base: str):
+    status = _get(f"{api_base}/status")
+    for a in status.get("anomalies") or []:
+        if a.get("detector") == "stuck_fields":
+            return a.get("state")
+    return None
+
+
+def _wait_stuck_state(api_base: str, want: str, seen: list):
+    deadline = time.monotonic() + ANOMALY_WAIT_SECS
+    while time.monotonic() < deadline:
+        state = _stuck_state(api_base)
+        if state is not None and (not seen or seen[-1] != state):
+            seen.append(state)
+        if state == want:
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    if len(sys.argv) > 1:
+        workdir = sys.argv[1]
+        os.makedirs(workdir, exist_ok=True)
+        cleanup = False
+    else:
+        workdir = tempfile.mkdtemp(prefix="audit-smoke-")
+        cleanup = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from nice_tpu.server.db import Db
+
+    db_path = os.path.join(workdir, "audit.db")
+    db = Db(db_path)
+    db.seed_base(BASE, field_size=FIELD_SIZE)
+    field_ids = [f.field_id for f in db.get_fields_in_base(BASE)]
+    db.close()
+
+    # 1 s history cadence so the anomaly engine evaluates fast; one open
+    # claim is enough to page stuck_fields.
+    env = dict(
+        os.environ,
+        NICE_TPU_HISTORY_SECS="1",
+        NICE_TPU_ANOMALY_STUCK_CLAIMS="1",
+        NICE_TPU_ANOMALY_WINDOW_SECS="600",
+    )
+    port = _pick_port()
+    api_base = f"http://127.0.0.1:{port}"
+    server_log = os.path.join(workdir, "server.log")
+    server, logf = _start_server(db_path, port, server_log, env)
+
+    failures = []
+    stuck_states: list = []
+    line = {"workdir": workdir, "fields": len(field_ids)}
+    try:
+        if not _wait_listening(port, server):
+            failures.append("server never listened")
+            raise RuntimeError
+        # Baseline: the detector must settle at ok before we force it.
+        if not _wait_stuck_state(api_base, "ok", stuck_states):
+            failures.append(
+                f"stuck_fields never reached ok pre-kill (saw {stuck_states})"
+            )
+
+        # Phase 1: one field to canon, then a real -9 mid-run.
+        first = _claim(api_base)
+        _submit(api_base, first)
+        server.send_signal(signal.SIGKILL)
+        server.wait(timeout=30)
+        logf.close()
+        server, logf = _start_server(db_path, port, server_log, env)
+        if not _wait_listening(port, server):
+            failures.append("server never listened after restart")
+            raise RuntimeError
+        line["killed_and_restarted"] = True
+
+        # Phase 2: force the stuck-field anomaly — claim and sit.
+        stuck = _claim(api_base)
+        if not _wait_stuck_state(api_base, "page", stuck_states):
+            failures.append(
+                f"stuck_fields never paged (states seen: {stuck_states})"
+            )
+        metrics = _get_text(f"{api_base}/metrics")
+        if 'nice_anomaly_state{detector="stuck_fields"} 2' not in metrics:
+            failures.append("nice_anomaly_state gauge did not read 2 (page)")
+
+        # Phase 3: promote the stuck field — the detector must recover.
+        _submit(api_base, stuck)
+        if not _wait_stuck_state(api_base, "ok", stuck_states):
+            failures.append(
+                f"stuck_fields never recovered (states seen: {stuck_states})"
+            )
+        metrics = _get_text(f"{api_base}/metrics")
+        if 'nice_anomaly_state{detector="stuck_fields"} 0' not in metrics:
+            failures.append("nice_anomaly_state gauge did not recover to 0")
+        if "page" not in stuck_states or stuck_states[-1] != "ok":
+            failures.append(
+                f"/status did not show ok -> page -> ok: {stuck_states}"
+            )
+
+        flight = _get(f"{api_base}/debug/flight")
+        flips = [
+            e for e in (flight.get("events") or [])
+            if e.get("kind") == "anomaly_transition"
+            and e.get("detector") == "stuck_fields"
+        ]
+        pairs = {(e.get("from_state"), e.get("to_state")) for e in flips}
+        if ("ok", "page") not in pairs or ("page", "ok") not in pairs:
+            failures.append(
+                f"flight missing anomaly transitions (saw {sorted(pairs)})"
+            )
+        line["anomaly_states_observed"] = stuck_states
+        line["anomaly_flight_transitions"] = len(flips)
+
+        # Phase 4: every canon-promoted timeline must be gap-free and
+        # causally ordered ACROSS the kill.
+        timelines = {}
+        canon_fields = []
+        for fid in field_ids:
+            tl = _get(f"{api_base}/fields/{fid}/timeline")
+            events = tl["events"]
+            timelines[fid] = events
+            seqs = [e["seq"] for e in events]
+            kinds = [e["kind"] for e in events]
+            if seqs != list(range(1, len(seqs) + 1)):
+                failures.append(f"field {fid}: seq gaps {seqs}")
+            if not kinds or kinds[0] != "generated":
+                failures.append(f"field {fid}: missing generated event")
+            if "canon_promoted" not in kinds:
+                continue
+            canon_fields.append(fid)
+            claim_idxs = [
+                kinds.index(k) for k in ("claimed", "block_claimed")
+                if k in kinds
+            ]
+            if not claim_idxs:
+                failures.append(f"field {fid}: canon without a claim event")
+                continue
+            if not (min(claim_idxs) < kinds.index("submit_accepted")
+                    < kinds.index("canon_promoted")):
+                failures.append(
+                    f"field {fid}: causal order violated: {kinds}"
+                )
+        if len(canon_fields) < 2:
+            failures.append(
+                f"expected >=2 canon fields (pre-kill + post-restart), "
+                f"got {canon_fields}"
+            )
+        line["canon_fields"] = canon_fields
+
+        # Artifacts for the CI upload.
+        with open(os.path.join(workdir, "timelines.json"), "w") as f:
+            json.dump({"base": BASE, "timelines": timelines}, f, indent=2)
+        with open(os.path.join(workdir, "anomalies.json"), "w") as f:
+            json.dump(
+                {
+                    "states_observed": stuck_states,
+                    "final_status_anomalies": _get(
+                        f"{api_base}/status"
+                    ).get("anomalies"),
+                    "flight_transitions": flips,
+                },
+                f, indent=2,
+            )
+    except RuntimeError:
+        pass
+    finally:
+        if server.poll() is None:
+            server.terminate()
+            try:
+                server.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                server.kill()
+                server.wait(timeout=15)
+        logf.close()
+
+    line["ok"] = not failures
+    line["failures"] = failures
+    line["elapsed_secs"] = round(time.monotonic() - t_start, 1)
+    print(json.dumps(line), flush=True)
+    if cleanup and not failures:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,492 @@
+"""Audit-journal + anomaly-engine tests: event-row building, the client-side
+event buffer and telemetry merge, per-field seq contiguity at the Db layer,
+the timeline / events-feed routes, anomaly state transitions (including the
+forced stuck-field ok -> page -> ok round trip), and a genuine server
+SIGKILL + restart asserting gap-free causally-ordered timelines."""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nice_tpu import obs
+from nice_tpu.obs import anomaly as anomaly_mod
+from nice_tpu.obs import journal
+from nice_tpu.server.db import Db, now_utc, ts
+
+
+# -- event-row building -----------------------------------------------------
+
+
+def test_event_row_derives_trace_from_claim():
+    row = journal.event_row(7, "claimed", claim_id=42, client="tok",
+                            tier="trusted", check_level=1, mode="Detailed")
+    assert row["field_id"] == 7 and row["kind"] == "claimed"
+    # Claim-derived trace id: client and server compute the same value, so
+    # both sides' spans join the event.
+    assert row["trace_id"] == obs.claim_trace_id(42)
+    assert row["detail"]["claim_id"] == 42
+    assert row["detail"]["mode"] == "Detailed"
+    assert row["client"] == "tok" and row["tier"] == "trusted"
+    assert row["check_level"] == 1
+
+
+def test_event_row_falls_back_to_ambient_trace():
+    with obs.trace_context(obs.claim_trace_id(99)):
+        row = journal.event_row(1, "queued", queue="niceonly")
+    assert row["trace_id"] == obs.claim_trace_id(99)
+    assert journal.event_row(1, "queued")["trace_id"] is None
+
+
+# -- client-side buffer -----------------------------------------------------
+
+
+def test_client_event_buffer_drains_and_bounds():
+    journal.drain_client_events()  # isolate from other tests
+    journal.record_client_event("ckpt_save", claim_id=3, cursor="10")
+    journal.record_client_event("downgrade", downgrades=["jnp->scalar"])
+    events = journal.drain_client_events()
+    assert [e["kind"] for e in events] == ["ckpt_save", "downgrade"]
+    assert events[0]["claim_id"] == 3
+    assert events[0]["detail"]["cursor"] == "10"
+    assert journal.drain_client_events() == []
+    # Bounded: oldest events drop first.
+    for i in range(journal._CLIENT_BUFFER_CAP + 10):
+        journal.record_client_event("ckpt_save", claim_id=i)
+    events = journal.drain_client_events()
+    assert len(events) == journal._CLIENT_BUFFER_CAP
+    assert events[0]["claim_id"] == 10  # the first ten dropped
+
+
+def test_client_event_rows_resolve_claims():
+    snap = {"events": [
+        {"kind": "ckpt_save", "claim_id": 5, "detail": {"cursor": "1"}},
+        {"kind": "spool_replay", "claim_id": 6},   # unresolvable -> skipped
+        {"kind": "downgrade"},                     # no claim -> skipped
+        "garbage",
+    ]}
+    rows = journal.client_event_rows(
+        snap, client="me@host/1",
+        resolve_claim=lambda cid: 77 if cid == 5 else None,
+    )
+    assert len(rows) == 1
+    assert rows[0]["field_id"] == 77
+    assert rows[0]["kind"] == "client_ckpt_save"
+    assert rows[0]["client"] == "me@host/1"
+    assert rows[0]["detail"]["cursor"] == "1"
+
+
+# -- Db layer ---------------------------------------------------------------
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Db(str(tmp_path / "journal.db"))
+    yield d
+    d.close()
+
+
+def test_seed_base_journals_generated(db):
+    db.seed_base(10, field_size=20)  # 3 fields
+    for fid in (1, 2, 3):
+        events = db.get_field_timeline(fid)
+        assert [e["kind"] for e in events] == ["generated"]
+        assert events[0]["seq"] == 1
+    # Re-seeding must not duplicate the generated events.
+    db.seed_base(10, field_size=20)
+    assert len(db.get_field_timeline(1)) == 1
+
+
+def test_append_assigns_contiguous_per_field_seq(db):
+    db.seed_base(10, field_size=20)
+    db.append_field_events([
+        journal.event_row(1, "queued", queue="niceonly"),
+        journal.event_row(2, "queued", queue="niceonly"),
+        journal.event_row(1, "claimed", claim_id=11),
+    ])
+    db.append_field_events([journal.event_row(1, "submit_accepted",
+                                              claim_id=11)])
+    tl1 = db.get_field_timeline(1)
+    assert [e["seq"] for e in tl1] == [1, 2, 3, 4]
+    assert [e["kind"] for e in tl1] == [
+        "generated", "queued", "claimed", "submit_accepted"]
+    tl2 = db.get_field_timeline(2)
+    assert [(e["seq"], e["kind"]) for e in tl2] == [
+        (1, "generated"), (2, "queued")]
+    # detail JSON round-trips.
+    assert tl1[1]["detail"] == {"queue": "niceonly"}
+    assert tl1[2]["detail"]["claim_id"] == 11
+
+
+def test_events_feed_cursor_pagination(db):
+    db.seed_base(10, field_size=20)  # 3 generated events
+    db.append_field_events(
+        [journal.event_row(1, "queued", queue="niceonly")])
+    page1 = db.get_events_since(0, limit=2)
+    assert len(page1) == 2
+    page2 = db.get_events_since(page1[-1]["id"], limit=100)
+    assert len(page2) == 2
+    ids = [e["id"] for e in page1 + page2]
+    assert ids == sorted(ids) and len(set(ids)) == 4
+
+
+def test_prune_and_counts(db):
+    db.seed_base(10, field_size=20)
+    old = "2000-01-01T00:00:00.000000Z"
+    db.append_field_events([
+        journal.event_row(1, "claimed", claim_id=1, ts=old),
+        journal.event_row(1, "lease_expired", ts=old),
+        journal.event_row(1, "claimed", claim_id=2),
+    ])
+    assert db.count_field_events(("claimed", "block_claimed"),
+                                 "1999-01-01T00:00:00.000000Z") == 2
+    # Window excludes the old events.
+    recent = ts(now_utc()).replace("T", "T")[:11] + "00:00:00.000000Z"
+    assert db.count_field_events(("lease_expired",), recent) == 0
+    # Field 1: two claims ever, no canon_promoted -> stuck at min_claims=2
+    # over an all-time window, not stuck once canon lands.
+    assert db.count_stuck_fields(2, old) == 1
+    db.append_field_events(
+        [journal.event_row(1, "canon_promoted", via="consensus")])
+    assert db.count_stuck_fields(2, old) == 0
+    # Retention pruning drops only the old rows.
+    pruned = db.prune_field_events("2001-01-01T00:00:00.000000Z")
+    assert pruned == 2
+    kinds = [e["kind"] for e in db.get_field_timeline(1)]
+    assert "lease_expired" not in kinds and "claimed" in kinds
+
+
+# -- server integration -----------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    from nice_tpu.server import app as server_app
+
+    monkeypatch.setenv("NICE_TPU_HISTORY_SECS", "3600")  # tick manually
+    db_path = str(tmp_path / "srv.db")
+    d = Db(db_path)
+    d.seed_base(10, field_size=20)
+    d.close()
+    srv = server_app.serve(db_path, host="127.0.0.1", port=0, prefill=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv.context
+    srv.shutdown()
+
+
+def _claim_and_submit(base_url):
+    from nice_tpu.client import api_client
+    from nice_tpu.client.main import compile_results, process_field
+    from nice_tpu.core.types import SearchMode
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "tester", max_retries=0
+    )
+    results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+    sub = compile_results(data, results, SearchMode.DETAILED, "tester")
+    api_client.submit_field_to_server(base_url, sub, max_retries=0)
+    return data
+
+
+def test_timeline_route_covers_lifecycle(server):
+    base_url, ctx = server
+    data = _claim_and_submit(base_url)
+    # Writer-side events (queued) are async; flush via a blocking write.
+    ctx.write(lambda: None)
+    field_id = _find_field_id(ctx, data)
+    tl = _get(f"{base_url}/fields/{field_id}/timeline")
+    assert tl["field_id"] == field_id
+    kinds = [e["kind"] for e in tl["events"]]
+    assert kinds[0] == "generated"
+    assert "claimed" in kinds and "submit_accepted" in kinds
+    # Trusted detailed submit promotes straight to canon.
+    assert "canon_promoted" in kinds
+    assert kinds.index("claimed") < kinds.index("submit_accepted")
+    assert kinds.index("submit_accepted") < kinds.index("canon_promoted")
+    seqs = [e["seq"] for e in tl["events"]]
+    assert seqs == list(range(1, len(seqs) + 1))
+    # The claim events carry identity + trace.
+    claimed = tl["events"][kinds.index("claimed")]
+    assert claimed["tier"] == "trusted"
+    assert claimed["trace_id"] == obs.claim_trace_id(data.claim_id)
+
+    # Unknown field -> 404; bad id -> 400.
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{base_url}/fields/999999/timeline")
+    assert err.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{base_url}/fields/bogus/timeline")
+    assert err.value.code == 400
+
+
+def _find_field_id(ctx, data):
+    for f in ctx.db.get_fields_in_base(10):
+        if (f.range_start, f.range_end) == (data.range_start, data.range_end):
+            return f.field_id
+    raise AssertionError("claimed field not found in base")
+
+
+def test_events_feed_route_pagination(server):
+    base_url, ctx = server
+    _claim_and_submit(base_url)
+    ctx.write(lambda: None)
+    page = _get(f"{base_url}/events?since=0&limit=2")
+    assert len(page["events"]) == 2 and page["more"] is True
+    assert page["cursor"] == page["events"][-1]["id"]
+    rest = _get(f"{base_url}/events?since={page['cursor']}&limit=500")
+    ids = [e["id"] for e in page["events"] + rest["events"]]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    # Feed exhausted: cursor echoes back, more is False.
+    tail = _get(f"{base_url}/events?since={rest['cursor']}")
+    assert tail["events"] == [] and tail["cursor"] == rest["cursor"]
+    assert tail["more"] is False
+
+
+def test_telemetry_merges_client_events(server):
+    base_url, ctx = server
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import SearchMode
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "tester", max_retries=0
+    )
+    _post(f"{base_url}/telemetry", {
+        "client_id": "tester@host/1",
+        "events": [
+            {"kind": "ckpt_save", "claim_id": data.claim_id,
+             "detail": {"cursor": "123"}},
+            {"kind": "ckpt_save", "claim_id": 999999},  # unresolvable
+        ],
+    })
+    ctx.write(lambda: None)
+    field_id = _find_field_id(ctx, data)
+    tl = _get(f"{base_url}/fields/{field_id}/timeline")
+    merged = [e for e in tl["events"] if e["kind"] == "client_ckpt_save"]
+    assert len(merged) == 1
+    assert merged[0]["client"] == "tester@host/1"
+    assert merged[0]["detail"]["cursor"] == "123"
+    assert merged[0]["trace_id"] == obs.claim_trace_id(data.claim_id)
+
+
+def test_journal_write_failure_never_raises(server):
+    _, ctx = server
+    from nice_tpu.obs.series import SERVER_JOURNAL_WRITE_FAILURES
+
+    before = SERVER_JOURNAL_WRITE_FAILURES.value()
+    ctx.journal_now([{"malformed": True}])  # KeyError inside append
+    assert SERVER_JOURNAL_WRITE_FAILURES.value() == before + 1
+
+
+def test_lease_sweep_journals_expirations(server, monkeypatch):
+    base_url, ctx = server
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import SearchMode
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "tester", max_retries=0
+    )
+    field_id = _find_field_id(ctx, data)
+    # Force the lease stale, then sweep.
+    with ctx.db._lock, ctx.db._txn():
+        ctx.db._conn.execute(
+            "UPDATE claims SET claim_time = ?, lease_expiry = ? WHERE id = ?",
+            ("2000-01-01T00:00:00.000000Z", "2000-01-01T00:00:00.000000Z",
+             data.claim_id),
+        )
+        ctx.db._conn.execute(
+            "UPDATE fields SET last_claim_time = ? WHERE id = ?",
+            ("2000-01-01T00:00:00.000000Z", field_id),
+        )
+    ctx._sweep_leases()
+    kinds = [e["kind"] for e in ctx.db.get_field_timeline(field_id)]
+    assert "lease_expired" in kinds
+
+
+# -- anomaly engine ---------------------------------------------------------
+
+
+def test_detector_threshold_ladder(monkeypatch):
+    values = iter([None, 0.0, 5.0, 50.0])
+    det = anomaly_mod.AnomalyDetector(
+        "testdet", lambda *_a: next(values), warn_at=5, page_at=50)
+    states = [det.evaluate(None, 0.0) for _ in range(4)]
+    assert [s["state"] for s in states] == ["ok", "ok", "warn", "page"]
+    assert states[0]["no_data"] is True
+
+
+def test_detector_env_overrides(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_ANOMALY_TESTDET_WARN", "100")
+    monkeypatch.setenv("NICE_TPU_ANOMALY_TESTDET_PAGE", "200")
+    det = anomaly_mod.AnomalyDetector(
+        "testdet", lambda *_a: 150.0, warn_at=5, page_at=50)
+    assert det.warn_at == 100 and det.page_at == 200
+    assert det.evaluate(None, 0.0)["state"] == "warn"
+
+
+def test_engine_records_transitions_and_gauges(tmp_path):
+    from nice_tpu.obs.series import ANOMALY_STATE
+
+    d = Db(str(tmp_path / "anom.db"))
+    try:
+        values = {"v": 0.0}
+        det = anomaly_mod.AnomalyDetector(
+            "testdet", lambda *_a: values["v"], warn_at=1, page_at=2)
+        eng = anomaly_mod.AnomalyEngine(d, None, detectors=[det])
+        assert eng.evaluate(now=1.0)[0]["state"] == "ok"
+        values["v"] = 5.0
+        res = eng.evaluate(now=2.0)
+        assert res[0]["state"] == "page"
+        assert eng.transitions == 1
+        assert ANOMALY_STATE.labels("testdet").value() == 2
+        values["v"] = 0.0
+        eng.evaluate(now=3.0)
+        assert eng.transitions == 2
+        assert ANOMALY_STATE.labels("testdet").value() == 0
+        assert [r["detector"] for r in eng.last()] == ["testdet"]
+    finally:
+        d.close()
+
+
+def test_stuck_field_anomaly_round_trip(server, monkeypatch):
+    """The acceptance-criteria path in-process: a field claimed repeatedly
+    without canon pages the stuck_fields detector; promotion recovers it."""
+    base_url, ctx = server
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import SearchMode
+
+    monkeypatch.setenv("NICE_TPU_ANOMALY_STUCK_CLAIMS", "1")
+    assert _states(ctx)["stuck_fields"] == "ok"
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, base_url, "tester", max_retries=0
+    )
+    assert _states(ctx)["stuck_fields"] == "page"
+    # /status carries the anomaly block.
+    status = _get(f"{base_url}/status")
+    by_name = {a["detector"]: a for a in status["anomalies"]}
+    assert by_name["stuck_fields"]["state"] == "page"
+
+    # Submitting to canon clears the pathology on the next evaluation.
+    from nice_tpu.client.main import compile_results, process_field
+    results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+    sub = compile_results(data, results, SearchMode.DETAILED, "tester")
+    api_client.submit_field_to_server(base_url, sub, max_retries=0)
+    assert _states(ctx)["stuck_fields"] == "ok"
+
+    # The ok -> page -> ok transitions landed in the flight ring.
+    flips = [
+        e for e in obs.flight.snapshot()
+        if e["kind"] == "anomaly_transition"
+        and e.get("detector") == "stuck_fields"
+    ]
+    pairs = [(e["from_state"], e["to_state"]) for e in flips]
+    assert ("ok", "page") in pairs and ("page", "ok") in pairs
+
+
+def _states(ctx):
+    return {r["detector"]: r["state"] for r in ctx.anomaly.evaluate()}
+
+
+# -- SIGKILL durability -----------------------------------------------------
+
+
+def _pick_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_server(db_path, port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "nice_tpu.server",
+         "--db", db_path, "--host", "127.0.0.1", "--port", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_listening(port, proc, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(0.05)
+    return False
+
+
+def test_sigkill_leaves_gap_free_timelines(tmp_path):
+    """Kill -9 mid-run, restart on the same ledger, keep working: every
+    field's timeline stays contiguous (seq 1..N, no gaps) and causally
+    ordered across the outage, because lifecycle events commit in the same
+    transaction as the state change they describe."""
+    db_path = str(tmp_path / "kill.db")
+    d = Db(db_path)
+    d.seed_base(10, field_size=20)
+    d.close()
+    port = _pick_port()
+    base_url = f"http://127.0.0.1:{port}"
+
+    server = _start_server(db_path, port)
+    try:
+        assert _wait_listening(port, server), "server never listened"
+        first = _claim_and_submit(base_url)
+        server.send_signal(signal.SIGKILL)
+        server.wait(timeout=30)
+
+        server = _start_server(db_path, port)
+        assert _wait_listening(port, server), "restart never listened"
+        second = _claim_and_submit(base_url)
+    finally:
+        server.kill()
+        server.wait(timeout=30)
+
+    d = Db(db_path)
+    try:
+        canon_fields = []
+        for f in d.get_fields_in_base(10):
+            events = d.get_field_timeline(f.field_id)
+            kinds = [e["kind"] for e in events]
+            seqs = [e["seq"] for e in events]
+            # Gap-free: contiguous per-field sequence from 1.
+            assert seqs == list(range(1, len(seqs) + 1)), (
+                f"field {f.field_id} has seq gaps: {seqs}")
+            assert kinds[0] == "generated"
+            if "canon_promoted" in kinds:
+                canon_fields.append(f.field_id)
+                claim_idx = min(
+                    kinds.index(k) for k in ("claimed", "block_claimed")
+                    if k in kinds
+                )
+                assert claim_idx < kinds.index("submit_accepted")
+                assert (kinds.index("submit_accepted")
+                        < kinds.index("canon_promoted"))
+        # Both the pre-kill and post-restart submissions reached canon with
+        # full histories.
+        assert len(canon_fields) >= 2
+        assert first.claim_id != second.claim_id
+    finally:
+        d.close()

@@ -20,6 +20,15 @@ lint:
         echo "lint: ruff not installed; skipped the generic floor"
     fi
 
+# jaxpr-level kernel verification (traces real plans; CPU-only, ~7 min full
+# sweep — use `just jaxlint-fast` while iterating)
+jaxlint:
+    JAX_PLATFORMS=cpu python scripts/jaxlint.py --strict
+
+# jaxlint over the cheapest base only (seconds, catches most drift)
+jaxlint-fast:
+    JAX_PLATFORMS=cpu python scripts/jaxlint.py --strict --bases 40
+
 # rewrite the nicelint ratchet baseline (justify every entry you keep)
 lint-baseline:
     python scripts/nicelint.py --update-baseline

@@ -15,7 +15,8 @@ import os
 import re
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
-# ``# nicelint: allow W1 (reason)`` / ``# nicelint: allow W1,K1 (reason)``
+# Escape grammar: a comment of the form "nicelint: allow <RULE>[,<RULE>...]"
+# with an optional parenthesised reason, on the flagged line or the line above.
 _ALLOW_RE = re.compile(
     r"#\s*nicelint:\s*allow\s+([A-Z]\d(?:\s*,\s*[A-Z]\d)*)\b"
 )
@@ -107,13 +108,37 @@ class SourceFile:
     def allowed(self, rule: str, line: int) -> bool:
         """True when ``line`` (or the line above, for markers placed on
         their own comment line) carries an allow for ``rule``."""
+        return self.allow_site(rule, line) is not None
+
+    def allow_site(self, rule: str, line: int) -> Optional[int]:
+        """The marker line that allows ``rule`` at ``line``, or None."""
         if self._allows is None:
             self._scan_markers()
         for ln in (line, line - 1):
             rules = self._allows.get(ln)
             if rules and rule in rules:
-                return True
-        return False
+                return ln
+        return None
+
+    def allow_markers(self) -> Dict[int, Set[str]]:
+        """marker line -> rule ids, for the dead-suppression audit."""
+        if self._allows is None:
+            self._scan_markers()
+        return dict(self._allows)
+
+    def string_spanned_lines(self) -> Set[int]:
+        """Lines covered by string constants (docstrings, fixture sources).
+        Escape markers on these lines are documentation, not suppressions —
+        the dead-suppression audit must not count them."""
+        tree = self.tree()
+        if tree is None:
+            return set()
+        out: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                out.update(range(node.lineno, end + 1))
+        return out
 
     def is_fence(self, line: int) -> bool:
         if self._fences is None:
@@ -198,20 +223,110 @@ def all_rules() -> Dict[str, Rule]:
     return dict(_RULES)
 
 
+AllowSite = Tuple[str, int, str]  # (path, marker line, rule id)
+
+
+def filter_allowed(
+    project: Project, violations: Iterable[Violation]
+) -> Tuple[List[Violation], Set[AllowSite]]:
+    """Drop inline-allowed findings; also return the marker sites that
+    actually suppressed something (the dead-suppression audit's ground
+    truth)."""
+    kept: List[Violation] = []
+    used: Set[AllowSite] = set()
+    for v in violations:
+        src = project.get(v.path)
+        site = src.allow_site(v.rule, v.line) if src is not None else None
+        if site is not None:
+            used.add((v.path, site, v.rule))
+            continue
+        kept.append(v)
+    return kept, used
+
+
 def run_rules(project: Project,
               only: Optional[Iterable[str]] = None) -> List[Violation]:
     """Run rule families (all by default) and drop inline-allowed findings."""
+    return run_rules_tracked(project, only=only)[0]
+
+
+def run_rules_tracked(
+    project: Project,
+    only: Optional[Iterable[str]] = None,
+    registry: Optional[Dict[str, Rule]] = None,
+) -> Tuple[List[Violation], Set[AllowSite]]:
+    """run_rules plus the set of allow-marker sites that fired. ``registry``
+    swaps in a different rule family (jaxlint passes its J-rules)."""
+    rules = registry if registry is not None else all_rules()
     wanted = set(only) if only else None
-    out: List[Violation] = []
-    for rule_id, fn in sorted(all_rules().items()):
+    raw: List[Violation] = []
+    for rule_id, fn in sorted(rules.items()):
         if wanted is not None and rule_id not in wanted:
             continue
-        for v in fn(project):
-            src = project.get(v.path)
-            if src is not None and src.allowed(v.rule, v.line):
-                continue
-            out.append(v)
+        raw.extend(fn(project))
+    out, used = filter_allowed(project, raw)
     out.sort(key=lambda v: (v.path, v.line, v.rule, v.detail))
+    return out, used
+
+
+# -- dead-suppression audit (rule S1) ---------------------------------------
+
+DEAD_SUPPRESSION_RULE = "S1"
+# Escape markers inside tests/ stay exempt: rule-fixture sources embed the
+# grammar in string literals and harness files legitimately park allows that
+# only fire for some fixture variants.
+DEAD_SUPPRESSION_SKIP = ("tests/",)
+
+
+def dead_suppressions(
+    project: Project,
+    ran_rules: Iterable[str],
+    used: Set[AllowSite],
+    skip_prefixes: Tuple[str, ...] = DEAD_SUPPRESSION_SKIP,
+) -> List[Violation]:
+    """Allow markers whose rule no longer fires at that site. Only markers
+    naming a rule in ``ran_rules`` are judged — a K1 allow is not dead just
+    because the run was --rules W1. Identity is line-number-free:
+    rule S1, detail ``dead:<rule>:<enclosing scope>``."""
+    ran = set(ran_rules)
+    out: List[Violation] = []
+    for src in project.python_files():
+        if src.relpath.startswith(skip_prefixes):
+            continue
+        markers = src.allow_markers()
+        if not markers:
+            continue
+        doc_lines = src.string_spanned_lines()
+        scopes = _line_scope_map(src)
+        for line in sorted(markers):
+            if line in doc_lines:
+                continue
+            for rule_id in sorted(markers[line]):
+                if rule_id not in ran:
+                    continue
+                if (src.relpath, line, rule_id) in used:
+                    continue
+                scope = scopes.get(line, "<module>")
+                out.append(Violation(
+                    DEAD_SUPPRESSION_RULE, src.relpath, line,
+                    f"dead escape: '# nicelint: allow {rule_id}' but {rule_id} "
+                    f"no longer fires here — delete the marker",
+                    detail=f"dead:{rule_id}:{scope}",
+                ))
+    return out
+
+
+def _line_scope_map(src: SourceFile) -> Dict[int, str]:
+    """line -> innermost enclosing function name (S1's stable identity)."""
+    tree = src.tree()
+    if tree is None:
+        return {}
+    out: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for ln in range(node.lineno, end + 1):
+                out[ln] = node.name  # walk order: inner defs overwrite outer
     return out
 
 
@@ -248,6 +363,29 @@ def save_baseline(root: str, entries: Dict[str, str]) -> None:
     with open(path, "w", encoding="utf-8") as f:  # nicelint: allow A1 (dev-only tool output, not crash-safety state)
         json.dump(payload, f, indent=1)
         f.write("\n")
+
+
+def filter_baseline(
+    baseline: Dict[str, str], rule_ids: Iterable[str]
+) -> Dict[str, str]:
+    """The slice of a shared baseline one analyzer family owns. nicelint and
+    jaxlint ratchet against the same file; each must only see (and declare
+    stale) keys for rules it actually ran. S1 keys are split by the rule
+    embedded in their ``dead:<rule>:...`` detail, since both CLIs emit S1
+    for their own rule family."""
+    ids = set(rule_ids)
+    out: Dict[str, str] = {}
+    for key, why in baseline.items():
+        rule_id, _, detail = key.split("|", 2) if key.count("|") >= 2 \
+            else (key.split("|", 1)[0], "", "")
+        if rule_id == DEAD_SUPPRESSION_RULE:
+            inner = detail.split(":", 2)[1] if detail.startswith("dead:") \
+                else ""
+            if DEAD_SUPPRESSION_RULE in ids and inner in ids:
+                out[key] = why
+        elif rule_id in ids:
+            out[key] = why
+    return out
 
 
 def diff_against_baseline(

@@ -1,0 +1,88 @@
+"""jaxlint: jaxpr-level kernel verification (the J-rule family).
+
+nicelint (``analysis/rules/``) reads source AST; this family reads the
+TRACED TRUTH — ``jax.make_jaxpr`` over the real kernel plans on abstract
+inputs, CPU-only and CI-safe. Same ratchet baseline, same
+``# nicelint: allow`` escape grammar (J findings attribute to real repo
+file:line via jaxpr source info), same strict gate.
+
+Rules:
+
+* **J1 dtype-flow** — every ``convert_element_type`` in a kernel jaxpr must
+  be a cast the KernelSpec declares; silent promotion out of the u32 limb
+  domain (or into floats) is a finding.
+* **J2 carry-headroom** — interval abstract interpretation proving every
+  integer add/sub/mul either cannot wrap or feeds the carry-save
+  wrap-detection idiom, for every sweep base and carry-interval cadence
+  (see ``interval.py`` for the theorem).
+* **J3 donation discipline** — donated buffers are donated in the traced
+  plan (``donated_invars``), survive lowering (``tf.aliasing_output``), and
+  are never read after donation at engine call sites (AST layer).
+* **J4 transfer/sync purity** — no host callbacks, ``device_put`` or
+  implicit transfers inside jitted step functions (the graph-level truth
+  behind nicelint's syntactic D1).
+* **J5 recompile surface** — jit sites in ops/ must be declared surfaces;
+  static-arg domains stay bounded; no dynamic argument burned into the
+  jaxpr as a constant (and no undeclared giant constants).
+* **J6 KernelSpec registry** — every public ``*_batch`` op declares a spec
+  (``analysis/kernelspec.py``) and every traced plan's output avals match
+  it across the base sweep; the pallas histogram-row cap is cross-checked
+  so lifting ``_HIST_ROWS_MAX`` breaks a lint, not a fleet.
+
+Run via ``scripts/jaxlint.py`` (or ``just jaxlint``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from nice_tpu.analysis import core
+
+_JRULES: Dict[str, object] = {}
+
+
+def jrule(rule_id: str):
+    def deco(fn):
+        _JRULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def all_jrules() -> Dict[str, object]:
+    # Import side-effect registers every J-rule module exactly once.
+    from nice_tpu.analysis.jaxrules import (  # noqa: F401
+        j1_dtype_flow, j2_headroom, j3_donation, j4_transfer,
+        j5_recompile, j6_kernelspec,
+    )
+    return dict(_JRULES)
+
+
+def run_jax_rules(
+    project: core.Project,
+    ctx,
+    only: Optional[Iterable[str]] = None,
+):
+    """(violations, used allow sites) over a built TraceContext, through the
+    shared nicelint runner so inline escapes work identically."""
+    registry = {
+        rule_id: (lambda p, _fn=fn: _fn(p, ctx))
+        for rule_id, fn in all_jrules().items()
+    }
+    return core.run_rules_tracked(project, only=only, registry=registry)
+
+
+def trace_violation(rule_id: str, ctx, trace, eqn, message: str,
+                    detail_tag: str) -> core.Violation:
+    """A finding attributed to the repo source line that emitted ``eqn``
+    (so the standard allow grammar applies), falling back to the spec's
+    module when no user frame survives tracing."""
+    from nice_tpu.analysis.jaxrules import tracer
+
+    site = tracer.src_site(eqn, ctx.root) if eqn is not None else None
+    if site is not None:
+        path, line, fname = site
+        detail = f"{detail_tag}:{fname}"
+    else:
+        path, line = trace.spec.module, 1
+        detail = f"{detail_tag}:{trace.spec.name}"
+    return core.Violation(rule_id, path, line, message, detail)

@@ -1,0 +1,734 @@
+"""Value-range abstract interpretation over jaxprs (the J2 engine).
+
+The theorem this module checks, per traced kernel plan: every integer
+``add``/``sub``/``mul`` (and every reduction/accumulation) either
+
+* provably cannot wrap its dtype — the interval of the true mathematical
+  result fits the machine range — or
+* feeds the carry-save wrap-detection idiom the limb kernels are built on
+  (``s = a + b; wrap = s < b`` — the comparison against an operand recovers
+  the dropped 2**32 bit, see ve._cs_add / ve._cs_resolve / ve.add_u32), or
+* matches the division-remainder peephole ``x - (x // c) * c`` whose result
+  is [0, c-1] by construction (the chunked radix digit extraction).
+
+Anything else is an undischarged headroom obligation -> a J2 finding. The
+carry-save headroom claim ("columns cannot overflow for any base <= 510 at
+any carry_interval cadence") reduces to: the *wrap counters* themselves are
+provably non-wrapping u32 adds (their magnitude is bounded by the term count
+of a column, orders of magnitude below 2**32), and every data add is either
+proven or checked. Shifts are exempt from obligations: ``t << 16`` in mul32
+intentionally drops high bits (they are carried separately via ``t >> 16``).
+
+Interval environments seed from the KernelSpec arg bounds (e.g. the
+histogram accumulator's flush contract HIST_ACC_BOUND); closed-over
+constants use their true min/max. Unknown primitives degrade soundly to the
+full dtype range. Pallas kernel jaxprs interpret through ``get``/``swap``/
+``addupdate`` with a declared carried-state bound on output refs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+Interval = Tuple[int, int]
+
+
+def dtype_interval(dtype) -> Optional[Interval]:
+    import numpy as np
+    d = np.dtype(dtype)
+    if d.kind == "b":
+        return (0, 1)
+    if d.kind == "u":
+        return (0, (1 << (d.itemsize * 8)) - 1)
+    if d.kind == "i":
+        return (-(1 << (d.itemsize * 8 - 1)), (1 << (d.itemsize * 8 - 1)) - 1)
+    return None  # floats and friends: untracked
+
+
+def _union(a: Interval, b: Interval) -> Interval:
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+@dataclasses.dataclass
+class Obligation:
+    """A may-wrap arithmetic op awaiting discharge by the wrap-check idiom."""
+    prim: str
+    dtype: str
+    eqn: object
+    math_range: Interval          # the unwrapped mathematical result range
+    operands: tuple               # invar objects / literal values (for idiom
+                                  # matching: wrap checks compare vs operands)
+    discharged: bool = False
+    checkable: bool = True        # reductions have no idiom; must be proven
+
+
+@dataclasses.dataclass
+class ProofStats:
+    eqns: int = 0
+    arith: int = 0
+    proven: int = 0               # arithmetic proven in-range
+    checked: int = 0              # discharged by the wrap-check idiom
+    rem_peephole: int = 0
+    unknown_prims: set = dataclasses.field(default_factory=set)
+    widest_u32_sum: int = 0       # largest proven non-wrap u32 math upper
+
+    def as_report(self) -> dict:
+        return {
+            "eqns": self.eqns, "arith_ops": self.arith,
+            "proven_in_range": self.proven,
+            "wrap_checked": self.checked,
+            "divmod_peepholes": self.rem_peephole,
+            "widest_proven_u32_sum": self.widest_u32_sum,
+            "unknown_prims": sorted(self.unknown_prims),
+        }
+
+
+class IntervalInterpreter:
+    def __init__(self, ref_bound: Optional[Interval] = None):
+        self.ref_bound = ref_bound
+        self.obligations: List[Obligation] = []
+        self.stats = ProofStats()
+        # var -> defining record for peephole matching
+        self._defs: Dict[int, Tuple[str, tuple]] = {}
+        # var -> pending obligation (discharged when a comparison consumes it)
+        self._pending: Dict[int, Obligation] = {}
+
+    # -- env helpers --------------------------------------------------------
+
+    def _aval_dtype(self, v):
+        aval = getattr(v, "aval", None)
+        return getattr(aval, "dtype", None)
+
+    def _read(self, env, v) -> Optional[Interval]:
+        from jax.core import Literal
+        if isinstance(v, Literal):
+            val = v.val
+            try:
+                import numpy as np
+                arr = np.asarray(val)
+                if arr.dtype.kind in "bui":
+                    return (int(arr.min()), int(arr.max()))
+            except Exception:
+                pass
+            return None
+        got = env.get(id(v))
+        if got is not None:
+            return got
+        return dtype_interval(self._aval_dtype(v)) \
+            if self._aval_dtype(v) is not None else None
+
+    def _top(self, v) -> Optional[Interval]:
+        dt = self._aval_dtype(v)
+        return dtype_interval(dt) if dt is not None else None
+
+    def _operand_key(self, v):
+        from jax.core import Literal
+        if isinstance(v, Literal):
+            return ("lit", repr(v.val))
+        return ("var", id(v))
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self, closed, in_intervals: Dict[int, Interval]):
+        """Interpret a ClosedJaxpr; in_intervals maps invar index -> bound."""
+        import numpy as np
+        jaxpr = closed.jaxpr
+        env: Dict[int, Interval] = {}
+        for cv, cval in zip(jaxpr.constvars, closed.consts):
+            try:
+                arr = np.asarray(cval)
+                if arr.dtype.kind in "bui" and arr.size:
+                    env[id(cv)] = (int(arr.min()), int(arr.max()))
+            except Exception:
+                pass
+        for i, v in enumerate(jaxpr.invars):
+            iv = in_intervals.get(i)
+            env[id(v)] = iv if iv is not None else \
+                (self._top(v) or (0, 0))
+        self.interp(jaxpr, env, grid=None)
+        # anything still pending was never consumed by a wrap check
+        for ob in self._pending.values():
+            if not ob.discharged:
+                self.obligations.append(ob)
+        return self
+
+    # -- core loop ----------------------------------------------------------
+
+    def interp(self, jaxpr, env: Dict[int, Interval], grid) -> None:
+        for eqn in jaxpr.eqns:
+            self.stats.eqns += 1
+            self._eqn(eqn, env, grid)
+
+    def _set(self, env, outvars, iv_list):
+        for v, iv in zip(outvars, iv_list):
+            if iv is None:
+                iv = self._top(v)
+            if iv is not None:
+                env[id(v)] = iv
+
+    def _eqn(self, eqn, env, grid) -> None:
+        name = eqn.primitive.name
+        handler = _HANDLERS.get(name)
+        if handler is not None:
+            handler(self, eqn, env, grid)
+            return
+        if self._try_call_like(eqn, env, grid):
+            return
+        self.stats.unknown_prims.add(name)
+        self._set(env, eqn.outvars, [self._top(v) for v in eqn.outvars])
+
+    # -- call-like recursion ------------------------------------------------
+
+    def _try_call_like(self, eqn, env, grid) -> bool:
+        from nice_tpu.analysis.jaxrules.tracer import _inner_jaxpr
+        for key in ("jaxpr", "call_jaxpr"):
+            inner = eqn.params.get(key)
+            ij = _inner_jaxpr(inner) if inner is not None else None
+            if ij is None:
+                continue
+            consts = getattr(inner, "consts", [])
+            if len(ij.invars) != len(eqn.invars):
+                return False
+            sub_env: Dict[int, Interval] = {}
+            import numpy as np
+            for cv, cval in zip(ij.constvars, consts):
+                try:
+                    arr = np.asarray(cval)
+                    if arr.dtype.kind in "bui" and arr.size:
+                        sub_env[id(cv)] = (int(arr.min()), int(arr.max()))
+                except Exception:
+                    pass
+            for iv_var, op in zip(ij.invars, eqn.invars):
+                got = self._read(env, op)
+                if got is not None:
+                    sub_env[id(iv_var)] = got
+            self.interp(ij, sub_env, grid)
+            self._set(env, eqn.outvars,
+                      [self._read(sub_env, v) for v in ij.outvars])
+            self._alias_wrapper_def(eqn, ij)
+            return True
+        return False
+
+    def _alias_wrapper_def(self, eqn, ij) -> None:
+        """Provenance through trivial one-eqn wrappers: ``x // c`` traces as
+        ``pjit[floor_divide](x, c)``, hiding the div the remainder peephole
+        needs. When the inner jaxpr is a single div/mul over the wrapper's
+        own invars, record the outer outvar as defined by that op with the
+        OUTER operands substituted in."""
+        if len(ij.eqns) != 1 or len(ij.outvars) != 1:
+            return
+        inner_eqn = ij.eqns[0]
+        prim = inner_eqn.primitive.name
+        if prim not in ("div", "mul") or ij.outvars[0] is not \
+                inner_eqn.outvars[0]:
+            return
+        invar_map = {id(iv): op for iv, op in zip(ij.invars, eqn.invars)}
+        mapped = []
+        for op in inner_eqn.invars:
+            outer = op if _is_lit(op) else invar_map.get(id(op))
+            if outer is None:
+                return
+            mapped.append(outer)
+        self._defs[id(eqn.outvars[0])] = (prim, tuple(mapped))
+
+
+def _is_dropvar(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+# -- primitive transfer functions -------------------------------------------
+
+def _binop_ranges(interp, eqn, env):
+    a, b = eqn.invars[0], eqn.invars[1]
+    return interp._read(env, a), interp._read(env, b)
+
+
+def _arith(interp: IntervalInterpreter, eqn, env, math_range: Interval,
+           checkable: bool = True) -> None:
+    """Shared wrap-obligation logic for add/sub/mul/reductions."""
+    out = eqn.outvars[0]
+    rng = interp._top(out)
+    interp.stats.arith += 1
+    if rng is None or math_range is None:
+        interp._set(env, eqn.outvars, [rng])
+        return
+    if math_range[0] >= rng[0] and math_range[1] <= rng[1]:
+        interp.stats.proven += 1
+        if rng == (0, 2**32 - 1):
+            interp.stats.widest_u32_sum = max(
+                interp.stats.widest_u32_sum, math_range[1])
+        env[id(out)] = math_range
+        return
+    ob = Obligation(eqn.primitive.name, str(interp._aval_dtype(out)), eqn,
+                    math_range, tuple(eqn.invars), checkable=checkable)
+    if checkable:
+        interp._pending[id(out)] = ob
+    else:
+        interp.obligations.append(ob)
+    env[id(out)] = rng  # wrapped result can be anything in the dtype
+
+
+def _h_add(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    _arith(interp, eqn, env, (ia[0] + ib[0], ia[1] + ib[1]))
+
+
+def _h_sub(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    # division-remainder peephole: sub(x, mul(div(x, c), c)) -> [0, c-1]
+    peep = _rem_peephole(interp, eqn)
+    if peep is not None:
+        interp.stats.rem_peephole += 1
+        interp.stats.arith += 1
+        interp.stats.proven += 1
+        env[id(eqn.outvars[0])] = peep
+        return
+    _arith(interp, eqn, env, (ia[0] - ib[1], ia[1] - ib[0]))
+
+
+def _rem_peephole(interp, eqn) -> Optional[Interval]:
+    a, b = eqn.invars[0], eqn.invars[1]
+    bdef = interp._defs.get(id(b))
+    if not bdef or bdef[0] != "mul":
+        return None
+    m1, m2 = bdef[1]
+    for q, c in ((m1, m2), (m2, m1)):
+        qdef = interp._defs.get(id(q)) if not _is_lit(q) else None
+        if not qdef or qdef[0] != "div":
+            continue
+        x, c2 = qdef[1]
+        if interp._operand_key(x) != interp._operand_key(a):
+            continue
+        cv, c2v = _lit_value(c), _lit_value(c2)
+        if cv is None or cv != c2v or cv <= 0:
+            continue
+        return (0, cv - 1)
+    return None
+
+
+def _is_lit(v) -> bool:
+    from jax.core import Literal
+    return isinstance(v, Literal)
+
+
+def _lit_value(v) -> Optional[int]:
+    from jax.core import Literal
+    if isinstance(v, Literal):
+        try:
+            return int(v.val)
+        except Exception:
+            return None
+    return None
+
+
+def _h_mul(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    out = eqn.outvars[0]
+    interp._defs[id(out)] = ("mul", (eqn.invars[0], eqn.invars[1]))
+    if ia is None or ib is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    prods = [ia[0] * ib[0], ia[0] * ib[1], ia[1] * ib[0], ia[1] * ib[1]]
+    # multiplications have no wrap-check idiom in the kernels: they must be
+    # proven in range (mul32 decomposes into 16-bit halves for exactly this)
+    _arith(interp, eqn, env, (min(prods), max(prods)), checkable=False)
+
+
+def _h_div(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    out = eqn.outvars[0]
+    interp._defs[id(out)] = ("div", (eqn.invars[0], eqn.invars[1]))
+    if ia is None or ib is None or ia[0] < 0 or ib[0] <= 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    env[id(out)] = (ia[0] // ib[1], ia[1] // ib[0])
+
+
+def _h_rem(interp, eqn, env, grid):
+    _, ib = _binop_ranges(interp, eqn, env)
+    if ib is None or ib[0] <= 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    env[id(eqn.outvars[0])] = (0, ib[1] - 1)
+
+
+def _h_compare(interp, eqn, env, grid):
+    # the wrap-check idiom: (a + b) < b  /  (a + b) < a discharges the add
+    if eqn.primitive.name in ("lt", "gt"):
+        x, y = eqn.invars
+        for s, other in ((x, y), (y, x)):
+            ob = interp._pending.get(id(s))
+            if ob is not None and not ob.discharged:
+                okeys = {interp._operand_key(o) for o in ob.operands}
+                if interp._operand_key(other) in okeys:
+                    ob.discharged = True
+                    interp.stats.checked += 1
+    interp._set(env, eqn.outvars, [(0, 1)])
+
+
+def _h_and(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None or ia[0] < 0 or ib[0] < 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    env[id(eqn.outvars[0])] = (0, min(ia[1], ib[1]))
+
+
+def _h_or(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None or ia[0] < 0 or ib[0] < 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    hi = max(ia[1], ib[1])
+    env[id(eqn.outvars[0])] = (max(ia[0], ib[0]),
+                               (1 << hi.bit_length()) - 1 if hi else 0)
+
+
+def _h_xor(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None or ia[0] < 0 or ib[0] < 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    bits = max(ia[1].bit_length(), ib[1].bit_length())
+    env[id(eqn.outvars[0])] = (0, (1 << bits) - 1 if bits else 0)
+
+
+def _h_shl(interp, eqn, env, grid):
+    # shifts never carry obligations: << is the mul32 masking idiom (high
+    # bits are recovered separately via >>); an out-of-range shift is top.
+    ia, ib = _binop_ranges(interp, eqn, env)
+    out = eqn.outvars[0]
+    rng = interp._top(out)
+    if ia is None or ib is None or rng is None or ia[0] < 0 or ib[0] < 0:
+        interp._set(env, eqn.outvars, [rng])
+        return
+    lo, hi = ia[0] << ib[0], ia[1] << ib[1]
+    env[id(out)] = (lo, hi) if hi <= rng[1] else rng
+
+
+def _h_shr(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None or ia[0] < 0 or ib[0] < 0:
+        interp._set(env, eqn.outvars, [None])
+        return
+    env[id(eqn.outvars[0])] = (ia[0] >> ib[1], ia[1] >> ib[0])
+
+
+def _h_convert(interp, eqn, env, grid):
+    ia = interp._read(env, eqn.invars[0])
+    out = eqn.outvars[0]
+    rng = interp._top(out)
+    if ia is None or rng is None:
+        interp._set(env, eqn.outvars, [rng])
+        return
+    env[id(out)] = ia if (ia[0] >= rng[0] and ia[1] <= rng[1]) else rng
+
+
+def _h_select(interp, eqn, env, grid):
+    iv = None
+    for case in eqn.invars[1:]:
+        ci = interp._read(env, case)
+        if ci is None:
+            iv = None
+            break
+        iv = ci if iv is None else _union(iv, ci)
+    interp._set(env, eqn.outvars, [iv])
+
+
+def _h_identity(interp, eqn, env, grid):
+    interp._set(env, eqn.outvars, [interp._read(env, eqn.invars[0])])
+
+
+def _h_union_all(interp, eqn, env, grid):
+    iv = None
+    for op in eqn.invars:
+        ci = interp._read(env, op)
+        if ci is None:
+            iv = None
+            break
+        iv = ci if iv is None else _union(iv, ci)
+    interp._set(env, eqn.outvars, [iv])
+
+
+def _h_iota(interp, eqn, env, grid):
+    shape = eqn.params.get("shape") or getattr(eqn.outvars[0].aval, "shape",
+                                               (1,))
+    dim = eqn.params.get("dimension", 0)
+    n = shape[dim] if shape else 1
+    env[id(eqn.outvars[0])] = (0, max(int(n) - 1, 0))
+
+
+def _reduce_count(eqn) -> int:
+    axes = eqn.params.get("axes", ())
+    shape = getattr(eqn.invars[0].aval, "shape", ())
+    n = 1
+    for a in axes:
+        if a < len(shape):
+            n *= int(shape[a])
+    return max(n, 1)
+
+
+def _h_reduce_sum(interp, eqn, env, grid):
+    ia = interp._read(env, eqn.invars[0])
+    if ia is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    n = _reduce_count(eqn)
+    _arith(interp, eqn, env, (min(ia[0], ia[0] * n), max(ia[1], ia[1] * n)),
+           checkable=False)
+
+
+def _h_cumsum(interp, eqn, env, grid):
+    ia = interp._read(env, eqn.invars[0])
+    if ia is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    axis = eqn.params.get("axis", 0)
+    shape = getattr(eqn.invars[0].aval, "shape", ())
+    n = int(shape[axis]) if axis < len(shape) else 1
+    _arith(interp, eqn, env, (min(ia[0], ia[0] * n), max(ia[1], ia[1] * n)),
+           checkable=False)
+
+
+def _h_reduce_minmax(interp, eqn, env, grid):
+    interp._set(env, eqn.outvars, [interp._read(env, eqn.invars[0])])
+
+
+def _h_minmax(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    if eqn.primitive.name == "max":
+        env[id(eqn.outvars[0])] = (max(ia[0], ib[0]), max(ia[1], ib[1]))
+    else:
+        env[id(eqn.outvars[0])] = (min(ia[0], ib[0]), min(ia[1], ib[1]))
+
+
+def _h_popcount(interp, eqn, env, grid):
+    import numpy as np
+    dt = interp._aval_dtype(eqn.invars[0])
+    bits = np.dtype(dt).itemsize * 8 if dt is not None else 64
+    env[id(eqn.outvars[0])] = (0, bits)
+
+
+def _h_scatter(interp, eqn, env, grid):
+    # result values come from the operand or the updates
+    io = interp._read(env, eqn.invars[0])
+    iu = interp._read(env, eqn.invars[-1])
+    iv = _union(io, iu) if io is not None and iu is not None else None
+    interp._set(env, eqn.outvars, [iv])
+
+
+def _h_scatter_add(interp, eqn, env, grid):
+    # worst case every update lands in one cell: op + n_updates * update
+    import math
+    io = interp._read(env, eqn.invars[0])
+    iu = interp._read(env, eqn.invars[-1])
+    if io is None or iu is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    shape = getattr(eqn.invars[-1].aval, "shape", ())
+    n = max(int(math.prod(shape)), 1)
+    _arith(interp, eqn, env,
+           (io[0] + n * min(iu[0], 0), io[1] + n * max(iu[1], 0)),
+           checkable=False)
+
+
+def _h_dot_general(interp, eqn, env, grid):
+    ia, ib = _binop_ranges(interp, eqn, env)
+    if ia is None or ib is None:
+        interp._set(env, eqn.outvars, [None])
+        return
+    dims = eqn.params.get("dimension_numbers")
+    shape = getattr(eqn.invars[0].aval, "shape", ())
+    n = 1
+    if dims:
+        (lhs_contract, _), _ = dims
+        for a in lhs_contract:
+            if a < len(shape):
+                n *= int(shape[a])
+    prods = [ia[0] * ib[0], ia[0] * ib[1], ia[1] * ib[0], ia[1] * ib[1]]
+    n = max(n, 1)
+    _arith(interp, eqn, env, (min(prods) * n, max(prods) * n),
+           checkable=False)
+
+
+def _h_cond(interp, eqn, env, grid):
+    branches = eqn.params.get("branches", ())
+    operands = eqn.invars[1:]
+    outs = None
+    for br in branches:
+        ij = br.jaxpr if hasattr(br, "jaxpr") else br
+        if len(ij.invars) != len(operands):
+            outs = None
+            break
+        sub_env: Dict[int, Interval] = {}
+        import numpy as np
+        for cv, cval in zip(ij.constvars, getattr(br, "consts", [])):
+            try:
+                arr = np.asarray(cval)
+                if arr.dtype.kind in "bui" and arr.size:
+                    sub_env[id(cv)] = (int(arr.min()), int(arr.max()))
+            except Exception:
+                pass
+        for iv_var, op in zip(ij.invars, operands):
+            got = interp._read(env, op)
+            if got is not None:
+                sub_env[id(iv_var)] = got
+        interp.interp(ij, sub_env, grid)
+        res = [interp._read(sub_env, v) for v in ij.outvars]
+        if outs is None:
+            outs = res
+        else:
+            outs = [_union(a, b) if a is not None and b is not None else None
+                    for a, b in zip(outs, res)]
+    interp._set(env, eqn.outvars, outs or
+                [interp._top(v) for v in eqn.outvars])
+
+
+def _h_while(interp, eqn, env, grid):
+    interp._set(env, eqn.outvars, [interp._top(v) for v in eqn.outvars])
+
+
+def _h_pallas_call(interp, eqn, env, grid):
+    from nice_tpu.analysis.jaxrules.tracer import _inner_jaxpr
+    inner = eqn.params.get("jaxpr")
+    ij = _inner_jaxpr(inner)
+    if ij is None:
+        interp._set(env, eqn.outvars, [interp._top(v) for v in eqn.outvars])
+        return
+    g = _pallas_grid(eqn)
+    sub_env: Dict[int, Interval] = {}
+    # kernel invars = scalar-prefetch refs + input refs, then output refs;
+    # operands line up with the non-output prefix.
+    n_ops = len(eqn.invars)
+    for i, iv_var in enumerate(ij.invars):
+        if i < n_ops:
+            got = interp._read(env, eqn.invars[i])
+            if got is not None:
+                sub_env[id(iv_var)] = got
+        else:
+            bound = interp.ref_bound or interp._ref_dtype_top(iv_var)
+            if bound is not None:
+                sub_env[id(iv_var)] = bound
+    interp.interp(ij, sub_env, g)
+    interp._set(env, eqn.outvars, [interp.ref_bound or interp._top(v)
+                                   for v in eqn.outvars])
+
+
+def _ref_dtype_top(self, v) -> Optional[Interval]:
+    aval = getattr(v, "aval", None)
+    inner = getattr(aval, "inner_aval", aval)
+    dt = getattr(inner, "dtype", None)
+    return dtype_interval(dt) if dt is not None else None
+
+
+IntervalInterpreter._ref_dtype_top = _ref_dtype_top
+
+
+def _pallas_grid(eqn):
+    gm = eqn.params.get("grid_mapping")
+    grid = getattr(gm, "grid", None)
+    if grid:
+        try:
+            return tuple(int(g) for g in grid)
+        except Exception:
+            return None
+    return None
+
+
+def _h_program_id(interp, eqn, env, grid):
+    axis = eqn.params.get("axis", 0)
+    if grid is not None and axis < len(grid):
+        env[id(eqn.outvars[0])] = (0, max(int(grid[axis]) - 1, 0))
+    else:
+        env[id(eqn.outvars[0])] = (0, (1 << 20) - 1)
+
+
+def _h_get(interp, eqn, env, grid):
+    ref = eqn.invars[0]
+    iv = env.get(id(ref))
+    if iv is None:
+        iv = interp._ref_dtype_top(ref)
+    interp._set(env, eqn.outvars, [iv])
+
+
+def _h_swap(interp, eqn, env, grid):
+    ref, val = eqn.invars[0], eqn.invars[1]
+    old = env.get(id(ref)) or interp._ref_dtype_top(ref)
+    iv_val = interp._read(env, val)
+    if old is not None and iv_val is not None:
+        env[id(ref)] = _union(old, iv_val)
+    interp._set(env, eqn.outvars, [old])
+
+
+def _h_addupdate(interp, eqn, env, grid):
+    ref, val = eqn.invars[0], eqn.invars[1]
+    old = env.get(id(ref)) or interp._ref_dtype_top(ref)
+    iv_val = interp._read(env, val)
+    rng = interp._ref_dtype_top(ref)
+    interp.stats.arith += 1
+    if old is None or iv_val is None or rng is None:
+        return
+    mathr = (old[0] + iv_val[0], old[1] + iv_val[1])
+    if mathr[0] >= rng[0] and mathr[1] <= rng[1]:
+        interp.stats.proven += 1
+        env[id(ref)] = mathr
+    else:
+        interp.obligations.append(Obligation(
+            "addupdate", str(_ref_dtype(ref)), eqn, mathr,
+            tuple(eqn.invars), checkable=False))
+        env[id(ref)] = rng
+
+
+def _ref_dtype(v):
+    aval = getattr(v, "aval", None)
+    inner = getattr(aval, "inner_aval", aval)
+    return getattr(inner, "dtype", None)
+
+
+_IDENTITY_PRIMS = (
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "copy",
+    "transpose", "rev", "slice", "dynamic_slice", "gather", "stop_gradient",
+    "convert_element_type_weak", "reduce_precision",
+)
+
+_HANDLERS = {
+    "add": _h_add, "sub": _h_sub, "mul": _h_mul,
+    "div": _h_div, "rem": _h_rem,
+    "lt": _h_compare, "le": _h_compare, "gt": _h_compare,
+    "ge": _h_compare, "eq": _h_compare, "ne": _h_compare,
+    "and": _h_and, "or": _h_or, "xor": _h_xor,
+    "shift_left": _h_shl,
+    "shift_right_logical": _h_shr, "shift_right_arithmetic": _h_shr,
+    "convert_element_type": _h_convert,
+    "select_n": _h_select,
+    "concatenate": _h_union_all, "pad": _h_union_all,
+    "iota": _h_iota,
+    "reduce_sum": _h_reduce_sum, "cumsum": _h_cumsum,
+    "reduce_max": _h_reduce_minmax, "reduce_min": _h_reduce_minmax,
+    "reduce_or": lambda i, e, env, g: i._set(env, e.outvars, [(0, 1)]),
+    "reduce_and": lambda i, e, env, g: i._set(env, e.outvars, [(0, 1)]),
+    "max": _h_minmax, "min": _h_minmax,
+    "population_count": _h_popcount,
+    "scatter": _h_scatter, "scatter-add": _h_scatter_add,
+    "dot_general": _h_dot_general,
+    "cond": _h_cond, "while": _h_while, "scan": _h_while,
+    "pallas_call": _h_pallas_call,
+    "program_id": _h_program_id,
+    "get": _h_get, "swap": _h_swap, "addupdate": _h_addupdate,
+    "not": lambda i, e, env, g: i._set(
+        env, e.outvars,
+        [(0, 1) if i._top(e.outvars[0]) == (0, 1)
+         else i._top(e.outvars[0])]),
+}
+for _p in _IDENTITY_PRIMS:
+    _HANDLERS[_p] = _h_identity

@@ -1,0 +1,44 @@
+"""J1: dtype-flow discipline in traced kernel plans.
+
+The limb kernels live in a closed uint32 arithmetic domain with i32 stats
+outputs. Every ``convert_element_type`` in a traced plan must be one of the
+casts its KernelSpec declares (``allowed_casts``); anything else — a float
+sneaking in via an accidental ``jnp.mean``, a silent promotion to 64-bit
+under an x64 flag flip, a new cast added without updating the contract —
+is a finding. Same-dtype converts (weak-type normalization) are benign.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+from nice_tpu.analysis.jaxrules.tracer import iter_eqns
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    for trace in ctx.traces:
+        allowed = trace.spec.allowed_casts
+        for eqn in iter_eqns(trace.closed.jaxpr):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src_dt = str(getattr(eqn.invars[0].aval, "dtype", "?"))
+            dst_dt = str(eqn.params.get("new_dtype", "?"))
+            if src_dt == dst_dt:
+                continue
+            if (src_dt, dst_dt) in allowed:
+                continue
+            v = trace_violation(
+                "J1", ctx, trace, eqn,
+                f"undeclared cast {src_dt}->{dst_dt} in {trace.key} — "
+                f"declare it in the KernelSpec allowed_casts or fix the "
+                f"kernel",
+                f"cast:{src_dt}->{dst_dt}",
+            )
+            out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J1")(check)

@@ -1,0 +1,49 @@
+"""J2: carry-headroom interval analysis.
+
+Runs the interval abstract interpreter (``interval.py``) over every traced
+plan — full kernel plans at the autotuned cadence plus the dedicated
+limb-math sweep over ``carry_interval in {0, 1, max}`` — and reports every
+arithmetic op that may wrap its dtype without feeding the carry-save
+wrap-detection idiom. This is the machine-checked form of the invariant the
+autotuner currently takes on faith: carry-save columns in
+``mul_limbs``/``sqr_limbs`` cannot overflow for any supported base <= 510,
+any limb count, any resolution cadence.
+
+Input bounds seed from the KernelSpec (notably the histogram accumulator's
+flush contract); per-trace proof statistics land in the CI report under
+``report["j2"]``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+from nice_tpu.analysis.jaxrules.interval import IntervalInterpreter
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    report = ctx.report.setdefault("j2", {})
+    for trace in ctx.traces:
+        interp = IntervalInterpreter(ref_bound=trace.target.ref_bound)
+        interp.run(trace.closed, dict(trace.target.arg_bounds))
+        entry = interp.stats.as_report()
+        entry["obligations"] = len(interp.obligations)
+        report[trace.key] = entry
+        for ob in interp.obligations:
+            lo, hi = ob.math_range
+            v = trace_violation(
+                "J2", ctx, trace, ob.eqn,
+                f"{ob.dtype} {ob.prim} may wrap in {trace.key}: "
+                f"value range [{lo}, {hi}] exceeds the dtype and no "
+                f"wrap-check idiom consumes it — prove the bound or add "
+                f"carry detection",
+                f"headroom:{ob.prim}:{ob.dtype}",
+            )
+            out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J2")(check)

@@ -1,0 +1,206 @@
+"""J3: donation discipline, in three layers.
+
+(a) **Traced plan**: every buffer a KernelSpec declares donated must carry
+``donated_invars=True`` on the traced ``pjit`` eqn — a refactor that drops
+``donate_argnums`` (or reorders arguments under it) fails here.
+
+(b) **Lowered plan**: the cheapest sweep base is actually lowered and the
+MLIR must contain a ``tf.aliasing_output`` attribute — donation that
+silently degrades to a copy (no aliasable output) fails here. XLA's
+"donated buffers were not usable" warning is promoted to a test failure in
+pyproject's filterwarnings; this is the static twin.
+
+(c) **Call sites (AST)**: a donated buffer is DEAD after the call. At every
+engine/mesh call site of a donating callable (provenance tracked from the
+factories in ``kernelspec.DONATING_FACTORIES``), the donated argument name
+must be rebound by the same statement (``acc, nm = dispatch(acc, item)``)
+or not read again before its next rebind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from nice_tpu.analysis import astutil, kernelspec
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+
+AST_SCOPE = ("nice_tpu/ops/", "nice_tpu/parallel/")
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    for v in _check_traces(ctx):
+        out.setdefault(v.key, v)
+    for v in _check_lowerings(ctx):
+        out.setdefault(v.key, v)
+    for v in _check_call_sites(project):
+        out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J3")(check)
+
+
+# -- (a) traced donation ----------------------------------------------------
+
+def _check_traces(ctx) -> List[Violation]:
+    out = []
+    for trace in ctx.traces:
+        donate = trace.target.donate
+        if not donate:
+            continue
+        jaxpr = trace.closed.jaxpr
+        pjit_eqns = [e for e in jaxpr.eqns if e.primitive.name == "pjit"]
+        for d in donate:
+            arg_var = jaxpr.invars[d]
+            donated = False
+            for eqn in pjit_eqns:
+                flags = eqn.params.get("donated_invars", ())
+                for op, flag in zip(eqn.invars, flags):
+                    if op is arg_var and flag:
+                        donated = True
+            if not donated:
+                out.append(trace_violation(
+                    "J3", ctx, trace, None,
+                    f"{trace.key}: argument {d} is declared donated in the "
+                    f"KernelSpec but the traced plan does not donate it "
+                    f"(donate_argnums dropped or reordered?)",
+                    f"donation-dropped:arg{d}",
+                ))
+    return out
+
+
+# -- (b) lowered aliasing ---------------------------------------------------
+
+def _check_lowerings(ctx) -> List[Violation]:
+    out = []
+    for trace in ctx.traces:
+        text = trace.aliasing_text
+        if text is None:
+            continue
+        if text.startswith("<lowering failed"):
+            ctx.report.setdefault("j3_lowering", {})[trace.key] = text
+            continue
+        if "tf.aliasing_output" not in text:
+            out.append(trace_violation(
+                "J3", ctx, trace, None,
+                f"{trace.key}: lowered module carries no "
+                f"tf.aliasing_output — the donated accumulator is being "
+                f"copied, not aliased",
+                "donation-not-aliased",
+            ))
+    return out
+
+
+# -- (c) read-after-donate at call sites ------------------------------------
+
+def _donating_names(tree) -> Dict[str, int]:
+    """Module-wide map of local names that are donating callables, by
+    provenance: assigned from a known factory, or a local ``def`` that
+    forwards one of its params straight into a donating call."""
+    names: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            callee = (astutil.call_name(node.value) or "").split(".")[-1]
+            if callee in kernelspec.DONATING_FACTORIES:
+                names[node.targets[0].id] = \
+                    kernelspec.DONATING_FACTORIES[callee]
+    # one propagation pass: wrappers like ``def dispatch(acc_, item):
+    # return accum_exec(acc_, ...)`` donate their own parameter
+    for _ in range(2):
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.args]
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                idx = _donated_index(call, names)
+                if idx is None or idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    names.setdefault(node.name, params.index(arg.id))
+    return names
+
+
+def _donated_index(call: ast.Call, local_names: Dict[str, int]):
+    callee = (astutil.call_name(call) or "").split(".")[-1]
+    if callee in local_names:
+        return local_names[callee]
+    if callee in kernelspec.DONATING_CALLS:
+        return kernelspec.DONATING_CALLS[callee]
+    return None
+
+
+def _check_call_sites(project: Project) -> List[Violation]:
+    out = []
+    for src in project.python_files():
+        if not src.relpath.startswith(AST_SCOPE):
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        names = _donating_names(tree)
+        if not names and not kernelspec.DONATING_CALLS:
+            continue
+        # iter_functions yields nested defs both standalone and inside their
+        # parent's walk; key on (line, var) so each read reports once, with
+        # the innermost (later-yielded) qualname winning.
+        found: Dict[Tuple[int, str], Violation] = {}
+        for qn, fn in astutil.iter_functions(tree):
+            for v in _scan_function(src, qn, fn, names):
+                found[(v.line, v.detail.rsplit(":", 1)[-1])] = v
+        out.extend(found.values())
+    return out
+
+
+def _own_nodes(fn):
+    """ast.walk that stays inside ``fn``'s own scope — nested defs/lambdas
+    rebind names (their own params!) and get scanned separately."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_function(src, qualname, fn, names) -> List[Violation]:
+    loads: Dict[str, List[int]] = {}
+    stores: Dict[str, List[int]] = {}
+    sites: List[Tuple[int, int, str]] = []
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Name):
+            target = loads if isinstance(node.ctx, ast.Load) else stores
+            target.setdefault(node.id, []).append(node.lineno)
+        if isinstance(node, ast.Call):
+            idx = _donated_index(node, names)
+            if idx is None or idx >= len(node.args):
+                continue
+            arg = node.args[idx]
+            if isinstance(arg, ast.Name):
+                # a multi-line call spans several lines; reads inside the
+                # call's own span ARE the donation, not a read-after
+                end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                sites.append((node.lineno, end, arg.id))
+    out = []
+    for line, call_end, name in sites:
+        rebinds = sorted(ln for ln in stores.get(name, []) if ln >= line)
+        horizon = rebinds[0] if rebinds else None
+        reads = [ln for ln in loads.get(name, [])
+                 if ln > call_end and (horizon is None or ln < horizon)]
+        if reads:
+            out.append(Violation(
+                "J3", src.relpath, reads[0],
+                f"'{name}' is read after being donated at line {line} "
+                f"(donated buffers are dead; rebind the name at the call "
+                f"statement)",
+                detail=f"read-after-donate:{qualname}:{name}",
+            ))
+    return out

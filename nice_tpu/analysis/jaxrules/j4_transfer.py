@@ -1,0 +1,46 @@
+"""J4: transfer/sync purity of jitted step functions.
+
+The engine's one-transfer-per-field readback contract only holds if the
+jitted plans themselves are pure device programs: no host callbacks, no
+``device_put``, no infeed/outfeed, no debug prints. nicelint's D1 catches
+the syntactic cases; this rule checks the traced graph, where a callback
+hidden behind three helper layers is still one eqn.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+from nice_tpu.analysis.jaxrules.tracer import iter_eqns
+
+FORBIDDEN = frozenset({
+    "device_put", "infeed", "outfeed", "copy_to_host_async",
+    "host_local_array_to_global_array", "debug_print",
+})
+
+
+def _is_forbidden(name: str) -> bool:
+    return name in FORBIDDEN or "callback" in name
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    for trace in ctx.traces:
+        for eqn in iter_eqns(trace.closed.jaxpr):
+            name = eqn.primitive.name
+            if not _is_forbidden(name):
+                continue
+            v = trace_violation(
+                "J4", ctx, trace, eqn,
+                f"host transfer/sync primitive '{name}' inside the jitted "
+                f"plan {trace.key} — step functions must be pure device "
+                f"programs",
+                f"transfer:{name}",
+            )
+            out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J4")(check)

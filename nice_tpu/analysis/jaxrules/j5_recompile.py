@@ -1,0 +1,173 @@
+"""J5: recompile-surface audit.
+
+Every ``jax.jit`` in ops/ is a recompile surface keyed by its static
+arguments. This rule keeps that surface enumerable:
+
+(a) every jit site in the kernel modules must be a declared surface
+    (``kernelspec.KNOWN_JIT_SURFACES``) — new jitted kernels are declared
+    (and spec'd) before they ship;
+(b) no dynamic argument gets burned into the traced jaxpr as a constant —
+    the traced plan must have exactly as many invars as the spec feeds it
+    (a Python scalar captured by closure shrinks the invars and recompiles
+    per value);
+(c) closed-over constants stay small (``max_const_elems`` per spec; the
+    strided offsets table is a declared exception) — a giant constant is
+    usually a dynamic array accidentally captured at trace time;
+(d) the static-arg tuple count across the sweep stays under the knob
+    ceiling, and no spec documents an unbounded static domain. Observed
+    variants land in the CI report under ``report["j5"]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from nice_tpu.analysis import astutil, kernelspec
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+
+MAX_VARIANTS_DEFAULT = 1024
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    for v in _check_jit_sites(project):
+        out.setdefault(v.key, v)
+    for v in _check_burned_args(ctx):
+        out.setdefault(v.key, v)
+    for v in _check_variants(ctx):
+        out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J5")(check)
+
+
+# -- (a) undeclared jit sites ----------------------------------------------
+
+def _jit_in(node: ast.AST) -> bool:
+    """Does this expression mention jax.jit (directly or via
+    functools.partial(jax.jit, ...))?"""
+    for sub in ast.walk(node):
+        name = astutil.dotted(sub) or ""
+        if name in ("jax.jit", "jit") or name.endswith(".jit"):
+            return True
+    return False
+
+
+def _check_jit_sites(project: Project) -> List[Violation]:
+    out = []
+    for rel in kernelspec.DISCOVERY_MODULES:
+        src = project.get(rel)
+        if src is None:
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        for top in tree.body:
+            if not isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_jit = any(_jit_in(d) for d in top.decorator_list)
+            if not has_jit:
+                for node in ast.walk(top):
+                    if isinstance(node, ast.Call) and _jit_in(node.func):
+                        has_jit = True
+                        break
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            node is not top and \
+                            any(_jit_in(d) for d in node.decorator_list):
+                        has_jit = True
+                        break
+            if has_jit and top.name not in kernelspec.KNOWN_JIT_SURFACES:
+                out.append(Violation(
+                    "J5", src.relpath, top.lineno,
+                    f"undeclared jit surface '{top.name}' — add it to "
+                    f"kernelspec.KNOWN_JIT_SURFACES (and give it a "
+                    f"KernelSpec) before shipping a new recompile surface",
+                    detail=f"unregistered-jit:{top.name}",
+                ))
+    return out
+
+
+# -- (b)+(c) burned constants ----------------------------------------------
+
+def _all_consts(closed):
+    """(jaxpr, const) pairs, recursing into call-like eqns."""
+    from nice_tpu.analysis.jaxrules.tracer import _inner_jaxpr, iter_eqns
+    yield from ((closed.jaxpr, c) for c in closed.consts)
+    for eqn in iter_eqns(closed.jaxpr):
+        for val in eqn.params.values():
+            inner = _inner_jaxpr(val)
+            if inner is not None and hasattr(val, "consts"):
+                yield from ((inner, c) for c in val.consts)
+
+
+def _check_burned_args(ctx) -> List[Violation]:
+    import numpy as np
+    out = []
+    for trace in ctx.traces:
+        n_invars = len(trace.closed.jaxpr.invars)
+        n_args = len(trace.target.args)
+        if n_invars != n_args:
+            out.append(trace_violation(
+                "J5", ctx, trace, None,
+                f"{trace.key}: traced plan has {n_invars} inputs but the "
+                f"spec feeds {n_args} — a dynamic argument was burned into "
+                f"the jaxpr as a constant (recompiles per value)",
+                "burned-arg",
+            ))
+        cap = trace.spec.max_const_elems
+        for _, const in _all_consts(trace.closed):
+            try:
+                size = int(np.asarray(const).size)
+            except Exception:
+                continue
+            if size > cap:
+                out.append(trace_violation(
+                    "J5", ctx, trace, None,
+                    f"{trace.key}: closed-over constant of {size} elements "
+                    f"exceeds the spec ceiling ({cap}) — an array captured "
+                    f"at trace time?",
+                    "giant-const",
+                ))
+                break
+    return out
+
+
+# -- (d) static-arg cardinality ---------------------------------------------
+
+def _check_variants(ctx, max_variants: int = MAX_VARIANTS_DEFAULT) -> \
+        List[Violation]:
+    out = []
+    variants = {}
+    for trace in ctx.traces:
+        variants.setdefault(trace.spec.name, set()).add(
+            (trace.base, trace.batch, trace.carry_interval))
+    report = {
+        name: {"observed_static_tuples": len(keys),
+               "static_domain": dict(
+                   kernelspec.all_specs()[name].static_domain)}
+        for name, keys in sorted(variants.items())
+    }
+    ctx.report["j5"] = report
+    total = sum(len(k) for k in variants.values())
+    limit = ctx.report.get("j5_max_variants", max_variants)
+    if total > limit:
+        out.append(Violation(
+            "J5", "nice_tpu/analysis/kernelspec.py", 1,
+            f"static-arg surface across the sweep is {total} variants "
+            f"(> {limit}) — unbounded recompile surface",
+            detail="variant-ceiling",
+        ))
+    for name, spec in sorted(kernelspec.all_specs().items()):
+        for param, doc in spec.static_domain:
+            if "unbounded" in doc.lower():
+                out.append(Violation(
+                    "J5", spec.module, 1,
+                    f"{name}: static arg '{param}' documents an unbounded "
+                    f"domain — bound it or the executable cache cannot",
+                    detail=f"unbounded-static:{name}:{param}",
+                ))
+    return out

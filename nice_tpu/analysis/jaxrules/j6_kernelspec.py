@@ -1,0 +1,124 @@
+"""J6: KernelSpec registry enforcement.
+
+(a) **Coverage**: every public ``*_batch`` op in the kernel modules
+    (``kernelspec.DISCOVERY_MODULES``) must carry a KernelSpec — shapes are
+    contracts, not emergent behavior.
+(b) **Shape drift**: every traced plan's output avals must equal the spec's
+    declared ``out_shapes(plan, batch)`` at every sweep base.
+(c) **Capability drift**: the pallas histogram-row cap is declared twice on
+    purpose — ``pallas_engine._HIST_ROWS_MAX`` (what the kernel unrolls)
+    and ``kernelspec.MAX_HIST_ROWS`` (what the contract promises). They
+    must agree, and ``supports_base`` must match the contract's predicate
+    over a probe sweep that brackets the cap. Lifting the engine cap
+    without updating the contract (or vice versa) breaks a lint here, not
+    a fleet.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import List
+
+from nice_tpu.analysis import astutil, kernelspec
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.jaxrules import jrule, trace_violation
+
+# Brackets the cap: 40/80/510 are sweep bases; 638 needs a 5th histogram
+# row ((638+2)/128 = 5) and must be rejected until the cap is lifted in
+# both places.
+PROBE_BASES = (40, 80, 510)
+PROBE_BASE_ABOVE_CAP = 638
+
+
+def check(project: Project, ctx) -> List[Violation]:
+    out = {}
+    for v in _check_coverage(project):
+        out.setdefault(v.key, v)
+    for v in _check_shapes(ctx):
+        out.setdefault(v.key, v)
+    for v in _check_hist_rows():
+        out.setdefault(v.key, v)
+    return list(out.values())
+
+
+jrule("J6")(check)
+
+
+def _check_coverage(project: Project) -> List[Violation]:
+    out = []
+    specs = kernelspec.all_specs()
+    for rel in kernelspec.DISCOVERY_MODULES:
+        src = project.get(rel)
+        if src is None:
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        stem = rel.rsplit("/", 1)[-1][:-3]
+        for top in tree.body:
+            if not isinstance(top, ast.FunctionDef):
+                continue
+            if top.name.startswith("_") or not top.name.endswith("_batch"):
+                continue
+            if f"{stem}.{top.name}" not in specs:
+                out.append(Violation(
+                    "J6", src.relpath, top.lineno,
+                    f"public op '{top.name}' has no KernelSpec — declare "
+                    f"its shapes/dtypes/casts in analysis/kernelspec.py",
+                    detail=f"unspecced-op:{top.name}",
+                ))
+    _ = astutil  # imported for parity with sibling rules
+    return out
+
+
+def _check_shapes(ctx) -> List[Violation]:
+    from nice_tpu.ops.limbs import get_plan
+    out = []
+    for trace in ctx.traces:
+        plan = get_plan(trace.base)
+        expected = tuple(
+            (tuple(shape), str(dtype))
+            for shape, dtype in trace.spec.out_shapes(plan, trace.batch)
+        )
+        got = tuple(
+            (tuple(getattr(v.aval, "shape", ())),
+             str(getattr(v.aval, "dtype", "?")))
+            for v in trace.closed.jaxpr.outvars
+        )
+        if got != expected:
+            out.append(trace_violation(
+                "J6", ctx, trace, None,
+                f"{trace.key}: traced outputs {got} != KernelSpec contract "
+                f"{expected} — update the spec or fix the kernel",
+                f"shape-drift:b{trace.base}",
+            ))
+    return out
+
+
+def _check_hist_rows() -> List[Violation]:
+    from nice_tpu.ops import pallas_engine as pe
+    from nice_tpu.ops.limbs import get_plan
+    out = []
+    if pe._HIST_ROWS_MAX != kernelspec.MAX_HIST_ROWS:
+        out.append(Violation(
+            "J6", "nice_tpu/ops/pallas_engine.py", 1,
+            f"_HIST_ROWS_MAX={pe._HIST_ROWS_MAX} but the KernelSpec "
+            f"contract says MAX_HIST_ROWS={kernelspec.MAX_HIST_ROWS} — "
+            f"update both together (and re-run the base sweep)",
+            detail="hist-rows-mismatch",
+        ))
+    probes = [get_plan(b) for b in PROBE_BASES]
+    probes.append(dataclasses.replace(get_plan(PROBE_BASES[0]),
+                                      base=PROBE_BASE_ABOVE_CAP))
+    for plan in probes:
+        want = kernelspec._hist_rows(plan) <= kernelspec.MAX_HIST_ROWS
+        if pe.supports_base(plan) != want:
+            out.append(Violation(
+                "J6", "nice_tpu/ops/pallas_engine.py", 1,
+                f"supports_base(base={plan.base}) = "
+                f"{pe.supports_base(plan)} disagrees with the KernelSpec "
+                f"hist-row contract ({want})",
+                detail=f"supports-base-drift:b{plan.base}",
+            ))
+    return out

@@ -1,0 +1,204 @@
+"""Plan tracing for the jaxpr-level rules (J1..J6).
+
+Every rule runs over the SAME set of traces, built once per jaxlint
+invocation: for each registered KernelSpec x sweep base (x carry-interval
+cadence for the limb-math proof surface), ``jax.make_jaxpr`` on abstract
+``ShapeDtypeStruct`` inputs. CPU-only and device-free — pallas kernels trace
+in interpreter mode and still expose their inner kernel jaxpr on the
+``pallas_call`` eqn, so the rules see the real Mosaic-bound program.
+
+Tracing the 29-limb base-510 plan costs tens of seconds; the budget knob
+(``NICE_TPU_JAXLINT_TRACE_BUDGET_SECS``) bounds the total and anything
+skipped is reported loudly (and fails --strict) rather than silently
+narrowing the sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from nice_tpu.analysis import kernelspec
+
+# Trace batch: big enough to exercise lane-aligned histogram layout
+# (batch % 128 == 0, the pallas minimum), small enough to trace fast. The
+# jaxpr is shape-polymorphic in nothing — but every rule's claim is about
+# dtypes, value ranges, and structure, which do not change with batch.
+TRACE_BATCH = 256
+
+# "small"-sweep specs (rare-path extraction kernels) skip bases above this:
+# their jaxprs repeat the same limb math the full-sweep plans already cover,
+# and a 29-limb trace of every spec would blow the CI budget.
+SMALL_SWEEP_MAX = 100
+
+
+@dataclasses.dataclass
+class Trace:
+    spec: kernelspec.KernelSpec
+    base: int
+    batch: int
+    carry_interval: int
+    target: kernelspec.TraceTarget
+    closed: object                 # jax ClosedJaxpr
+    elapsed: float
+    aliasing_text: Optional[str] = None   # lowered MLIR for donation checks
+
+    @property
+    def key(self) -> str:
+        return f"{self.spec.name}@b{self.base}ci{self.carry_interval}"
+
+
+class TraceContext:
+    """The shared input of every J-rule run: traces + a report accumulator
+    that the CLI archives as the CI artifact."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.traces: List[Trace] = []
+        self.skipped: List[dict] = []
+        self.report: Dict[str, object] = {}
+
+    def by_kind(self, *kinds: str) -> List[Trace]:
+        return [t for t in self.traces if t.spec.kind in kinds]
+
+
+def build_context(
+    root: str,
+    bases: Iterable[int],
+    specs: Optional[Iterable[kernelspec.KernelSpec]] = None,
+    budget_secs: float = 900.0,
+    lower_accum: bool = True,
+) -> TraceContext:
+    """Trace every (spec, base[, cadence]) combination within budget."""
+    import jax
+
+    from nice_tpu.ops.limbs import get_plan
+
+    ctx = TraceContext(root)
+    bases = sorted(set(int(b) for b in bases))
+    spec_list = sorted(specs if specs is not None
+                       else kernelspec.all_specs().values(),
+                       key=lambda s: s.name)
+    t_start = time.perf_counter()
+    timings = []
+    for spec in spec_list:
+        for base in bases:
+            if spec.sweep == "small" and base > SMALL_SWEEP_MAX:
+                continue
+            plan = get_plan(base)
+            if not spec.applies(plan):
+                continue
+            if spec.kind == "limbmath":
+                cis = kernelspec.carry_cadences(plan)
+            elif spec.takes_carry_interval:
+                cis = (0,)
+            else:
+                cis = (0,)
+            for ci in cis:
+                spent = time.perf_counter() - t_start
+                if spent > budget_secs:
+                    ctx.skipped.append({
+                        "spec": spec.name, "base": base,
+                        "carry_interval": ci,
+                        "reason": f"trace budget exhausted "
+                                  f"({spent:.0f}s > {budget_secs:.0f}s)",
+                    })
+                    continue
+                target = spec.build(plan, TRACE_BATCH, ci)
+                t0 = time.perf_counter()
+                closed = jax.make_jaxpr(target.fn)(*target.args)
+                elapsed = time.perf_counter() - t0
+                trace = Trace(spec, base, TRACE_BATCH, ci, target, closed,
+                              elapsed)
+                if lower_accum and spec.kind == "accum" and base == bases[0]:
+                    trace.aliasing_text = _lowered_text(spec, plan,
+                                                        TRACE_BATCH, ci)
+                ctx.traces.append(trace)
+                timings.append({"trace": trace.key,
+                                "secs": round(elapsed, 3),
+                                "eqns": sum(1 for _ in iter_eqns(
+                                    closed.jaxpr))})
+    ctx.report["traces"] = timings
+    ctx.report["skipped"] = ctx.skipped
+    return ctx
+
+
+def _lowered_text(spec, plan, batch, ci) -> Optional[str]:
+    """MLIR for the donation check (J3): lowering is much costlier than
+    tracing, so only the cheapest sweep base pays for it."""
+    try:
+        if spec.backend == "pallas":
+            from nice_tpu.ops import pallas_engine as pe
+            br = pe._effective_block_rows(batch, pe.BLOCK_ROWS)
+            jitted = pe._detailed_accum_callable(plan, batch, br,
+                                                 carry_interval=ci)
+            target = spec.build(plan, batch, ci)
+            return jitted.lower(*target.args).as_text()
+        from nice_tpu.ops import vector_engine as ve
+        target = spec.build(plan, batch, ci)
+        acc, rest = target.args[0], target.args[1:]
+        return ve.detailed_accum_batch.lower(
+            plan, batch, acc, list(rest[:-1]), rest[-1],
+            carry_interval=ci).as_text()
+    except Exception as exc:  # lowering is best-effort evidence
+        return f"<lowering failed: {exc}>"
+
+
+# -- jaxpr walking ----------------------------------------------------------
+
+def _core():
+    import jax
+    return jax.core
+
+
+def _inner_jaxpr(val):
+    core = _core()
+    if isinstance(val, core.ClosedJaxpr):
+        return val.jaxpr
+    if isinstance(val, core.Jaxpr):
+        return val
+    return None
+
+
+def sub_jaxprs(eqn) -> Iterator[object]:
+    """Inner jaxprs of a call-like eqn (pjit, pallas_call, cond, ...)."""
+    for val in eqn.params.values():
+        j = _inner_jaxpr(val)
+        if j is not None:
+            yield j
+        elif isinstance(val, (list, tuple)):
+            for item in val:
+                j = _inner_jaxpr(item)
+                if j is not None:
+                    yield j
+
+
+def iter_eqns(jaxpr) -> Iterator[object]:
+    """All eqns, recursing into inner jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def src_site(eqn, root: str) -> Optional[Tuple[str, int, str]]:
+    """(repo-relative path, line, function name) of the user frame that
+    emitted this eqn, or None when attribution is unavailable. Real sites
+    make the standard ``# nicelint: allow`` grammar work for J-rules."""
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+    except Exception:
+        return None
+    if frame is None:
+        return None
+    file_name = getattr(frame, "file_name", "") or ""
+    if not file_name.startswith(root + os.sep):
+        return None
+    return (
+        os.path.relpath(file_name, root),
+        int(getattr(frame, "start_line", 1) or 1),
+        getattr(frame, "function_name", "") or "<unknown>",
+    )

@@ -1,0 +1,451 @@
+"""KernelSpec contract registry — jaxlint's ground truth for ops/ kernels.
+
+Every public batch entry point in ``ops/vector_engine.py`` and
+``ops/pallas_engine.py`` declares here, as functions of (plan, batch):
+
+* abstract input shapes/dtypes (what the tracer feeds ``jax.make_jaxpr``),
+* expected output shapes/dtypes (rule J6 checks the traced ``out_avals``
+  against these across the base sweep),
+* donated argument indices (rule J3 checks ``donated_invars`` on the
+  traced plan),
+* the set of element-type casts the kernel is allowed to contain
+  (rule J1 flags any ``convert_element_type`` outside it),
+* value bounds on carried state (rule J2 seeds its interval analysis
+  from these; the bound IS the contract — e.g. the histogram accumulator
+  stays below ``HIST_ACC_BOUND`` because the engine flushes it first),
+* the bounded domain of every static argument (rule J5's recompile
+  surface), and
+* applicability predicates (which bases a kernel supports), including the
+  pallas histogram-row cap: lifting ``_HIST_ROWS_MAX`` in the engine
+  without updating ``MAX_HIST_ROWS`` here breaks a lint, not a fleet.
+
+The registry is declarative and import-cheap; tracing happens in
+``analysis/jaxrules/tracer.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+U32_FULL = (0, 2**32 - 1)
+I32_FULL = (-(2**31), 2**31 - 1)
+
+# Device-resident i32 histogram accumulators are flushed by the engine loop
+# long before bins approach i32 saturation (process_range_detailed sizes
+# flush_every so total counted lanes stay under 2**30), so traced plans may
+# assume this bound on carried accumulator state. J2 proves "no i32 wrap"
+# ON TOP of this bound; widening it past 2**30 makes the per-batch
+# ``hist_acc + hist`` add unprovable and J2 will say so.
+HIST_ACC_BOUND = (0, 1 << 30)
+
+# One batch's stats tile (the pallas kernels' carried out-ref state, and the
+# per-dispatch histogram the accum plans add into the accumulator): at most
+# 2**17 lanes per dispatch, each contributing < 2**9 digit events, so 2**26
+# bounds every bin with room to spare. J2 seeds pallas output refs with this
+# and proves HIST_ACC_BOUND + PER_BATCH_HIST_BOUND fits i32.
+PER_BATCH_HIST_BOUND = (0, 1 << 26)
+
+# Pallas stats-tile histogram row cap: must equal pallas_engine._HIST_ROWS_MAX
+# (J6 cross-checks both directions over a probe sweep). Bases with
+# ceil((base+2)/128) rows above this cap fall back to the jnp backend.
+MAX_HIST_ROWS = 4
+
+# Casts the limb/stats kernels are allowed to contain (J1). Everything else —
+# in particular any float dtype and any widening past 32 bits — is a finding.
+CASTS_DEFAULT = frozenset({
+    ("bool", "uint32"),    # ve._carry: wrap flag -> u32 carry
+    ("bool", "int32"),     # histogram/mask one-hot counts
+    ("uint32", "int32"),   # popcount accumulators -> i32 stats domain
+    ("int32", "uint32"),   # lane iota -> u32 candidate offset
+})
+
+# Survivor-compaction capacity used for traces (a representative static).
+TRACE_SURVIVOR_CAP = 256
+
+# jax.jit surfaces in ops/ that are allowed to exist: the decorated
+# vector-engine entry points plus the pallas callable factories (each factory
+# jits one inner ``run``). Rule J5 flags any other jit site in ops/ — a new
+# jitted kernel must be declared (and usually spec'd) before it ships.
+KNOWN_JIT_SURFACES = frozenset({
+    # vector_engine decorated entry points
+    "detailed_batch", "uniques_batch", "survivors_batch",
+    "detailed_accum_batch", "niceonly_dense_batch",
+    # pallas_engine callable factories (lru-cached, jit inside)
+    "_stats_callable", "_uniques_callable", "_survivors_callable",
+    "_detailed_accum_callable", "_strided_callable",
+})
+
+# Donation provenance for rule J3's read-after-donate scan: local names bound
+# from these factories are callables whose Nth positional argument is donated.
+DONATING_FACTORIES: Dict[str, int] = {
+    "_detailed_accum_callable": 0,      # pallas_engine factory
+    "_detailed_accum_executable": 0,    # engine AOT wrapper
+    "make_sharded_stats_accum_step": 0, # parallel/mesh factory
+    "_build_stats_accum_step": 0,
+}
+# Directly-called donating entry points: callee name -> donated positional
+# argument index at the call site.
+DONATING_CALLS: Dict[str, int] = {
+    "detailed_accum_batch": 2,          # (plan, batch_size, hist_acc, ...)
+}
+
+# Files rule J6 scans for public ``*_batch`` ops that must carry a spec.
+DISCOVERY_MODULES = (
+    "nice_tpu/ops/vector_engine.py",
+    "nice_tpu/ops/pallas_engine.py",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceTarget:
+    """One traceable plan: a flat-positional callable over abstract args."""
+    fn: Callable
+    args: tuple                          # jax.ShapeDtypeStruct per flat arg
+    arg_bounds: Dict[int, Tuple[int, int]]  # flat arg index -> value bound
+    donate: Tuple[int, ...] = ()         # flat arg indices expected donated
+    ref_bound: Optional[Tuple[int, int]] = None  # pallas out-ref state bound
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    name: str                      # "vector_engine.detailed_accum_batch"
+    module: str                    # repo-relative source path
+    backend: str                   # "jnp" | "pallas"
+    kind: str                      # stats|accum|uniques|survivors|niceonly|strided|limbmath
+    sweep: str                     # "full": every sweep base; "small": cheap bases only
+    build: Callable                # (plan, batch, carry_interval) -> TraceTarget
+    out_shapes: Callable           # (plan, batch) -> ((shape, dtype name), ...)
+    static_domain: Tuple[Tuple[str, str], ...] = ()
+    allowed_casts: frozenset = CASTS_DEFAULT
+    applies: Callable = lambda plan: True  # noqa: E731
+    takes_carry_interval: bool = True
+    max_hist_rows: Optional[int] = None
+    max_const_elems: int = 1 << 16
+
+    @property
+    def func(self) -> str:
+        return self.name.split(".", 1)[1]
+
+
+SPECS: Dict[str, KernelSpec] = {}
+
+
+def register(spec: KernelSpec) -> KernelSpec:
+    assert spec.name not in SPECS, spec.name
+    SPECS[spec.name] = spec
+    return spec
+
+
+def all_specs() -> Dict[str, KernelSpec]:
+    return dict(SPECS)
+
+
+def carry_cadences(plan) -> Tuple[int, ...]:
+    """The carry_interval sweep J2 must cover: 0 (resolve once), 1 (resolve
+    every term), and the max useful cadence (one full fold per limb pass)."""
+    return tuple(sorted({0, 1, plan.limbs_n}))
+
+
+# -- shared shape builders ---------------------------------------------------
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+def _ve_range_args(plan):
+    """(start limb scalars u32 * limbs_n, valid_count i32) — the dense-range
+    argument tail shared by every vector_engine batch entry point."""
+    return tuple(_sds((), "uint32") for _ in range(plan.limbs_n)) + \
+        (_sds((), "int32"),)
+
+
+def _pe_range_args(plan):
+    """(start limbs u32[limbs_n], valid_count i32 scalar) — the pallas twins
+    take the start as one scalar-prefetched array."""
+    return (_sds((plan.limbs_n,), "uint32"), _sds((), "int32"))
+
+
+def _hist_rows(plan) -> int:
+    return -(-(plan.base + 2) // 128)
+
+
+_STATIC_RANGE = (
+    ("base", "plan registry; bases with a valid range (<= 510 under the "
+     "4-row pallas histogram cap)"),
+    ("batch_size", "autotune sweep powers of two, <= 2**26"),
+    ("carry_interval", "0..limbs_n (autotuned cadence)"),
+)
+_STATIC_PALLAS = _STATIC_RANGE + (
+    ("block_rows", "divisors of batch_size/128, <= 128"),
+)
+
+
+# -- vector_engine (jnp backend) specs ---------------------------------------
+
+def _ve_spec(func, kind, out_shapes, build, sweep="full", **kw):
+    return register(KernelSpec(
+        name=f"vector_engine.{func}",
+        module="nice_tpu/ops/vector_engine.py",
+        backend="jnp", kind=kind, sweep=sweep,
+        build=build, out_shapes=out_shapes,
+        static_domain=kw.pop("static_domain", _STATIC_RANGE), **kw,
+    ))
+
+
+def _build_ve_detailed(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+
+    def fn(*a):
+        return ve.detailed_batch(plan, batch, list(a[:L]), a[L],
+                                 carry_interval=ci)
+    return TraceTarget(fn, _ve_range_args(plan), {L: (0, batch)})
+
+
+_ve_spec(
+    "detailed_batch", "stats",
+    lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
+    _build_ve_detailed,
+)
+
+
+def _build_ve_uniques(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+
+    def fn(*a):
+        return ve.uniques_batch(plan, batch, list(a[:L]), carry_interval=ci)
+    return TraceTarget(fn, _ve_range_args(plan)[:-1], {})
+
+
+_ve_spec(
+    "uniques_batch", "uniques",
+    lambda plan, batch: (((batch,), "int32"),),
+    _build_ve_uniques, sweep="small",
+)
+
+
+def _build_ve_survivors(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+    cap = TRACE_SURVIVOR_CAP
+
+    def fn(*a):
+        return ve.survivors_batch(plan, batch, plan.near_miss_cutoff, cap,
+                                  list(a[:L]), a[L], carry_interval=ci)
+    return TraceTarget(fn, _ve_range_args(plan), {L: (0, batch)})
+
+
+_ve_spec(
+    "survivors_batch", "survivors",
+    lambda plan, batch: (((), "int32"),
+                         ((TRACE_SURVIVOR_CAP,), "int32"),
+                         ((TRACE_SURVIVOR_CAP,), "int32")),
+    _build_ve_survivors, sweep="small",
+    static_domain=_STATIC_RANGE + (
+        ("thresh", "near_miss_cutoff (detailed) or base-1 (niceonly)"),
+        ("cap", "survivor capacity; powers of two <= 2**16"),
+    ),
+)
+
+
+def _build_ve_accum(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+
+    def fn(acc, *a):
+        return ve.detailed_accum_batch(plan, batch, acc, list(a[:L]), a[L],
+                                       carry_interval=ci)
+    args = (_sds((plan.base + 2,), "int32"),) + _ve_range_args(plan)
+    return TraceTarget(fn, args, {0: HIST_ACC_BOUND, L + 1: (0, batch)},
+                       donate=(0,))
+
+
+_ve_spec(
+    "detailed_accum_batch", "accum",
+    lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
+    _build_ve_accum,
+)
+
+
+def _build_ve_niceonly(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+
+    def fn(*a):
+        return ve.niceonly_dense_batch(plan, batch, list(a[:L]), a[L],
+                                       carry_interval=ci)
+    return TraceTarget(fn, _ve_range_args(plan), {L: (0, batch)})
+
+
+_ve_spec(
+    "niceonly_dense_batch", "niceonly",
+    lambda plan, batch: (((), "int32"),),
+    _build_ve_niceonly,
+)
+
+
+# Limb-math core traced without jit: sqr + mul + digit extraction exactly as
+# num_uniques_lanes composes them. This is the J2 carry-headroom proof
+# surface — swept over carry_interval {0, 1, max} per base.
+def _build_ve_limbmath(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+
+    def fn(*limbs):
+        return ve.num_uniques_lanes(plan, list(limbs), ci)
+    args = tuple(_sds((batch,), "uint32") for _ in range(plan.limbs_n))
+    return TraceTarget(fn, args, {})
+
+
+_ve_spec(
+    "num_uniques_lanes", "limbmath",
+    lambda plan, batch: (((batch,), "int32"),),
+    _build_ve_limbmath,
+)
+
+
+# -- pallas_engine specs -----------------------------------------------------
+
+def _pe_spec(func, kind, out_shapes, build, sweep="full", **kw):
+    kw.setdefault("max_hist_rows", MAX_HIST_ROWS)
+    kw.setdefault("applies", _pe_supports)
+    return register(KernelSpec(
+        name=f"pallas_engine.{func}",
+        module="nice_tpu/ops/pallas_engine.py",
+        backend="pallas", kind=kind, sweep=sweep,
+        build=build, out_shapes=out_shapes,
+        static_domain=kw.pop("static_domain", _STATIC_PALLAS), **kw,
+    ))
+
+
+def _pe_supports(plan) -> bool:
+    return _hist_rows(plan) <= MAX_HIST_ROWS
+
+
+def _build_pe_detailed(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(start, valid):
+        return pe.detailed_batch(plan, batch, start, valid,
+                                 carry_interval=ci)
+    return TraceTarget(fn, _pe_range_args(plan), {1: (0, batch)},
+                       ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "detailed_batch", "stats",
+    lambda plan, batch: (((128 * _hist_rows(plan),), "int32"), ((), "int32")),
+    _build_pe_detailed,
+)
+
+
+def _build_pe_niceonly(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(start, valid):
+        return pe.niceonly_dense_batch(plan, batch, start, valid,
+                                       carry_interval=ci)
+    return TraceTarget(fn, _pe_range_args(plan), {1: (0, batch)},
+                       ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "niceonly_dense_batch", "niceonly",
+    lambda plan, batch: (((), "int32"),),
+    _build_pe_niceonly,
+)
+
+
+def _build_pe_uniques(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(start):
+        return pe.uniques_batch(plan, batch, start)
+    return TraceTarget(fn, _pe_range_args(plan)[:1], {},
+                       ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "uniques_batch", "uniques",
+    lambda plan, batch: (((batch,), "int32"),),
+    _build_pe_uniques, sweep="small", takes_carry_interval=False,
+)
+
+
+def _build_pe_survivors(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+    cap = TRACE_SURVIVOR_CAP
+
+    def fn(start, valid):
+        return pe.survivors_batch(plan, batch, plan.near_miss_cutoff, cap,
+                                  start, valid)
+    return TraceTarget(fn, _pe_range_args(plan), {1: (0, batch)},
+                       ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "survivors_batch", "survivors",
+    lambda plan, batch: (((), "int32"),
+                         ((TRACE_SURVIVOR_CAP,), "int32"),
+                         ((TRACE_SURVIVOR_CAP,), "int32")),
+    _build_pe_survivors, sweep="small", takes_carry_interval=False,
+    static_domain=_STATIC_PALLAS + (
+        ("thresh", "near_miss_cutoff (detailed) or base-1 (niceonly)"),
+        ("cap", "survivor capacity; powers of two <= 2**16"),
+    ),
+)
+
+
+def _build_pe_accum(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(acc, start, valid):
+        return pe.detailed_accum_batch(plan, batch, acc, start, valid,
+                                       carry_interval=ci)
+    args = (_sds((plan.base + 2,), "int32"),) + _pe_range_args(plan)
+    return TraceTarget(fn, args, {0: HIST_ACC_BOUND, 2: (0, batch)},
+                       donate=(0,), ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "detailed_accum_batch", "accum",
+    lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
+    _build_pe_accum,
+)
+
+
+# Stride-compacted niceonly: the offsets table is a deliberate large VMEM
+# constant (host-expanded CRT residue table), so this spec raises the
+# burned-constant ceiling J5 applies to it. Traced with a tiny 1-residue
+# table; shape contracts do not depend on the table contents.
+_STRIDED_TRACE_DESC = 128
+_STRIDED_TRACE_PERIODS = 128
+
+
+def _build_pe_strided(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+    spec = pe.StrideSpec(2, (1,))
+
+    def fn(desc):
+        return pe.niceonly_strided_batch(
+            plan, spec, desc, periods=_STRIDED_TRACE_PERIODS)
+    args = (_sds((_STRIDED_TRACE_DESC, pe._DESC_WIDTH), "uint32"),)
+    return TraceTarget(fn, args, {}, ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "niceonly_strided_batch", "strided",
+    lambda plan, batch: (((8, 128), "int32"),),
+    _build_pe_strided, sweep="small", takes_carry_interval=False,
+    applies=lambda plan: plan.limbs_n <= 4 and _pe_supports(plan),
+    max_const_elems=1 << 21,
+    static_domain=(
+        ("base", "plan registry; strided kernel asserts limbs_n <= 4"),
+        ("stride spec", "CRT modulus + residue table per (base, depth)"),
+        ("num_desc", "descriptor-group sizes, <= STRIDED_DESC_MAX=1024"),
+        ("periods", "stride periods, <= STRIDED_PERIODS_MAX=1024"),
+    ),
+)

@@ -77,7 +77,6 @@ def install(default_level: str = "info") -> None:
     log_file: Optional[str] = knobs.LOG_FILE.get()
     if log_file:
         try:
-            # nicelint: allow A1 (streaming append-only log sink)
             handlers.append(logging.FileHandler(log_file, encoding="utf-8"))
         except OSError as exc:
             print(

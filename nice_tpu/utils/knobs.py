@@ -587,6 +587,32 @@ LOCKDEP_HOLD_SECS = _k(
     owner="utils/lockdep.py", group="lockdep",
 )
 
+# -- static analysis (nicelint / jaxlint) ----------------------------------
+JAXLINT_BASES = _k(
+    "NICE_TPU_JAXLINT_BASES", "str", "40,80,510",
+    "Comma-separated base sweep jaxlint traces kernel plans at (overridden"
+    " by --bases).",
+    owner="scripts/jaxlint.py", group="analysis",
+)
+JAXLINT_TRACE_BUDGET_SECS = _k(
+    "NICE_TPU_JAXLINT_TRACE_BUDGET_SECS", "float", 900.0,
+    "Wall-clock budget for the jaxpr trace sweep; traces past the budget"
+    " are skipped and reported (a skip fails --strict).",
+    owner="scripts/jaxlint.py", group="analysis",
+)
+JAXLINT_RULES = _k(
+    "NICE_TPU_JAXLINT_RULES", "str", None,
+    "Comma-separated J-rule subset jaxlint runs (unset = all).",
+    owner="scripts/jaxlint.py", group="analysis",
+    default_doc="all rules",
+)
+JAXLINT_MAX_VARIANTS = _k(
+    "NICE_TPU_JAXLINT_MAX_VARIANTS", "int", 1024,
+    "Ceiling on the static-argument variant count J5 tolerates across the"
+    " trace sweep before declaring the recompile surface unbounded.",
+    owner="scripts/jaxlint.py", group="analysis",
+)
+
 
 # ---------------------------------------------------------------------------
 # Documentation rendering (docs/KNOBS.md + README tables). nicelint's K1
@@ -601,6 +627,7 @@ _GROUP_TITLES = {
     "obs": "Observability",
     "faults": "Chaos / fault injection",
     "lockdep": "Lock diagnostics",
+    "analysis": "Static analysis",
     "general": "General",
 }
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""jaxlint CLI — jaxpr-level kernel verification for nice_tpu.
+
+Traces every registered KernelSpec with ``jax.make_jaxpr`` on abstract
+inputs (CPU-only; no accelerator needed) and runs the J-rule family over
+the traced plans: dtype-flow, carry-headroom interval proofs, donation
+discipline, transfer purity, recompile-surface audit, and KernelSpec
+contract drift. Shares nicelint's ratchet baseline and escape grammar.
+
+Usage:
+    python scripts/jaxlint.py                  # report vs ratchet baseline
+    python scripts/jaxlint.py --strict         # CI gate: also fail stale
+                                               # entries and skipped traces
+    python scripts/jaxlint.py --update-baseline
+    python scripts/jaxlint.py --json out.json  # archive the full report
+    python scripts/jaxlint.py --rules J2,J3    # run a subset
+    python scripts/jaxlint.py --bases 40       # quick local sweep
+
+Exit codes: 0 clean, 1 new violations (or stale entries / skipped traces
+under --strict), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# Tracing is abstract; never let jaxlint grab a real accelerator.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from nice_tpu.analysis import core  # noqa: E402
+from nice_tpu.analysis import jaxrules  # noqa: E402
+from nice_tpu.analysis.jaxrules import tracer  # noqa: E402
+from nice_tpu.utils import knobs  # noqa: E402
+
+FAMILY = ("J1", "J2", "J3", "J4", "J5", "J6",
+          core.DEAD_SUPPRESSION_RULE)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on stale baseline entries and skipped traces")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite this family's slice of the shared baseline")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full report (violations + trace/proof "
+                         "stats) as JSON")
+    ap.add_argument("--rules", metavar="IDS",
+                    default=knobs.JAXLINT_RULES.get(),
+                    help="comma-separated J-rule subset (e.g. J2,J3)")
+    ap.add_argument("--bases", metavar="LIST",
+                    default=knobs.JAXLINT_BASES.get(),
+                    help="comma-separated base sweep to trace at")
+    ap.add_argument("--budget", type=float, metavar="SECS",
+                    default=knobs.JAXLINT_TRACE_BUDGET_SECS.get(),
+                    help="wall-clock budget for the trace sweep")
+    args = ap.parse_args(argv)
+
+    try:
+        bases = sorted({int(b) for b in str(args.bases).split(",") if
+                        b.strip()})
+    except ValueError:
+        print(f"jaxlint: bad --bases {args.bases!r}", file=sys.stderr)
+        return 2
+    if not bases:
+        print("jaxlint: empty base sweep", file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root)
+    project = core.Project(root)
+
+    t0 = time.monotonic()
+    ctx = tracer.build_context(root, bases, budget_secs=args.budget)
+    trace_secs = time.monotonic() - t0
+    print(f"jaxlint: traced {len(ctx.traces)} plans over bases "
+          f"{bases} in {trace_secs:.1f}s"
+          + (f" ({len(ctx.skipped)} skipped)" if ctx.skipped else ""))
+
+    only = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    ctx.report["j5_max_variants"] = knobs.JAXLINT_MAX_VARIANTS.get()
+    violations, used = jaxrules.run_jax_rules(project, ctx, only=only)
+
+    if only is None:
+        # the dead-suppression audit (S1) needs every J-rule's usage data,
+        # so it only runs on full (non --rules) invocations
+        jrule_ids = {r for r in FAMILY if r != core.DEAD_SUPPRESSION_RULE}
+        dead, _ = core.filter_allowed(
+            project, core.dead_suppressions(project, jrule_ids, used))
+        violations = sorted(
+            violations + dead,
+            key=lambda v: (v.path, v.line, v.rule, v.detail))
+
+    baseline = core.filter_baseline(core.load_baseline(root), FAMILY)
+    if only:
+        baseline = core.filter_baseline(baseline, set(only))
+    new, stale = core.diff_against_baseline(violations, baseline)
+
+    if args.update_baseline:
+        old = core.load_baseline(root)
+        # preserve the other family's keys — the baseline file is shared
+        entries = {k: v for k, v in old.items()
+                   if k not in core.filter_baseline(old, FAMILY)}
+        for v in violations:
+            entries[v.key] = old.get(v.key, "TODO: justify or fix")
+        core.save_baseline(root, entries)
+        print(f"jaxlint: baseline rewritten ({len(new)} new, "
+              f"{len(stale)} removed; other families preserved)")
+        return 0
+
+    if args.json:
+        report = {
+            "bases": bases,
+            "trace_secs": round(trace_secs, 2),
+            "violations": [v.to_json() for v in violations],
+            "new": [v.to_json() for v in new],
+            "stale_baseline_keys": stale,
+            "baselined": len(violations) - len(new),
+            "skipped_traces": ctx.skipped,
+            "context": ctx.report,
+        }
+        with open(args.json, "w", encoding="utf-8") as f:  # nicelint: allow A1 (CI artifact, not state)
+            json.dump(report, f, indent=1, default=str)
+            f.write("\n")
+
+    for v in new:
+        print(f"{v.path}:{v.line}: {v.rule}: {v.message}")
+    if stale:
+        print(f"jaxlint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed violations "
+              "still listed — run --update-baseline to burn them down):")
+        for key in stale:
+            print(f"  stale: {key}")
+    if ctx.skipped:
+        print(f"jaxlint: {len(ctx.skipped)} trace(s) skipped "
+              f"(budget {args.budget:.0f}s):")
+        for entry in ctx.skipped:
+            print(f"  skipped: {entry}")
+
+    baselined = len(violations) - len(new)
+    print(f"jaxlint: {len(new)} new, {baselined} baselined, "
+          f"{len(stale)} stale")
+    if new:
+        return 1
+    if args.strict and (stale or ctx.skipped):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,21 +92,34 @@ def main(argv=None) -> int:
 
     only = [r.strip().upper() for r in args.rules.split(",")] \
         if args.rules else None
-    violations = core.run_rules(project, only=only)
-    baseline = core.load_baseline(root)
+    violations, used = core.run_rules_tracked(project, only=only)
+    family = set(core.all_rules()) | {core.DEAD_SUPPRESSION_RULE}
+    if only is None:
+        # the dead-suppression audit (S1) needs every rule's usage data, so
+        # it only runs on full (non --rules) invocations
+        dead, _ = core.filter_allowed(
+            project,
+            core.dead_suppressions(project, set(core.all_rules()), used))
+        violations = sorted(
+            violations + dead,
+            key=lambda v: (v.path, v.line, v.rule, v.detail))
+    # the baseline file is shared with jaxlint's J-rule family — only this
+    # family's slice is visible (and can go stale) here
+    baseline = core.filter_baseline(core.load_baseline(root), family)
     if only:
-        baseline = {k: v for k, v in baseline.items()
-                    if k.split("|", 1)[0] in only}
+        baseline = core.filter_baseline(baseline, set(only))
     new, stale = core.diff_against_baseline(violations, baseline)
 
     if args.update_baseline:
-        entries = {}
         old = core.load_baseline(root)
+        entries = {k: v for k, v in old.items()
+                   if k not in core.filter_baseline(old, family)}
         for v in violations:
             entries[v.key] = old.get(v.key, "TODO: justify or fix")
         core.save_baseline(root, entries)
         print(f"nicelint: baseline rewritten with {len(entries)} entries "
-              f"({len(new)} new, {len(stale)} removed)")
+              f"({len(new)} new, {len(stale)} removed; other families "
+              f"preserved)")
         return 0
 
     if args.json:

@@ -1,0 +1,432 @@
+"""jaxlint tests: the interval interpreter proves/flags the right shapes of
+arithmetic (including the carry-save wrap-check idiom and a headroom-
+violating carry-save variant), every J-rule has a good/bad fixture pair, the
+shared ratchet baseline splits cleanly between the nicelint and jaxlint
+families, and the repo tree itself is jaxlint-clean."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JAXLINT = os.path.join(REPO, "scripts", "jaxlint.py")
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nice_tpu.analysis import core, kernelspec  # noqa: E402
+from nice_tpu.analysis.jaxrules import (  # noqa: E402
+    interval, j1_dtype_flow, j3_donation, j4_transfer, j5_recompile,
+    j6_kernelspec, tracer,
+)
+
+U32 = (0, 2**32 - 1)
+
+
+# ---------------------------------------------------------------------------
+# fixture plumbing
+
+def project(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content), encoding="utf-8")
+    return core.Project(str(tmp_path))
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def toy_spec(name="vector_engine.toy_batch", out_shapes=None,
+             casts=kernelspec.CASTS_DEFAULT, max_const_elems=1 << 16):
+    return kernelspec.KernelSpec(
+        name=name, module="nice_tpu/ops/vector_engine.py", backend="jnp",
+        kind="stats", sweep="full", build=None,
+        out_shapes=out_shapes or (lambda plan, batch: ()),
+        allowed_casts=casts, max_const_elems=max_const_elems,
+    )
+
+
+def toy_trace(fn, args, arg_bounds=None, donate=(), spec=None, base=40):
+    target = kernelspec.TraceTarget(fn, tuple(args), dict(arg_bounds or {}),
+                                    donate=tuple(donate))
+    closed = jax.make_jaxpr(fn)(*args)
+    return tracer.Trace(spec or toy_spec(), base, 256, 0, target, closed,
+                        0.0)
+
+
+def toy_ctx(*traces):
+    ctx = tracer.TraceContext(REPO)
+    ctx.traces.extend(traces)
+    return ctx
+
+
+def run_interval(fn, args, bounds, ref_bound=None):
+    closed = jax.make_jaxpr(fn)(*args)
+    interp = interval.IntervalInterpreter(ref_bound=ref_bound)
+    interp.run(closed, bounds)
+    return interp
+
+
+# ---------------------------------------------------------------------------
+# interval interpreter (the J2 engine)
+
+def test_interval_proves_bounded_add():
+    it = run_interval(lambda a, b: a + b,
+                      (sds((8,), jnp.uint32), sds((8,), jnp.uint32)),
+                      {0: (0, 1000), 1: (0, 1000)})
+    assert it.obligations == []
+    assert it.stats.proven >= 1
+
+
+def test_interval_flags_unchecked_full_range_add():
+    it = run_interval(lambda a, b: a + b,
+                      (sds((8,), jnp.uint32), sds((8,), jnp.uint32)),
+                      {0: U32, 1: U32})
+    assert len(it.obligations) == 1
+    assert it.obligations[0].prim == "add"
+    assert it.obligations[0].math_range[1] > 2**32 - 1
+
+
+def test_wrap_check_idiom_discharges_the_add():
+    # the carry-save idiom: s = a + b; wrap = s < b recovers the 2**32 bit
+    def f(a, b):
+        s = a + b
+        return s, (s < b)
+
+    it = run_interval(f, (sds((8,), jnp.uint32), sds((8,), jnp.uint32)),
+                      {0: U32, 1: U32})
+    assert it.obligations == []
+    assert it.stats.checked == 1
+
+
+def test_headroom_violating_carry_save_variant_is_flagged():
+    # a carry-save column summed WITHOUT its resolve step: each product of
+    # 16-bit halves fits u32, but the unresolved column sum does not
+    def bad_column(a, b, c, d):
+        return a * b + c * d
+
+    it = run_interval(
+        bad_column, tuple(sds((8,), jnp.uint32) for _ in range(4)),
+        {i: (0, 2**16 - 1) for i in range(4)})
+    assert len(it.obligations) == 1
+    assert it.obligations[0].prim == "add"
+
+
+def test_divmod_peephole_through_floor_divide_wrapper():
+    # x // c traces as pjit[floor_divide]; the remainder peephole must see
+    # through the wrapper (digit extraction does this tens of times per limb)
+    def digit(x):
+        q = x // np.uint32(40)
+        return x - q * np.uint32(40)
+
+    it = run_interval(digit, (sds((8,), jnp.uint32),), {0: U32})
+    assert it.obligations == []
+    assert it.stats.rem_peephole == 1
+
+
+def test_mul_has_no_wrap_idiom_and_must_be_proven():
+    def f(a, b):
+        p = a * b
+        return p, (p < b)  # comparing a mul is NOT the carry idiom
+
+    it = run_interval(f, (sds((8,), jnp.uint32), sds((8,), jnp.uint32)),
+                      {0: U32, 1: U32})
+    assert [ob.prim for ob in it.obligations] == ["mul"]
+
+
+def test_scatter_add_headroom_is_add_aware():
+    def hist(acc, idx, upd):
+        return acc.at[idx].add(upd)
+
+    args = (sds((8,), jnp.int32), sds((4,), jnp.int32),
+            sds((4,), jnp.int32))
+    ok = run_interval(hist, args, {0: (0, 1 << 30), 1: (0, 7), 2: (0, 1)})
+    assert ok.obligations == []
+    # near-saturated accumulator: 4 updates of 10 can push past i32 max
+    bad = run_interval(hist, args,
+                       {0: (0, 2**31 - 5), 1: (0, 7), 2: (0, 10)})
+    assert [ob.prim for ob in bad.obligations] == ["scatter-add"]
+
+
+# ---------------------------------------------------------------------------
+# J1: dtype flow
+
+def test_j1_flags_undeclared_cast(tmp_path):
+    tr = toy_trace(lambda a: a.astype(jnp.float32).sum(),
+                   (sds((8,), jnp.uint32),))
+    vs = j1_dtype_flow.check(core.Project(str(tmp_path)), toy_ctx(tr))
+    assert len(vs) == 1 and "float32" in vs[0].message
+    assert vs[0].detail.startswith("cast:uint32->float32")
+
+
+def test_j1_declared_casts_are_clean(tmp_path):
+    tr = toy_trace(lambda a: (a > 0).astype(jnp.int32),
+                   (sds((8,), jnp.uint32),))
+    assert j1_dtype_flow.check(core.Project(str(tmp_path)),
+                               toy_ctx(tr)) == []
+
+
+# ---------------------------------------------------------------------------
+# J3: donation discipline
+
+def _step(acc, x):
+    return acc + x, x.sum()
+
+
+def test_j3_traced_donation_present_is_clean():
+    fn = jax.jit(_step, donate_argnums=(0,))
+    tr = toy_trace(fn, (sds((8,), jnp.int32), sds((8,), jnp.int32)),
+                   donate=(0,))
+    assert j3_donation._check_traces(toy_ctx(tr)) == []
+
+
+def test_j3_dropped_donation_is_flagged():
+    fn = jax.jit(_step)  # donate_argnums lost in a refactor
+    tr = toy_trace(fn, (sds((8,), jnp.int32), sds((8,), jnp.int32)),
+                   donate=(0,))
+    vs = j3_donation._check_traces(toy_ctx(tr))
+    assert len(vs) == 1 and "donation-dropped:arg0" in vs[0].detail
+
+
+READ_AFTER_DONATE = """
+    from nice_tpu.ops.pallas_engine import _detailed_accum_callable
+
+    def loop(plan, items):
+        step = _detailed_accum_callable(plan, 256, 128, 0)
+        acc = make_acc()
+        for item in items:
+            out = step(acc, item.starts, item.valids)
+            total = acc.sum()  # acc was donated: this buffer is dead
+            acc = out[0]
+        return acc, total
+"""
+
+CLEAN_DONATE = """
+    from nice_tpu.ops.pallas_engine import _detailed_accum_callable
+
+    def loop(plan, items):
+        step = _detailed_accum_callable(plan, 256, 128, 0)
+        acc = make_acc()
+        for item in items:
+            acc, nm = step(acc, item.starts, item.valids)
+        return acc
+"""
+
+
+def test_j3_read_after_donate_call_site(tmp_path):
+    vs = j3_donation._check_call_sites(
+        project(tmp_path, {"nice_tpu/ops/engine2.py": READ_AFTER_DONATE}))
+    assert len(vs) == 1
+    assert "read-after-donate" in vs[0].detail and "acc" in vs[0].detail
+
+
+def test_j3_rebind_at_call_statement_is_clean(tmp_path):
+    assert j3_donation._check_call_sites(
+        project(tmp_path, {"nice_tpu/ops/engine2.py": CLEAN_DONATE})) == []
+
+
+# ---------------------------------------------------------------------------
+# J4: transfer purity
+
+def test_j4_flags_host_callback(tmp_path):
+    def f(x):
+        jax.debug.print("x = {}", x)
+        return x + 1
+
+    tr = toy_trace(f, (sds((8,), jnp.int32),))
+    vs = j4_transfer.check(core.Project(str(tmp_path)), toy_ctx(tr))
+    assert len(vs) == 1 and "callback" in vs[0].detail
+
+
+def test_j4_pure_plan_is_clean(tmp_path):
+    tr = toy_trace(lambda x: x * 2 + 1, (sds((8,), jnp.int32),))
+    assert j4_transfer.check(core.Project(str(tmp_path)),
+                             toy_ctx(tr)) == []
+
+
+# ---------------------------------------------------------------------------
+# J5: recompile surface
+
+ROGUE_JIT = """
+    import jax
+
+    @jax.jit
+    def rogue_batch(x):
+        return x
+"""
+
+DECLARED_JIT = """
+    import jax
+
+    @jax.jit
+    def detailed_batch(x):
+        return x
+"""
+
+
+def test_j5_unregistered_jit_site(tmp_path):
+    vs = j5_recompile._check_jit_sites(
+        project(tmp_path, {"nice_tpu/ops/vector_engine.py": ROGUE_JIT}))
+    assert [v.detail for v in vs] == ["unregistered-jit:rogue_batch"]
+
+
+def test_j5_declared_surface_is_clean(tmp_path):
+    assert j5_recompile._check_jit_sites(
+        project(tmp_path,
+                {"nice_tpu/ops/vector_engine.py": DECLARED_JIT})) == []
+
+
+def test_j5_burned_arg_detected():
+    tr = toy_trace(lambda a: a + 1, (sds((8,), jnp.int32),))
+    # the spec claims two dynamic args but the traced plan only has one —
+    # the second was burned into the jaxpr as a Python constant
+    tr.target = kernelspec.TraceTarget(
+        tr.target.fn, tr.target.args + (sds((), jnp.int32),), {})
+    vs = j5_recompile._check_burned_args(toy_ctx(tr))
+    assert any("burned-arg" in v.detail for v in vs)
+
+
+def test_j5_giant_closed_over_const():
+    big = np.zeros((1 << 17,), dtype=np.int32)
+
+    def f(x):
+        return x + jnp.asarray(big)[: x.shape[0]]
+
+    tr = toy_trace(f, (sds((8,), jnp.int32),))
+    vs = j5_recompile._check_burned_args(toy_ctx(tr))
+    assert any("giant-const" in v.detail for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# J6: KernelSpec registry
+
+def test_j6_public_op_without_spec(tmp_path):
+    vs = j6_kernelspec._check_coverage(
+        project(tmp_path, {"nice_tpu/ops/vector_engine.py": """
+            def rogue_batch(plan, batch):
+                return None
+        """}))
+    assert [v.detail for v in vs] == ["unspecced-op:rogue_batch"]
+
+
+def test_j6_shape_drift():
+    spec = toy_spec(out_shapes=lambda plan, batch: (((8,), "int32"),))
+    tr = toy_trace(lambda a: a * 2, (sds((4,), jnp.uint32),), spec=spec)
+    vs = j6_kernelspec._check_shapes(toy_ctx(tr))
+    assert len(vs) == 1 and "shape-drift" in vs[0].detail
+
+
+def test_j6_matching_shapes_are_clean():
+    spec = toy_spec(out_shapes=lambda plan, batch: (((4,), "uint32"),))
+    tr = toy_trace(lambda a: a * 2, (sds((4,), jnp.uint32),), spec=spec)
+    assert j6_kernelspec._check_shapes(toy_ctx(tr)) == []
+
+
+def test_j6_hist_rows_contract_holds_in_tree():
+    # pallas_engine._HIST_ROWS_MAX == kernelspec.MAX_HIST_ROWS and
+    # supports_base agrees with the contract over the probe sweep
+    assert j6_kernelspec._check_hist_rows() == []
+
+
+# ---------------------------------------------------------------------------
+# S1: dead-suppression audit (shared core machinery)
+
+def _dead_audit(proj):
+    violations, used = core.run_rules_tracked(proj)
+    return core.dead_suppressions(proj, set(core.all_rules()), used)
+
+
+def test_s1_flags_dead_allow(tmp_path):
+    dead = _dead_audit(project(tmp_path, {"nice_tpu/x.py": """
+        def f(path):
+            # nicelint: allow A1 (nothing here writes anymore)
+            return path
+    """}))
+    assert [d.detail for d in dead] == ["dead:A1:f"]
+
+
+def test_s1_live_allow_is_not_flagged(tmp_path):
+    dead = _dead_audit(project(tmp_path, {"nice_tpu/x.py": """
+        def save(path, blob):
+            # nicelint: allow A1 (append-only sink)
+            with open(path, "w") as f:
+                f.write(blob)
+    """}))
+    assert dead == []
+
+
+def test_s1_docstring_grammar_mention_is_not_a_marker(tmp_path):
+    dead = _dead_audit(project(tmp_path, {"nice_tpu/x.py": '''
+        def f():
+            """Escape with ``# nicelint: allow A1 (reason)`` on the line."""
+            return 1
+    '''}))
+    assert dead == []
+
+
+# ---------------------------------------------------------------------------
+# shared-baseline family split
+
+def test_filter_baseline_splits_families():
+    baseline = {
+        "A1|nice_tpu/x.py|open-w": "",
+        "J2|nice_tpu/ops/y.py|headroom:add:uint32:f": "",
+        "S1|nice_tpu/x.py|dead:A1:f": "",
+        "S1|nice_tpu/ops/y.py|dead:J2:g": "",
+    }
+    nice = core.filter_baseline(baseline, {"A1", "S1"})
+    assert set(nice) == {"A1|nice_tpu/x.py|open-w",
+                         "S1|nice_tpu/x.py|dead:A1:f"}
+    jx = core.filter_baseline(baseline, {"J2", "S1"})
+    assert set(jx) == {"J2|nice_tpu/ops/y.py|headroom:add:uint32:f",
+                       "S1|nice_tpu/ops/y.py|dead:J2:g"}
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end (traces the real kernels at the cheapest base)
+
+def jaxlint(root, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, JAXLINT, "--root", str(root), "--bases", "40",
+         *args],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+
+
+def test_repo_tree_is_jaxlint_clean_strict():
+    r = jaxlint(REPO, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout
+
+
+def test_jaxlint_ratchet_and_family_preservation(tmp_path):
+    project(tmp_path, {"nice_tpu/ops/vector_engine.py": ROGUE_JIT})
+    # pre-seed a nicelint-family entry: jaxlint must never touch it
+    (tmp_path / "nice_tpu/analysis").mkdir(parents=True)
+    (tmp_path / "nice_tpu/analysis/baseline.json").write_text(json.dumps(
+        {"entries": {"A1|nice_tpu/x.py|open-w": "keep me"}}))
+
+    r = jaxlint(tmp_path)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "undeclared jit surface" in r.stdout
+    assert "has no KernelSpec" in r.stdout
+
+    r = jaxlint(tmp_path, "--update-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    entries = json.loads(
+        (tmp_path / "nice_tpu/analysis/baseline.json").read_text()
+    )["entries"]
+    assert entries["A1|nice_tpu/x.py|open-w"] == "keep me"
+    assert any(k.startswith("J5|") for k in entries)
+
+    r = jaxlint(tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout

@@ -168,6 +168,35 @@ def test_square_cube_limbs_match_bigint(base, carry_interval):
         assert got_cu == want_cu, (base, n, carry_interval)
 
 
+def test_square_cube_limbs_match_bigint_b510_worst_cadence():
+    """Runtime witness for the jaxlint J2 headroom theorem at its hardest
+    point: base 510 is the widest sweep plan (29 u32 limbs — the deepest
+    carry-save columns any supported base produces) and resolve_every =
+    limbs_n is the laziest carry cadence the autotuner may pick, so wrap
+    counters accumulate across a full limb pass before any resolution. The
+    interval analysis proves this cannot overflow; this test executes it
+    against Python big-int on engineered carry-edge candidates. A thinned
+    candidate set keeps the eager 29-limb math inside the tier-1 budget."""
+    base = 510
+    plan = get_plan(base)
+    all_cands = _carry_edge_candidates(base)
+    # endpoints + the all-ones-limbs patterns + an evenly-thinned remainder
+    ns = sorted(set(all_cands[:2] + all_cands[-2:] + all_cands[:: max(1, len(all_cands) // 6)]))
+    n_dev = [jnp.asarray(col) for col in ints_to_limb_arrays(ns, plan.limbs_n)]
+    for carry_interval in (0, plan.limbs_n):
+        sq = ve.sqr_limbs(n_dev, plan.limbs_sq, resolve_every=carry_interval)
+        cu = ve.mul_limbs(sq, n_dev, plan.limbs_cu, resolve_every=carry_interval)
+        sq_host = [np.asarray(col) for col in sq]
+        cu_host = [np.asarray(col) for col in cu]
+        for row, n in enumerate(ns):
+            want_sq = _bigint_limbs(n * n, plan.limbs_sq)
+            want_cu = _bigint_limbs(n * n * n, plan.limbs_cu)
+            got_sq = [int(col[row]) for col in sq_host]
+            got_cu = [int(col[row]) for col in cu_host]
+            assert got_sq == want_sq, (base, n, carry_interval)
+            assert got_cu == want_cu, (base, n, carry_interval)
+
+
 @pytest.mark.parametrize("base", _DIFF_BASES)
 def test_sqr_equals_general_mul(base):
     """The squaring specialization (symmetry: each cross product accumulated

@@ -50,10 +50,20 @@ device->host readback round-trip, whose latency through the axon tunnel swings
 30-110 ms hour to hour — their lines jitter 2-3x run to run with no code
 change. Only modes >= ~2 s are stable benchmarks of compute.
 
+CLI:
+  --only MODE        run only this mode (same semantics as NICE_BENCH_MODE,
+                     but composable with a driver that passes argv — e.g.
+                     `bench.py --only hi-base` for the CI perf-gate's short
+                     hi-base case)
+
 Env knobs:
   NICE_BENCH_MODE    run only this mode (e.g. "extra-large")
   NICE_BENCH_SUITE   comma-separated mode:kind list overriding the default
                      suite (kind = detailed|niceonly)
+  NICE_BENCH_SIZE    clamp every case's field to at most this many numbers
+                     (recorded as range_clamped=true; lets the CPU perf gate
+                     EXECUTE the 1e9 hi-base case as a short slice instead of
+                     budget-skipping it — BENCH r04 rc=124, r06 budget-skip)
   NICE_BENCH_BATCH   lanes per dispatch (default: per-mode table below)
   NICE_BENCH_BUDGET  wall budget in seconds for the whole run (default 480)
   NICE_BENCH_INIT_TIMEOUT  cap on EACH backend-init attempt (default 60/90/120
@@ -387,8 +397,16 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     from nice_tpu.ops import engine
 
     data = get_benchmark_field(BenchmarkMode(mode))
+    # NICE_BENCH_SIZE clamps the field so huge cases (hi-base: 1e9 @ b80) can
+    # EXECUTE as a short slice on CPU instead of budget-skipping: the line is
+    # then a real measurement of the same kernels, flagged range_clamped.
+    size_cap = int(os.environ.get("NICE_BENCH_SIZE", "0"))
+    range_size = data.range_size
+    range_clamped = 0 < size_cap < range_size
+    if range_clamped:
+        range_size = size_cap
     batch_size = min(
-        batch_size, max(1 << 18, 1 << (data.range_size - 1).bit_length())
+        batch_size, max(1 << 18, 1 << (range_size - 1).bit_length())
     )
 
     if kind == "detailed":
@@ -434,7 +452,10 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     st0 = int(ENGINE_STATS_TRANSFERS.value(("detailed",)))
     cc0 = compile_cache.counts()
 
-    rng = data.to_field_size()
+    rng = (
+        FieldSize(data.range_start, data.range_start + range_size)
+        if range_clamped else data.to_field_size()
+    )
     t0 = time.monotonic()
     results = run(rng)
     elapsed = time.monotonic() - t0
@@ -446,21 +467,25 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
 
     if kind == "detailed":
         total = sum(d.count for d in results.distribution)
-        assert total == data.range_size, (total, data.range_size)
+        assert total == range_size, (total, range_size)
         baseline = NORTH_STAR_DETAILED
     else:
         baseline = NORTH_STAR_DETAILED * NICEONLY_SPEEDUP
-    value = data.range_size / elapsed / n_chips
+    value = range_size / elapsed / n_chips
     line = {
         "metric": f"numbers/sec/chip {kind} ({mode}, base {data.base})",
         "value": round(value, 1),
         "unit": "numbers/sec/chip",
         "vs_baseline": round(value / baseline, 3),
         "elapsed_secs": round(elapsed, 3),
-        "range_size": data.range_size,
+        "range_size": range_size,
         "n_chips": n_chips,
         "hits": len(results.nice_numbers),
     }
+    if range_clamped:
+        line["range_clamped"] = True
+    if mode == "hi-base" and kind == "detailed":
+        line.update(_hi_base_extras(data, batch_size))
     # Transfer/cache telemetry for the timed run only (warm-up excluded):
     # readback bytes by payload kind proves the compaction win, and
     # stats_transfers==1 proves the accumulator stayed device-resident.
@@ -471,6 +496,59 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     if cache_delta:
         line["compile_cache"] = cache_delta
     return line
+
+
+def _hi_base_extras(data, batch_size: int) -> dict:
+    """MXU A/B + fused-filter prune probe riding the hi-base case.
+
+    A short fixed slice of the hi-base field is timed twice through the
+    detailed path with NICE_TPU_MXU pinned 0 (VPU carry-save) then 1 (banded
+    Toeplitz dot_general), each after its own warm-up so the pair compares
+    steady-state kernels, not compile time. A niceonly slice then reads the
+    nice_engine_filter_pruned_total delta so the record proves the fused
+    residue filter pruned candidates ON DEVICE (non-zero) rather than on the
+    host. Off-TPU both arms are CPU emulation: the 8-bit digit split does
+    ~4x the scalar work of the VPU's 16-bit schoolbook (the price of the
+    provable i32 accumulator bound — free on a systolic array, real on a
+    CPU), so expect mxu_secs to trail there; the A/B is a correctness
+    anchor off-chip and a perf signal only on real MXU hardware."""
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.obs.series import ENGINE_FILTER_PRUNED
+    from nice_tpu.ops import engine
+
+    ab_size = min(data.range_size, max(batch_size, 1 << 18))
+    rng = FieldSize(data.range_start, data.range_start + ab_size)
+    out: dict = {}
+    prev = os.environ.get("NICE_TPU_MXU")
+    try:
+        ab = {"slice": ab_size}
+        for field, pin in (("vpu_secs", "0"), ("mxu_secs", "1")):
+            os.environ["NICE_TPU_MXU"] = pin
+            engine.process_range_detailed(
+                rng, data.base, backend="jax", batch_size=batch_size
+            )  # warm: compile the pinned variant before timing it
+            t0 = time.monotonic()
+            engine.process_range_detailed(
+                rng, data.base, backend="jax", batch_size=batch_size
+            )
+            ab[field] = round(time.monotonic() - t0, 3)
+        import jax
+
+        if jax.default_backend() != "tpu":
+            ab["note"] = "cpu-emulated: digit-split overhead, no MXU"
+        out["mxu_ab"] = ab
+    finally:
+        if prev is None:
+            os.environ.pop("NICE_TPU_MXU", None)
+        else:
+            os.environ["NICE_TPU_MXU"] = prev
+    key = ("niceonly", str(data.base))
+    pruned0 = int(ENGINE_FILTER_PRUNED.value(key))
+    engine.process_range_niceonly(
+        rng, data.base, backend="jax", batch_size=batch_size
+    )
+    out["filter_pruned"] = int(ENGINE_FILTER_PRUNED.value(key)) - pruned0
+    return out
 
 
 def _run_mode_capped(
@@ -534,6 +612,19 @@ def _parse_suite(raw: str) -> tuple:
     return tuple(suite)
 
 
+def _parse_only(argv: list) -> str | None:
+    """`--only MODE` / `--only=MODE`: case filter, argparse-free so the
+    driver's env-knob contract (no CLI required) stays intact."""
+    only = None
+    it = iter(argv)
+    for arg in it:
+        if arg == "--only":
+            only = next(it, None)
+        elif arg.startswith("--only="):
+            only = arg.split("=", 1)[1]
+    return only
+
+
 def main() -> int:
     remaining, budget = _budget_clock()
     # Engine per-field phase traces (floor, stride depth, descriptors,
@@ -555,6 +646,11 @@ def main() -> int:
             ) or ((mode, _MODE_KIND.get(mode, "detailed")),)
         else:
             suite = DEFAULT_SUITE
+        only = _parse_only(sys.argv[1:])
+        if only:
+            suite = tuple(
+                (m, k) for (m, k) in suite if m == only
+            ) or ((only, _MODE_KIND.get(only, "detailed")),)
     except ValueError as exc:
         # Still a JSON line, never a bare traceback (driver contract).
         print(

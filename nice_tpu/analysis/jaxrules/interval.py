@@ -85,8 +85,14 @@ class ProofStats:
 
 
 class IntervalInterpreter:
-    def __init__(self, ref_bound: Optional[Interval] = None):
+    def __init__(self, ref_bound: Optional[Interval] = None,
+                 dot_bound: Optional[Interval] = None):
         self.ref_bound = ref_bound
+        # Declared dot_general accumulator bound (TraceTarget.dot_bound):
+        # intersected with the naive per-element product bound, so a spec
+        # can discharge MXU contraction headroom with a stated theorem
+        # (ops/mxu.accum_bound) instead of a baseline allow.
+        self.dot_bound = dot_bound
         self.obligations: List[Obligation] = []
         self.stats = ProofStats()
         # var -> defining record for peephole matching
@@ -557,8 +563,17 @@ def _h_dot_general(interp, eqn, env, grid):
                 n *= int(shape[a])
     prods = [ia[0] * ib[0], ia[0] * ib[1], ia[1] * ib[0], ia[1] * ib[1]]
     n = max(n, 1)
-    _arith(interp, eqn, env, (min(prods) * n, max(prods) * n),
-           checkable=False)
+    lo, hi = min(prods) * n, max(prods) * n
+    if interp.dot_bound is not None:
+        # The declared contraction bound narrows the naive sum-of-products
+        # interval (the naive bound multiplies by the FULL contraction depth
+        # even when the operand structure — e.g. the banded Toeplitz digit
+        # split — guarantees a tighter sum).
+        lo = max(lo, interp.dot_bound[0])
+        hi = min(hi, interp.dot_bound[1])
+        if lo > hi:
+            lo, hi = interp.dot_bound
+    _arith(interp, eqn, env, (lo, hi), checkable=False)
 
 
 def _h_cond(interp, eqn, env, grid):

@@ -6,8 +6,10 @@ limb-math sweep over ``carry_interval in {0, 1, max}`` — and reports every
 arithmetic op that may wrap its dtype without feeding the carry-save
 wrap-detection idiom. This is the machine-checked form of the invariant the
 autotuner currently takes on faith: carry-save columns in
-``mul_limbs``/``sqr_limbs`` cannot overflow for any supported base <= 510,
-any limb count, any resolution cadence.
+``mul_limbs``/``sqr_limbs`` cannot overflow for any swept base, any limb
+count, any resolution cadence — and the MXU arm's i32 dot_general
+accumulator stays under the declared digit-split bound
+(``TraceTarget.dot_bound``, sourced from ``ops/mxu.accum_bound``).
 
 Input bounds seed from the KernelSpec (notably the histogram accumulator's
 flush contract); per-trace proof statistics land in the CI report under
@@ -27,7 +29,8 @@ def check(project: Project, ctx) -> List[Violation]:
     out = {}
     report = ctx.report.setdefault("j2", {})
     for trace in ctx.traces:
-        interp = IntervalInterpreter(ref_bound=trace.target.ref_bound)
+        interp = IntervalInterpreter(ref_bound=trace.target.ref_bound,
+                                     dot_bound=trace.target.dot_bound)
         interp.run(trace.closed, dict(trace.target.arg_bounds))
         entry = interp.stats.as_report()
         entry["obligations"] = len(interp.obligations)

@@ -24,11 +24,13 @@ from nice_tpu.analysis import astutil, kernelspec
 from nice_tpu.analysis.core import Project, Violation
 from nice_tpu.analysis.jaxrules import jrule, trace_violation
 
-# Brackets the cap: 40/80/510 are sweep bases; 638 needs a 5th histogram
-# row ((638+2)/128 = 5) and must be rejected until the cap is lifted in
-# both places.
-PROBE_BASES = (40, 80, 510)
-PROBE_BASE_ABOVE_CAP = 638
+# Brackets the cap: 40/80/510 are sweep bases; 2100 needs a 17th histogram
+# row ((2100+2)/128 = 17) and must be rejected until the 16-row cap is
+# lifted in both places. 513 (5 rows, and the cheapest 5-row plan — the
+# same 29-limb class as 510) sits INSIDE the lifted cap and probes
+# that the old 4-row ceiling stays gone.
+PROBE_BASES = (40, 80, 510, 513)
+PROBE_BASE_ABOVE_CAP = 2100
 
 
 def check(project: Project, ctx) -> List[Violation]:

@@ -68,7 +68,7 @@ def build_context(
     root: str,
     bases: Iterable[int],
     specs: Optional[Iterable[kernelspec.KernelSpec]] = None,
-    budget_secs: float = 900.0,
+    budget_secs: float = 3600.0,
     lower_accum: bool = True,
 ) -> TraceContext:
     """Trace every (spec, base[, cadence]) combination within budget."""
@@ -91,7 +91,7 @@ def build_context(
             if not spec.applies(plan):
                 continue
             if spec.kind == "limbmath":
-                cis = kernelspec.carry_cadences(plan)
+                cis = (spec.cadences or kernelspec.carry_cadences)(plan)
             elif spec.takes_carry_interval:
                 cis = (0,)
             else:

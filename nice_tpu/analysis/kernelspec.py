@@ -49,7 +49,9 @@ PER_BATCH_HIST_BOUND = (0, 1 << 26)
 # Pallas stats-tile histogram row cap: must equal pallas_engine._HIST_ROWS_MAX
 # (J6 cross-checks both directions over a probe sweep). Bases with
 # ceil((base+2)/128) rows above this cap fall back to the jnp backend.
-MAX_HIST_ROWS = 4
+# 16 rows admits bases up to 2046 (the old 4-row cap pinned the sweep at
+# 510); the stats tile stays a bounded trace-time constant either way.
+MAX_HIST_ROWS = 16
 
 # Casts the limb/stats kernels are allowed to contain (J1). Everything else —
 # in particular any float dtype and any widening past 32 bits — is a finding.
@@ -71,6 +73,7 @@ KNOWN_JIT_SURFACES = frozenset({
     # vector_engine decorated entry points
     "detailed_batch", "uniques_batch", "survivors_batch",
     "detailed_accum_batch", "niceonly_dense_batch",
+    "niceonly_filtered_batch",
     # pallas_engine callable factories (lru-cached, jit inside)
     "_stats_callable", "_uniques_callable", "_survivors_callable",
     "_detailed_accum_callable", "_strided_callable",
@@ -105,6 +108,11 @@ class TraceTarget:
     arg_bounds: Dict[int, Tuple[int, int]]  # flat arg index -> value bound
     donate: Tuple[int, ...] = ()         # flat arg indices expected donated
     ref_bound: Optional[Tuple[int, int]] = None  # pallas out-ref state bound
+    # Declared i32 dot_general accumulator bound (the MXU limb-multiply
+    # contraction): J2's interval interpreter intersects this with its naive
+    # per-element bound, so headroom is discharged by a stated theorem about
+    # the digit split (ops/mxu.accum_bound), not a baseline allow.
+    dot_bound: Optional[Tuple[int, int]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,6 +130,12 @@ class KernelSpec:
     takes_carry_interval: bool = True
     max_hist_rows: Optional[int] = None
     max_const_elems: int = 1 << 16
+    # Optional limbmath cadence override: (plan) -> cadence tuple. None =
+    # the full carry_cadences sweep. The MXU arm trims to the endpoint
+    # cadences — its new proof surface (the dot_general accumulator) is
+    # cadence-independent, and the shared carry-save resolve is already
+    # swept at every cadence through the VPU arm's specs.
+    cadences: Optional[Callable] = None
 
     @property
     def func(self) -> str:
@@ -173,8 +187,8 @@ def _hist_rows(plan) -> int:
 
 
 _STATIC_RANGE = (
-    ("base", "plan registry; bases with a valid range (<= 510 under the "
-     "4-row pallas histogram cap)"),
+    ("base", "plan registry; bases with a valid range (<= 2046 under the "
+     "16-row pallas histogram cap)"),
     ("batch_size", "autotune sweep powers of two, <= 2**26"),
     ("carry_interval", "0..limbs_n (autotuned cadence)"),
 )
@@ -288,6 +302,25 @@ _ve_spec(
 )
 
 
+# Fused residue-filter niceonly: congruence mask -> prefix-scatter
+# compaction -> limb math on survivors only. Returns (nice, pruned).
+def _build_ve_filtered(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+    L = plan.limbs_n
+
+    def fn(*a):
+        return ve.niceonly_filtered_batch(plan, batch, list(a[:L]), a[L],
+                                          carry_interval=ci)
+    return TraceTarget(fn, _ve_range_args(plan), {L: (0, batch)})
+
+
+_ve_spec(
+    "niceonly_filtered_batch", "niceonly",
+    lambda plan, batch: (((), "int32"), ((), "int32")),
+    _build_ve_filtered,
+)
+
+
 # Limb-math core traced without jit: sqr + mul + digit extraction exactly as
 # num_uniques_lanes composes them. This is the J2 carry-headroom proof
 # surface — swept over carry_interval {0, 1, max} per base.
@@ -304,6 +337,38 @@ _ve_spec(
     "num_uniques_lanes", "limbmath",
     lambda plan, batch: (((batch,), "int32"),),
     _build_ve_limbmath,
+)
+
+
+# MXU arm of the limb-math core: the same sqr + mul + digit-extraction
+# composition routed through the banded Toeplitz dot_general (ops/mxu.py).
+# The TraceTarget declares the contraction's accumulator bound
+# (mxu.accum_bound — a theorem about the 8x16-bit digit split), which J2
+# intersects with its naive interval so MXU headroom is proved, not allowed.
+def _mxu_supports(plan) -> bool:
+    from nice_tpu.ops import mxu
+    return mxu.supports_plan(plan)
+
+
+def _build_ve_limbmath_mxu(plan, batch, ci):
+    from nice_tpu.ops import mxu, vector_engine as ve
+
+    def fn(*limbs):
+        return ve.num_uniques_lanes(plan, list(limbs), ci, use_mxu=True)
+    args = tuple(_sds((batch,), "uint32") for _ in range(plan.limbs_n))
+    return TraceTarget(fn, args, {},
+                       dot_bound=(0, mxu.accum_bound(plan.limbs_n)))
+
+
+_ve_spec(
+    "num_uniques_lanes_mxu", "limbmath",
+    lambda plan, batch: (((batch,), "int32"),),
+    _build_ve_limbmath_mxu,
+    applies=_mxu_supports,
+    cadences=lambda plan: tuple(sorted({0, plan.limbs_n})),
+    static_domain=_STATIC_RANGE + (
+        ("use_mxu", "boolean engine arm (env NICE_TPU_MXU > autotuned)"),
+    ),
 )
 
 
@@ -356,6 +421,26 @@ _pe_spec(
     "niceonly_dense_batch", "niceonly",
     lambda plan, batch: (((), "int32"),),
     _build_pe_niceonly,
+)
+
+
+# Fused-filter pallas twin: the residue congruence mask is evaluated inside
+# the stats kernel (SIMD masking, no compaction) so pruned lanes never feed
+# the nice count; returns (nice, pruned) tallies from the stats tile.
+def _build_pe_fused(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(start, valid):
+        return pe.niceonly_fused_batch(plan, batch, start, valid,
+                                       carry_interval=ci)
+    return TraceTarget(fn, _pe_range_args(plan), {1: (0, batch)},
+                       ref_bound=PER_BATCH_HIST_BOUND)
+
+
+_pe_spec(
+    "niceonly_fused_batch", "niceonly",
+    lambda plan, batch: (((), "int32"), ((), "int32")),
+    _build_pe_fused,
 )
 
 

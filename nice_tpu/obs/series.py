@@ -60,6 +60,12 @@ ENGINE_SURVIVOR_OVERFLOW = metrics.counter(
     "Compacted survivor readbacks that overflowed the on-device cap and "
     "fell back to a dense per-lane transfer.",
 )
+ENGINE_FILTER_PRUNED = metrics.counter(
+    "nice_engine_filter_pruned_total",
+    "Candidates pruned on-device by the fused residue/stride filter before "
+    "any limb math ran, by mode and base.",
+    labelnames=("mode", "base"),
+)
 
 # --- pallas + mesh dispatch ---------------------------------------------
 PALLAS_DISPATCH_SECONDS = metrics.histogram(

@@ -43,6 +43,7 @@ ENV_VARS = {
     "batch_size": "NICE_TPU_BATCH",
     "block_rows": "NICE_TPU_BLOCK_ROWS",
     "carry_interval": "NICE_TPU_CARRY_INTERVAL",
+    "use_mxu": "NICE_TPU_MXU",
 }
 
 _lock = lockdep.make_lock("ops.autotune._lock")
@@ -178,7 +179,8 @@ def record(mode: str, base: int, backend: str, new_params: dict,
 
 def sweep(mode: str, bench_mode: str, backend: str, *,
           batch_shifts: list[int], rows: list[int] | None = None,
-          carry: list[int] | None = None, slice_size: int = 1_000_000,
+          carry: list[int] | None = None, mxu: str | None = None,
+          slice_size: int = 1_000_000,
           timeout: float = 900.0) -> dict | None:
     """Run the scripts/tune_kernels.py timing harness over the cartesian
     config grid and persist the best-throughput config as this key's winner.
@@ -197,6 +199,8 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
         cmd += ["--sweep-rows", ",".join(str(r) for r in rows)]
     if carry:
         cmd += ["--carry", ",".join(str(c) for c in carry)]
+    if mxu:
+        cmd += ["--mxu", mxu]
     AUTOTUNE_EVENTS.labels("sweep").inc()
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=timeout,
@@ -223,7 +227,7 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
     best = max(results, key=lambda r: r["numbers_per_sec"])
     new_params = {
         k: best[k]
-        for k in ("batch_size", "block_rows", "carry_interval")
+        for k in ("batch_size", "block_rows", "carry_interval", "use_mxu")
         if best.get(k) is not None
     }
     record(
@@ -231,7 +235,8 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
         throughput=float(best["numbers_per_sec"]),
         swept=[
             {k: r.get(k) for k in
-             ("batch_size", "block_rows", "carry_interval", "numbers_per_sec")}
+             ("batch_size", "block_rows", "carry_interval", "use_mxu",
+              "numbers_per_sec")}
             for r in results
         ],
         # The harness subprocess reports a stepprof breakdown when it ran

@@ -49,6 +49,7 @@ from nice_tpu.obs.series import (
     ENGINE_BATCH_KERNEL_SECONDS,
     ENGINE_DESCRIPTORS,
     ENGINE_DISPATCH_OCCUPANCY,
+    ENGINE_FILTER_PRUNED,
     ENGINE_HOST_FALLBACK,
     ENGINE_NUMBERS,
     ENGINE_READBACK_BYTES,
@@ -1174,23 +1175,28 @@ def _strided_setup(base: int, field_size: int) -> "_StridedSetup | None":
 
 
 def resolve_tuning(mode: str, base: int, backend: str,
-                   batch_size: int | None = None) -> tuple[int, int, int]:
+                   batch_size: int | None = None) -> tuple[int, int, int, int]:
     """Resolve the kernel-shape knobs for one dispatch: (batch_size,
-    block_rows, carry_interval) under the autotuner's env > tuned > default
-    precedence (ops/autotune.py; NICE_TPU_BATCH / NICE_TPU_BLOCK_ROWS /
-    NICE_TPU_CARRY_INTERVAL pin a knob for one run).
+    block_rows, carry_interval, use_mxu) under the autotuner's env > tuned >
+    default precedence (ops/autotune.py; NICE_TPU_BATCH / NICE_TPU_BLOCK_ROWS
+    / NICE_TPU_CARRY_INTERVAL / NICE_TPU_MXU pin a knob for one run).
 
     The table is keyed by the backend string the CALLER requested ("jax" /
     "pallas" / "jnp") — the same spelling scripts/tune_kernels.py records
     under — not the _pick_backend resolution; a tuned entry can't leak
     across accelerators anyway because its signature pins the platform.
     An explicitly passed batch_size is honored untouched (bench and the
-    tuning harness sweep it themselves); block_rows / carry_interval are
-    always resolved. Host backends (scalar/native) get plain defaults —
-    these knobs don't exist there."""
+    tuning harness sweep it themselves); block_rows / carry_interval /
+    use_mxu are always resolved. Host backends (scalar/native) get plain
+    defaults — these knobs don't exist there.
+
+    use_mxu routes limb products through the banded Toeplitz dot_general
+    path (ops/mxu.py, bit-identical); it is forced to 0 for any plan whose
+    MXU accumulator bound does not fit i32 (mxu.supports_plan), so a stale
+    pin can never select an unprovable kernel."""
     if backend not in ("jax", "jnp", "pallas"):
-        return batch_size or DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0
-    from nice_tpu.ops import autotune
+        return batch_size or DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0
+    from nice_tpu.ops import autotune, mxu
 
     if batch_size is None:
         batch_size = autotune.choose(
@@ -1202,7 +1208,10 @@ def resolve_tuning(mode: str, base: int, backend: str,
     carry_interval = autotune.choose(
         mode, base, backend, "carry_interval", 0
     )
-    return batch_size, block_rows, carry_interval
+    use_mxu = autotune.choose(mode, base, backend, "use_mxu", 0)
+    if use_mxu and not mxu.supports_plan(get_plan(base)):
+        use_mxu = 0
+    return batch_size, block_rows, carry_interval, 1 if use_mxu else 0
 
 
 def _batch_arg_shapes(plan):
@@ -1217,14 +1226,15 @@ def _batch_arg_shapes(plan):
 
 
 def _detailed_accum_executable(plan, batch_size: int, backend: str,
-                               block_rows: int = 0, carry_interval: int = 0):
+                               block_rows: int = 0, carry_interval: int = 0,
+                               use_mxu: int = 0):
     """AOT-compiled single-device detailed step with a device-resident
     accumulator: exec(hist_acc i32[base+2], start_limbs, valid) ->
     (new_acc, near_miss_count). Cached per (plan, batch, backend, shape
     knobs) so a second field of the same shape never re-lowers (and the
     persistent cache makes a second *process* skip XLA compilation too).
-    carry_interval is a static argname burned in at lowering; block_rows only
-    shapes the pallas grid (0 = module default)."""
+    carry_interval / use_mxu are static argnames burned in at lowering;
+    block_rows only shapes the pallas grid (0 = module default)."""
     import jax
     import jax.numpy as jnp
 
@@ -1233,33 +1243,43 @@ def _detailed_accum_executable(plan, batch_size: int, backend: str,
         if backend == "pallas":
             br = pe._effective_block_rows(batch_size, block_rows or pe.BLOCK_ROWS)
             jitted = pe._detailed_accum_callable(
-                plan, batch_size, br, carry_interval=carry_interval
+                plan, batch_size, br, carry_interval=carry_interval,
+                use_mxu=bool(use_mxu),
             )
             return compile_cache.aot(jitted, acc, *_batch_arg_shapes(plan))
         return compile_cache.aot(
             ve.detailed_accum_batch, plan, batch_size, acc,
             *_batch_arg_shapes(plan), carry_interval=carry_interval,
+            use_mxu=bool(use_mxu),
         )
 
     return compile_cache.executable(
         ("detailed-accum", backend, plan, batch_size, block_rows,
-         carry_interval),
+         carry_interval, use_mxu),
         build,
     )
 
 
-def _niceonly_dense_executable(plan, batch_size: int, carry_interval: int = 0):
+def _niceonly_dense_executable(plan, batch_size: int, carry_interval: int = 0,
+                               use_mxu: int = 0, fused: bool = False):
     """AOT-compiled single-device dense niceonly count step (jnp; the pallas
-    niceonly path is strided and never reaches the dense loop)."""
+    niceonly path is strided and never reaches the dense loop).
+
+    fused=True compiles ve.niceonly_filtered_batch — the residue filter
+    evaluated on-device in front of the limb math — whose executable
+    returns (nice_count, pruned) instead of a bare count."""
 
     def build():
+        fn = ve.niceonly_filtered_batch if fused else ve.niceonly_dense_batch
         return compile_cache.aot(
-            ve.niceonly_dense_batch, plan, batch_size,
+            fn, plan, batch_size,
             *_batch_arg_shapes(plan), carry_interval=carry_interval,
+            use_mxu=bool(use_mxu),
         )
 
     return compile_cache.executable(
-        ("niceonly-dense", plan, batch_size, carry_interval), build
+        ("niceonly-dense", plan, batch_size, carry_interval, use_mxu, fused),
+        build,
     )
 
 
@@ -1276,7 +1296,7 @@ def warm_detailed(base: int, batch_size: int | None = None,
     if backend in ("scalar", "native"):
         return
     compile_cache.setup()
-    batch_size, block_rows, carry_interval = resolve_tuning(
+    batch_size, block_rows, carry_interval, use_mxu = resolve_tuning(
         "detailed", base, backend, batch_size
     )
     plan = get_plan(base)
@@ -1294,7 +1314,7 @@ def warm_detailed(base: int, batch_size: int | None = None,
         pmesh.make_sharded_stats_fold(mesh)
     else:
         _detailed_accum_executable(
-            plan, batch_size, backend, block_rows, carry_interval
+            plan, batch_size, backend, block_rows, carry_interval, use_mxu
         )
 
 
@@ -1799,8 +1819,8 @@ def _process_range_detailed(
 
     batch_size=None (the default) resolves batch/block_rows/carry_interval
     through the autotuner (resolve_tuning: env > tuned winners > defaults);
-    an explicit batch_size pins the batch and still resolves the other two."""
-    batch_size, block_rows, carry_interval = resolve_tuning(
+    an explicit batch_size pins the batch and still resolves the others."""
+    batch_size, block_rows, carry_interval, use_mxu = resolve_tuning(
         "detailed", base, backend, batch_size
     )
     if backend == "scalar":
@@ -1898,7 +1918,8 @@ def _process_range_detailed(
             # step above stays at module defaults (its per-device kernel
             # shape is owned by parallel/mesh.py).
             accum_exec = _detailed_accum_executable(
-                plan, batch_size, backend, block_rows, carry_interval
+                plan, batch_size, backend, block_rows, carry_interval,
+                use_mxu,
             )
 
             def disp(acc_, item):
@@ -2273,7 +2294,7 @@ def _process_range_niceonly(
     batch_size=None resolves batch/carry_interval through the autotuner
     (resolve_tuning); the strided pallas pipeline picks its own shapes and
     ignores the dense-scan knobs."""
-    batch_size, _block_rows, carry_interval = resolve_tuning(
+    batch_size, _block_rows, carry_interval, use_mxu = resolve_tuning(
         "niceonly", base, backend, batch_size
     )
     if backend == "scalar":
@@ -2461,23 +2482,43 @@ def _process_range_niceonly(
         """Dispatch closure for the current mesh layout — rebuilt by the
         elastic downshift. Only the jnp dense path reaches here (the pallas
         strided path returned above), so the per-device kernel is jnp by
-        construction."""
+        construction. Every dispatch returns (count, pruned) with pruned
+        None on the unfused paths, so the collector sees one shape."""
         if mesh_ is not None:
+            # The sharded step stays unfused: its per-device kernel shape is
+            # owned by parallel/mesh.py.
             step = pmesh.make_sharded_stats_step(
                 plan, batch_size, mesh_, "niceonly", kernel="jnp"
             )
 
             def disp(item):
-                return step(item.starts, item.valids)
+                return step(item.starts, item.valids), None
         else:
-            count_exec = _niceonly_dense_executable(
-                plan, batch_size, carry_interval
-            )
+            # Fused residue filter (NICE_TPU_FUSED_FILTER, default on):
+            # the congruence mask prunes lanes on-device BEFORE limb math,
+            # worthwhile whenever the filter actually excludes classes.
+            from nice_tpu.ops import residue_filter
 
-            def disp(item):
-                return count_exec(
-                    item.starts[0], np.int32(int(item.valids[0]))
-                )
+            fused = (
+                knobs.FUSED_FILTER.get()
+                and base > 2
+                and len(residue_filter.get_residue_filter(base)) < base - 1
+            )
+            count_exec = _niceonly_dense_executable(
+                plan, batch_size, carry_interval, use_mxu, fused
+            )
+            if fused:
+
+                def disp(item):
+                    return count_exec(
+                        item.starts[0], np.int32(int(item.valids[0]))
+                    )
+            else:
+
+                def disp(item):
+                    return count_exec(
+                        item.starts[0], np.int32(int(item.valids[0]))
+                    ), None
 
         return disp
 
@@ -2506,8 +2547,16 @@ def _process_range_niceonly(
     def collect_item(kind, *payload):
         t0 = time.monotonic()
         if kind == "count":
-            segs, count = payload
-            ENGINE_READBACK_BYTES.labels("count").inc(4)
+            segs, (count, pruned) = payload
+            ENGINE_READBACK_BYTES.labels("count").inc(
+                4 if pruned is None else 8
+            )
+            if pruned is not None:
+                # nicelint: fence (pruned tally readback, fused filter)
+                pruned_n = int(np.asarray(pruned))
+                ENGINE_FILTER_PRUNED.labels("niceonly", str(base)).inc(
+                    pruned_n
+                )
             # nicelint: fence (count flag readback gates extraction)
             if int(np.asarray(count)) > 0:
                 # uniques > base-1 <=> == base: compacted nice extraction,

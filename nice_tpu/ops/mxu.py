@@ -1,0 +1,184 @@
+"""MXU-mapped big-int limb multiplication: banded Toeplitz ``dot_general``.
+
+The carry-save multiply in ops/vector_engine.py grinds every 32x32->64
+partial product through the VPU one elementwise ``mul32`` at a time. The
+column sums it accumulates are exactly a 1-D convolution of the two
+operands' digit vectors — the shape "Large Scale Distributed Linear Algebra
+With Tensor Processing Units" (PAPERS.md) maps onto the MXU: express the
+convolution as a banded Toeplitz matrix of shifted digit windows and
+contract it against the other operand with one ``dot_general`` per column
+band, accumulating in i32 on the systolic array instead of half-word
+arithmetic on the VPU.
+
+Digit split (chosen so the i32 accumulator provably cannot overflow and the
+interval analysis in analysis/jaxrules/interval.py discharges it):
+
+- the LONGER operand is quartered into 8-bit digits ``q`` (values in
+  [0, 255], extracted in the u32 domain before the i32 cast);
+- the SHORTER operand is halved into 16-bit digits ``h`` ([0, 65535]);
+- output column ``t`` (worth 2^(8t)) is ``C_t = sum_j q[t - 2j] * h[j]`` —
+  at most ``2 * short_limbs`` terms of at most ``255 * 65535`` each, so
+  ``C_t <= 2 * short_limbs * 255 * 65535``, which fits i32 for every plan
+  with ``limbs_n <= 64`` (bases far beyond the 510 sweep cap).
+
+Reassembly feeds the 8-bit columns back into the SAME carry-save
+(sums, wraps) representation as vector_engine (``_cs_add`` splitting each
+column across its two overlapping u32 limbs, one deferred ``_cs_resolve``),
+so results are bit-identical to ``mul_limbs``/``sqr_limbs`` under the
+truncation-to-out_len contract: dropped columns and the final high spill
+are all multiples of 2^(32*out_len).
+
+All shapes are trace-time constants; limb entries may be any shape (1-D
+(batch,) lanes from vector_engine or 2-D (rows, 128) Pallas tiles) — the
+Toeplitz contraction batches over every leading axis. The engine arbitrates
+MXU-vs-VPU per (mode, base, backend) through ``resolve_tuning``
+(env NICE_TPU_MXU > autotuned ``use_mxu`` arm > default off).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nice_tpu.ops.limbs import BasePlan
+from nice_tpu.ops.vector_engine import U32, _cs_add, _cs_resolve
+
+I32 = jnp.int32
+
+# Output columns contracted per dot_general call. Bounds the Toeplitz
+# operand at (..., BAND_COLS, halves) i32 per band — 16 keeps the band
+# buffer small enough that the MXU arm's VMEM/RAM footprint is set by the
+# batch axis the autotuner already sweeps.
+BAND_COLS = 16
+
+_DIGIT_MAX = 255   # 8-bit Toeplitz digits
+_HALF_MAX = 65535  # 16-bit contraction halves
+
+
+def accum_bound(short_limbs: int) -> int:
+    """Worst-case column sum of the i32 dot_general accumulator: every one
+    of the ``2 * short_limbs`` halves multiplies a maximal 8-bit digit.
+    This is the DECLARED bound the J2 interval interpreter checks against
+    the traced contraction (kernelspec dot_bound) — a theorem about the
+    digit split, not a measured allowance."""
+    return 2 * short_limbs * _DIGIT_MAX * _HALF_MAX
+
+
+def supports_plan(plan: BasePlan) -> bool:
+    """True when every MXU contraction this plan needs provably fits i32.
+
+    The contraction depth is the half-limb count of the SHORTER operand of
+    each product — ``n`` itself for both n*n and n^2*n — so the bound is
+    set by ``plan.limbs_n`` alone."""
+    return accum_bound(plan.limbs_n) < 2**31
+
+
+def _digits8(limbs: list) -> jnp.ndarray:
+    """Quarter u32 limbs into 8-bit digits, LS digit first, stacked on a new
+    trailing axis. Masked in the u32 domain so every value is provably in
+    [0, 255] before the i32 cast (a direct u32->i32 limb cast could go
+    negative and sink the interval analysis)."""
+    cols = []
+    for limb in limbs:
+        for k in range(4):
+            cols.append(
+                ((limb >> np.uint32(8 * k)) & np.uint32(0xFF)).astype(I32)
+            )
+    return jnp.stack(cols, axis=-1)
+
+
+def _halves16(limbs: list) -> jnp.ndarray:
+    """Halve u32 limbs into 16-bit digits on a new trailing axis (i32,
+    provably in [0, 65535])."""
+    cols = []
+    for limb in limbs:
+        for k in range(2):
+            cols.append(
+                ((limb >> np.uint32(16 * k)) & np.uint32(0xFFFF)).astype(I32)
+            )
+    return jnp.stack(cols, axis=-1)
+
+
+def _column_sums(q: jnp.ndarray, h: jnp.ndarray, t_cols: int) -> jnp.ndarray:
+    """All product columns ``C_t = sum_j q[..., t - 2j] * h[..., j]`` for
+    ``t < t_cols`` as one i32 dot_general per BAND_COLS-column band.
+
+    Per band, the HB shifted windows of the (zero-padded) digit vector are
+    stacked into a (..., band, HB) Toeplitz operand and contracted against
+    the halves on the trailing axis, batching over every leading axis —
+    (batch,) jnp lanes and (rows, 128) Pallas tiles take the same path."""
+    qa = q.shape[-1]
+    hb = h.shape[-1]
+    axis = q.ndim - 1
+    # Left pad so window j's start (t - 2j) is never negative; right pad so
+    # the last band's window end (left + t_cols) always exists.
+    left = 2 * (hb - 1)
+    width = left + max(qa, t_cols)
+    pad = [(0, 0)] * (q.ndim - 1) + [(left, width - left - qa)]
+    qp = jnp.pad(q, pad)
+    nb = h.ndim - 1  # leading batch axes
+    dims = ((nb + 1,), (nb,)), (tuple(range(nb)), tuple(range(nb)))
+    bands = []
+    for t0 in range(0, t_cols, BAND_COLS):
+        bt = min(BAND_COLS, t_cols - t0)
+        windows = [
+            jax.lax.slice_in_dim(
+                qp, left + t0 - 2 * j, left + t0 - 2 * j + bt, axis=axis
+            )
+            for j in range(hb)
+        ]
+        toe = jnp.stack(windows, axis=-1)  # (..., bt, HB)
+        bands.append(
+            jax.lax.dot_general(
+                toe, h, dimension_numbers=dims, preferred_element_type=I32
+            )
+        )
+    return jnp.concatenate(bands, axis=-1)  # (..., t_cols)
+
+
+def _columns_to_limbs(c: jnp.ndarray, out_len: int) -> list:
+    """Reassemble 8-bit column sums into ``out_len`` u32 limbs through the
+    shared carry-save representation: column t (worth 2^(8t)) splits across
+    limb t>>2 and — when t is not limb-aligned — the low bits of limb
+    t>>2 + 1; one deferred ``_cs_resolve`` propagates carries. The i32->u32
+    cast is exact (column sums are non-negative and < 2^31), and a spill
+    past limb out_len-1 is a multiple of 2^(32*out_len) — dropped by the
+    same truncation contract as mul_limbs."""
+    zero = jnp.zeros(c.shape[:-1], U32)
+    sums = [zero] * out_len
+    wraps = [zero] * out_len
+    for t in range(c.shape[-1]):
+        k, s = divmod(8 * t, 32)
+        if k >= out_len:
+            break
+        cu = c[..., t].astype(U32)
+        _cs_add(sums, wraps, k, (cu << np.uint32(s)) if s else cu)
+        if s and k + 1 < out_len:
+            _cs_add(sums, wraps, k + 1, cu >> np.uint32(32 - s))
+    return _cs_resolve(sums, wraps)
+
+
+def mul_limbs_mxu(a: list, b: list, out_len: int) -> list:
+    """MXU multiply with the same contract as vector_engine.mul_limbs:
+    LSW-first limb lists in, ``a * b mod 2^(32*out_len)`` out, bit-identical
+    limbs. The SHORTER operand supplies the 16-bit contraction halves
+    (bounding the i32 accumulator — see ``accum_bound``); the longer one
+    the 8-bit Toeplitz digits."""
+    if len(b) > len(a):
+        a, b = b, a
+    assert accum_bound(len(b)) < 2**31, (len(a), len(b))
+    q = _digits8(a)
+    h = _halves16(b)
+    # Columns past the full convolution are identically zero; columns past
+    # 4*out_len only contribute multiples of 2^(32*out_len).
+    t_cols = min(4 * out_len, q.shape[-1] + 2 * h.shape[-1] - 1)
+    return _columns_to_limbs(_column_sums(q, h, t_cols), out_len)
+
+
+def sqr_limbs_mxu(a: list, out_len: int) -> list:
+    """Squaring through the general MXU multiply. The VPU path halves its
+    multiply count by symmetry; on the MXU the symmetric products ride the
+    same contraction, so no specialization is needed for bit-identity or
+    throughput."""
+    return mul_limbs_mxu(a, a, out_len)

@@ -60,10 +60,12 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# Histogram rows in the stats tile. 4 rows (bases up to 510) is far beyond
-# any base with a valid range the scalar oracle can verify in test time; the
-# cap only bounds the unrolled per-bin accumulation in the kernel.
-_HIST_ROWS_MAX = 4
+# Histogram rows in the stats tile: a plan-derived cap, not a hard-coded 4.
+# 16 rows covers bases up to 2046 — the whole sweep range the limb planner
+# can express — while still bounding the unrolled per-bin accumulation in
+# the kernel (the SMEM tile stays a few KiB). The old 4-row cap silently
+# pinned the searchable range at base 510.
+_HIST_ROWS_MAX = 16
 
 
 def _hist_rows(plan: BasePlan) -> int:
@@ -103,10 +105,15 @@ def _derive_lanes(plan: BasePlan, start_ref, idx, block_rows: int):
 
 
 def _make_kernel(plan: BasePlan, mode: str, block_rows: int,
-                 carry_interval: int = 0):
-    """mode: "detailed" (histogram + near-miss count) or "niceonly" (count).
+                 carry_interval: int = 0, use_mxu: bool = False):
+    """mode: "detailed" (histogram + near-miss count), "niceonly" (count),
+    or "niceonly-fused" (count + pruned, with the residue-filter congruence
+    evaluated in-kernel so pruned lanes never count as candidates).
     carry_interval: carry-save resolution interval threaded into
-    ve.num_uniques_lanes (bit-identical results at any value)."""
+    ve.num_uniques_lanes (bit-identical results at any value). use_mxu
+    mirrors the ops/mxu.py Toeplitz dot_general packing into the kernel —
+    the limb helpers are shape-polymorphic, so the same contraction traces
+    onto (rows, 128) Mosaic tiles."""
     hist_rows = _hist_rows(plan)
 
     def kernel(start_ref, valid_ref, out_ref):
@@ -114,7 +121,7 @@ def _make_kernel(plan: BasePlan, mode: str, block_rows: int,
         lane0 = step * (block_rows * 128)
         idx = _block_iota(block_rows) + lane0
         n = _derive_lanes(plan, start_ref, idx, block_rows)
-        uniques = ve.num_uniques_lanes(plan, n, carry_interval)
+        uniques = ve.num_uniques_lanes(plan, n, carry_interval, use_mxu)
         valid = idx < valid_ref[0]
 
         @pl.when(step == 0)
@@ -133,6 +140,18 @@ def _make_kernel(plan: BasePlan, mode: str, block_rows: int,
             out_ref[hist_rows, 0] += jnp.sum(
                 (valid & (uniques > plan.near_miss_cutoff)).astype(jnp.int32)
             )
+        elif mode == "niceonly-fused":
+            # The fused residue prune: lanes failing the n^2+n^3 congruence
+            # (ve.residue_keep_lanes — pure u32 arithmetic, Mosaic-safe)
+            # cannot be fully nice, so they are masked out of the nice
+            # count and tallied in the pruned counter at [hist_rows, 1].
+            keep = ve.residue_keep_lanes(plan, n)
+            out_ref[hist_rows, 0] += jnp.sum(
+                (valid & keep & (uniques == plan.base)).astype(jnp.int32)
+            )
+            out_ref[hist_rows, 1] += jnp.sum(
+                (valid & ~keep).astype(jnp.int32)
+            )
         else:
             out_ref[hist_rows, 0] += jnp.sum(
                 (valid & (uniques == plan.base)).astype(jnp.int32)
@@ -143,7 +162,8 @@ def _make_kernel(plan: BasePlan, mode: str, block_rows: int,
 
 @functools.lru_cache(maxsize=None)
 def _stats_callable(plan: BasePlan, mode: str, batch_size: int,
-                    block_rows: int, carry_interval: int = 0):
+                    block_rows: int, carry_interval: int = 0,
+                    use_mxu: bool = False):
     assert batch_size % (block_rows * 128) == 0, (batch_size, block_rows)
     num_blocks = batch_size // (block_rows * 128)
     hist_rows = _hist_rows(plan)
@@ -159,7 +179,7 @@ def _stats_callable(plan: BasePlan, mode: str, batch_size: int,
         ),
     )
     call = pl.pallas_call(
-        _make_kernel(plan, mode, block_rows, carry_interval),
+        _make_kernel(plan, mode, block_rows, carry_interval, use_mxu),
         out_shape=jax.ShapeDtypeStruct((tile_rows, 128), jnp.int32),
         grid_spec=grid_spec,
         interpret=_interpret(),
@@ -168,6 +188,8 @@ def _stats_callable(plan: BasePlan, mode: str, batch_size: int,
     @jax.jit
     def run(start_limbs, valid_count):
         tile = call(start_limbs, jnp.reshape(valid_count, (1,)).astype(jnp.int32))
+        if mode == "niceonly-fused":
+            return tile[hist_rows, 0], tile[hist_rows, 1]
         return tile[:hist_rows].reshape(-1), tile[hist_rows, 0]
 
     return run
@@ -190,24 +212,39 @@ def _timed(kernel: str):
 
 
 def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
-                   block_rows: int = BLOCK_ROWS, carry_interval: int = 0):
+                   block_rows: int = BLOCK_ROWS, carry_interval: int = 0,
+                   use_mxu: bool = False):
     """(histogram i32[128 * hist_rows] (bins 0..base+1), near_miss_count i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
     run = _stats_callable(plan, "detailed", batch_size, block_rows,
-                          carry_interval)
+                          carry_interval, use_mxu)
     with _timed("detailed"):
         return run(start_limbs, valid_count)
 
 
 def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
                          valid_count, block_rows: int = BLOCK_ROWS,
-                         carry_interval: int = 0):
+                         carry_interval: int = 0, use_mxu: bool = False):
     """Count of fully nice lanes in a dense range batch (i32)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
     run = _stats_callable(plan, "niceonly", batch_size, block_rows,
-                          carry_interval)
+                          carry_interval, use_mxu)
     with _timed("niceonly_dense"):
         return run(start_limbs, valid_count)[1]
+
+
+def niceonly_fused_batch(plan: BasePlan, batch_size: int, start_limbs,
+                         valid_count, block_rows: int = BLOCK_ROWS,
+                         carry_interval: int = 0, use_mxu: bool = False):
+    """niceonly_dense_batch with the residue filter fused into the kernel:
+    (nice_count i32, pruned i32). Bit-identical count (the congruence only
+    excludes lanes that cannot be fully nice); pruned feeds the
+    nice_engine_filter_pruned_total series."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _stats_callable(plan, "niceonly-fused", batch_size, block_rows,
+                          carry_interval, use_mxu)
+    with _timed("niceonly_fused"):
+        return run(start_limbs, valid_count)
 
 
 # --------------------------------------------------------------------------
@@ -480,11 +517,11 @@ def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
 
 @functools.lru_cache(maxsize=None)
 def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int,
-                             carry_interval: int = 0):
+                             carry_interval: int = 0, use_mxu: bool = False):
     """Detailed stats kernel folding into a device-resident accumulator
     (donated i32[base+2]); see ve.detailed_accum_batch."""
     stats_call = _stats_callable(plan, "detailed", batch_size, block_rows,
-                                 carry_interval)
+                                 carry_interval, use_mxu)
     width = plan.base + 2
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -498,11 +535,11 @@ def _detailed_accum_callable(plan: BasePlan, batch_size: int, block_rows: int,
 def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
                          start_limbs, valid_count,
                          block_rows: int = BLOCK_ROWS,
-                         carry_interval: int = 0):
+                         carry_interval: int = 0, use_mxu: bool = False):
     """detailed_batch folded into a device-resident histogram accumulator
     (hist_acc i32[base+2], donated); returns (new_acc, near_miss_count)."""
     block_rows = _effective_block_rows(batch_size, block_rows)
     run = _detailed_accum_callable(plan, batch_size, block_rows,
-                                   carry_interval)
+                                   carry_interval, use_mxu)
     with _timed("detailed"):
         return run(hist_acc, start_limbs, valid_count)

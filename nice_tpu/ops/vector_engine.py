@@ -288,14 +288,25 @@ def accumulate_digit_masks(plan: BasePlan, masks: list, limbs: list, num_digits:
     return masks
 
 
-def num_uniques_lanes(plan: BasePlan, n_limbs: list, carry_interval: int = 0):
+def num_uniques_lanes(plan: BasePlan, n_limbs: list, carry_interval: int = 0,
+                      use_mxu: bool = False):
     """num_uniques of (n^2, n^3) for a batch of candidates given as limbs.
 
     carry_interval is the carry-save resolution interval (0 = resolve only
     once per product) — a pure performance knob, bit-identical results at any
-    value; the autotuner sweeps it per (mode, base, backend)."""
-    sq = sqr_limbs(n_limbs, plan.limbs_sq, resolve_every=carry_interval)
-    cu = mul_limbs(sq, n_limbs, plan.limbs_cu, resolve_every=carry_interval)
+    value; the autotuner sweeps it per (mode, base, backend). use_mxu routes
+    the limb products through the banded Toeplitz dot_general path
+    (ops/mxu.py) — also bit-identical, also autotuner-arbitrated
+    (env NICE_TPU_MXU > tuned use_mxu arm > default VPU)."""
+    if use_mxu:
+        from nice_tpu.ops import mxu
+
+        sq = mxu.sqr_limbs_mxu(n_limbs, plan.limbs_sq)
+        cu = mxu.mul_limbs_mxu(sq, n_limbs, plan.limbs_cu)
+    else:
+        sq = sqr_limbs(n_limbs, plan.limbs_sq, resolve_every=carry_interval)
+        cu = mul_limbs(sq, n_limbs, plan.limbs_cu,
+                       resolve_every=carry_interval)
     masks = [jnp.zeros_like(n_limbs[0]) for _ in range(plan.n_masks)]
     masks = accumulate_digit_masks(plan, masks, sq, plan.d_sq, plan.hw_sq)
     masks = accumulate_digit_masks(plan, masks, cu, plan.d_cu, plan.hw_cu)
@@ -342,27 +353,27 @@ def detailed_from_uniques(plan: BasePlan, uniques, valid):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1),
-                   static_argnames=("carry_interval",))
+                   static_argnames=("carry_interval", "use_mxu"))
 def detailed_batch(plan: BasePlan, batch_size: int, start_limbs, valid_count,
-                   *, carry_interval: int = 0):
+                   *, carry_interval: int = 0, use_mxu: bool = False):
     """(histogram int32[base+2], near_miss_count int32) for one batch.
 
     Lanes >= valid_count are masked into histogram bin 0 (real candidates
     always have num_uniques >= 1).
     """
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n, carry_interval)
+    uniques = num_uniques_lanes(plan, n, carry_interval, use_mxu)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     return detailed_from_uniques(plan, uniques, lane < valid_count)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1),
-                   static_argnames=("carry_interval",))
+                   static_argnames=("carry_interval", "use_mxu"))
 def uniques_batch(plan: BasePlan, batch_size: int, start_limbs,
-                  *, carry_interval: int = 0):
+                  *, carry_interval: int = 0, use_mxu: bool = False):
     """Per-lane num_uniques (rare-path extraction of near misses / nice)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    return num_uniques_lanes(plan, n, carry_interval)
+    return num_uniques_lanes(plan, n, carry_interval, use_mxu)
 
 
 def compact_survivors(uniques, valid, thresh: int, cap: int):
@@ -389,22 +400,24 @@ def compact_survivors(uniques, valid, thresh: int, cap: int):
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3),
-                   static_argnames=("carry_interval",))
+                   static_argnames=("carry_interval", "use_mxu"))
 def survivors_batch(plan: BasePlan, batch_size: int, thresh: int, cap: int,
-                    start_limbs, valid_count, *, carry_interval: int = 0):
+                    start_limbs, valid_count, *, carry_interval: int = 0,
+                    use_mxu: bool = False):
     """Compacted rare-path extraction: (count, idx[cap], uniq[cap]) of lanes
     with num_uniques > thresh. thresh = near_miss_cutoff serves detailed;
     thresh = base - 1 serves niceonly (uniques > base-1 <=> == base)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n, carry_interval)
+    uniques = num_uniques_lanes(plan, n, carry_interval, use_mxu)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     return compact_survivors(uniques, lane < valid_count, thresh, cap)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,),
-                   static_argnames=("carry_interval",))
+                   static_argnames=("carry_interval", "use_mxu"))
 def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
-                         start_limbs, valid_count, *, carry_interval: int = 0):
+                         start_limbs, valid_count, *, carry_interval: int = 0,
+                         use_mxu: bool = False):
     """detailed_batch folded into a DEVICE-RESIDENT histogram accumulator.
 
     hist_acc (i32[base+2], donated) is carried across batches on the device;
@@ -413,19 +426,104 @@ def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
     well before i32 bins could saturate). Padding lanes land in bin 0, which
     no consumer reads (distributions report bins 1..base)."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n, carry_interval)
+    uniques = num_uniques_lanes(plan, n, carry_interval, use_mxu)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     hist, nm = detailed_from_uniques(plan, uniques, lane < valid_count)
     return hist_acc + hist, nm
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1),
-                   static_argnames=("carry_interval",))
+                   static_argnames=("carry_interval", "use_mxu"))
 def niceonly_dense_batch(plan: BasePlan, batch_size: int, start_limbs,
-                        valid_count, *, carry_interval: int = 0):
+                        valid_count, *, carry_interval: int = 0,
+                        use_mxu: bool = False):
     """Count of fully nice lanes in a dense range batch."""
     n = _iota_lanes(plan, start_limbs, batch_size)
-    uniques = num_uniques_lanes(plan, n, carry_interval)
+    uniques = num_uniques_lanes(plan, n, carry_interval, use_mxu)
     lane = jnp.arange(batch_size, dtype=jnp.int32)
     valid = lane < valid_count
     return jnp.sum((valid & (uniques == plan.base)).astype(jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# Fused residue-filter pruning (device-side, before any limb math)
+# --------------------------------------------------------------------------
+
+def _mod_const(x, c: int):
+    """x mod c for u32 lanes via the divide/multiply-back idiom (one constant
+    division; jnp.mod would be a second division Mosaic does not CSE, and the
+    subtract form is the wrap-free remainder shape the J2 interval
+    interpreter's peephole proves to be in [0, c-1])."""
+    cv = np.uint32(c)
+    q = x // cv
+    return x - q * cv
+
+
+def residue_keep_lanes(plan: BasePlan, n_limbs: list):
+    """Per-lane residue-filter membership, by direct congruence (no table,
+    no gather): a nice n must satisfy n^2 + n^3 == b(b-1)/2 (mod b-1)
+    (digit sums are permutation-invariant — ops/residue_filter.py), so a
+    lane survives iff r = n mod (b-1) satisfies the congruence.
+
+    r comes from a limb fold (2^(32i) mod m weights): every term is below
+    m^2 < 2^22 and the sum over <= 64 limbs stays below 2^28, so the whole
+    evaluation is u32-exact and interval-provable. Membership equals
+    ``r in residue_filter.get_residue_filter(base)`` exactly."""
+    m = plan.base - 1
+    target = plan.base * (plan.base - 1) // 2 % m
+    acc = jnp.zeros_like(n_limbs[0])
+    for i, limb in enumerate(n_limbs):
+        w = np.uint32(pow(2, 32 * i, m))
+        acc = acc + _mod_const(limb, m) * w
+    r = _mod_const(acc, m)
+    t = _mod_const(r * r, m)            # r^2 mod m   (r*r < 2^22)
+    cube = _mod_const(t * r, m)         # r^3 mod m   (t*r < 2^22)
+    return _mod_const(t + cube, m) == np.uint32(target)
+
+
+def filtered_cap(plan: BasePlan, batch_size: int) -> int:
+    """Static survivor cap for a CONSECUTIVE window of batch_size candidates:
+    each residue class contributes at most ceil(batch/(b-1)) members to any
+    window, so |R| * ceil(batch/(b-1)) is a true bound (never drops a
+    survivor); lane-aligned up to a multiple of 128 and clamped at
+    batch_size (survivors cannot exceed the window)."""
+    from nice_tpu.ops import residue_filter
+
+    m = plan.base - 1
+    n_res = len(residue_filter.get_residue_filter(plan.base))
+    cap = n_res * ((batch_size + m - 1) // m)
+    cap = min(-(-cap // 128) * 128, batch_size)
+    return max(cap, 1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   static_argnames=("carry_interval", "use_mxu"))
+def niceonly_filtered_batch(plan: BasePlan, batch_size: int, start_limbs,
+                            valid_count, *, carry_interval: int = 0,
+                            use_mxu: bool = False):
+    """niceonly_dense_batch with the residue filter FUSED in front of the
+    limb math: the congruence mask is evaluated on the raw lane values,
+    survivors are prefix-scatter compacted into a filtered_cap-sized tile
+    (the compact_survivors idiom), and only those lanes pay
+    squaring/cubing/digit extraction. The filter excludes exactly the lanes
+    that cannot be FULLY nice, so the count is bit-identical to the dense
+    kernel's.
+
+    Returns (nice_count int32, pruned int32) — pruned feeds the
+    nice_engine_filter_pruned_total series."""
+    n = _iota_lanes(plan, start_limbs, batch_size)
+    lane = jnp.arange(batch_size, dtype=jnp.int32)
+    valid = lane < valid_count
+    keep = valid & residue_keep_lanes(plan, n)
+    cap = filtered_cap(plan, batch_size)
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    tgt = jnp.where(keep, pos, cap)
+    idx = jnp.zeros(cap, jnp.int32).at[tgt].set(lane, mode="drop")
+    cnt = jnp.sum(keep.astype(jnp.int32))
+    survivors = [limb[idx] for limb in n]
+    uniques = num_uniques_lanes(plan, survivors, carry_interval, use_mxu)
+    sub = jnp.arange(cap, dtype=jnp.int32)
+    # Padding slots replay lane 0; the sub < cnt mask keeps them out.
+    nice = jnp.sum(((sub < cnt) & (uniques == plan.base)).astype(jnp.int32))
+    pruned = jnp.sum(valid.astype(jnp.int32)) - cnt
+    return nice, pruned

@@ -227,6 +227,21 @@ CARRY_INTERVAL = _k(
     owner="ops/autotune.py", group="engine",
     default_doc="autotuned per (mode, base, backend)",
 )
+MXU = _k(
+    "NICE_TPU_MXU", "int", None,
+    "Limb-multiply engine override: 1 routes mul/sqr through the banded"
+    " Toeplitz dot_general MXU path (ops/mxu.py), 0 pins the VPU carry-save"
+    " path (env > autotuned > default off).",
+    owner="ops/autotune.py", group="engine",
+    default_doc="autotuned per (mode, base, backend)",
+)
+FUSED_FILTER = _k(
+    "NICE_TPU_FUSED_FILTER", "bool", True,
+    "Fuse the residue filter into the dense niceonly device kernel so"
+    " pruned candidates never enter limb math (0 = filter stays on the"
+    " host/native paths only).",
+    owner="ops/engine.py", group="engine",
+)
 AUTOTUNE_FILE = _k(
     "NICE_TPU_AUTOTUNE_FILE", "str", None,
     "Path of the persisted autotuner winners table (falls back to"
@@ -595,7 +610,7 @@ JAXLINT_BASES = _k(
     owner="scripts/jaxlint.py", group="analysis",
 )
 JAXLINT_TRACE_BUDGET_SECS = _k(
-    "NICE_TPU_JAXLINT_TRACE_BUDGET_SECS", "float", 900.0,
+    "NICE_TPU_JAXLINT_TRACE_BUDGET_SECS", "float", 3600.0,
     "Wall-clock budget for the jaxpr trace sweep; traces past the budget"
     " are skipped and reported (a skip fails --strict).",
     owner="scripts/jaxlint.py", group="analysis",

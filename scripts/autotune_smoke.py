@@ -34,7 +34,7 @@ from nice_tpu.ops import engine, scalar
 from nice_tpu.obs.series import AUTOTUNE_EVENTS
 
 hits0 = AUTOTUNE_EVENTS.value(("hit",))
-bs, br, ci = engine.resolve_tuning("detailed", 40, "jax")
+bs, br, ci, use_mxu = engine.resolve_tuning("detailed", 40, "jax")
 hits = AUTOTUNE_EVENTS.value(("hit",)) - hits0
 
 lo, _hi = base_range.get_base_range(40)
@@ -42,7 +42,7 @@ rng = FieldSize(lo, lo + 512)
 got = engine.process_range_detailed(rng, 40, backend="jax")
 want = scalar.process_range_detailed(rng, 40)
 print(json.dumps({
-    "resolved": [bs, br, ci],
+    "resolved": [bs, br, ci, use_mxu],
     "hits": hits,
     "field_ok": got == want,
 }))
@@ -95,7 +95,7 @@ def main() -> int:
         json.dump(table, f)
     autotune.reset_for_tests()
     inv0 = AUTOTUNE_EVENTS.value(("invalidated",))
-    bs, _br, _ci = engine.resolve_tuning("detailed", 40, "jax")
+    bs, _br, _ci, _mxu = engine.resolve_tuning("detailed", 40, "jax")
     invalidated = (
         AUTOTUNE_EVENTS.value(("invalidated",)) > inv0
         and bs == engine.DEFAULT_BATCH_SIZE

@@ -21,7 +21,7 @@ def _isolated_table(tmp_path, monkeypatch):
         "NICE_TPU_AUTOTUNE_FILE", str(tmp_path / "winners.json")
     )
     for var in ("NICE_TPU_BATCH", "NICE_TPU_BLOCK_ROWS",
-                "NICE_TPU_CARRY_INTERVAL"):
+                "NICE_TPU_CARRY_INTERVAL", "NICE_TPU_MXU"):
         monkeypatch.delenv(var, raising=False)
     autotune.reset_for_tests()
     yield
@@ -108,20 +108,62 @@ def test_corrupt_table_reads_as_empty():
 
 
 def test_resolve_tuning_precedence(monkeypatch):
-    """The engine-facing resolver composes the three knobs: explicit batch
+    """The engine-facing resolver composes the four knobs: explicit batch
     pins batch (tuned ignored), env pins any knob, host backends bypass the
     table entirely."""
     autotune.record(
         "detailed", 40, "jax",
         {"batch_size": 4096, "block_rows": 32, "carry_interval": 2},
     )
-    assert engine.resolve_tuning("detailed", 40, "jax") == (4096, 32, 2)
-    bs, br, ci = engine.resolve_tuning("detailed", 40, "jax", 512)
-    assert (bs, br, ci) == (512, 32, 2)
+    assert engine.resolve_tuning("detailed", 40, "jax") == (4096, 32, 2, 0)
+    bs, br, ci, mxu = engine.resolve_tuning("detailed", 40, "jax", 512)
+    assert (bs, br, ci, mxu) == (512, 32, 2, 0)
     monkeypatch.setenv("NICE_TPU_BLOCK_ROWS", "16")
     assert engine.resolve_tuning("detailed", 40, "jax")[1] == 16
     monkeypatch.delenv("NICE_TPU_BLOCK_ROWS")
     assert engine.resolve_tuning("detailed", 40, "scalar") == (
-        engine.DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0,
+        engine.DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0,
     )
     assert engine.resolve_tuning("detailed", 40, "scalar", 64)[0] == 64
+
+
+def test_use_mxu_roundtrip_and_env_pin(monkeypatch):
+    """The MXU arm persists like any other winner param, resolves through
+    the same env > tuned > default precedence, and the resolver forces it
+    off for plans past the i32 accumulator bound."""
+    autotune.record(
+        "detailed", 40, "jax",
+        {"batch_size": 4096, "use_mxu": 1},
+    )
+    # Round-trip through a fresh loader (restart analog).
+    autotune.reset_for_tests()
+    assert autotune.choose("detailed", 40, "jax", "use_mxu", 0) == 1
+    assert engine.resolve_tuning("detailed", 40, "jax")[3] == 1
+    # Env pin beats the tuned winner.
+    monkeypatch.setenv("NICE_TPU_MXU", "0")
+    assert engine.resolve_tuning("detailed", 40, "jax")[3] == 0
+    monkeypatch.setenv("NICE_TPU_MXU", "1")
+    assert engine.resolve_tuning("detailed", 40, "jax")[3] == 1
+    # Untuned + no env -> default off.
+    monkeypatch.delenv("NICE_TPU_MXU")
+    assert engine.resolve_tuning("niceonly", 40, "jax")[3] == 0
+
+
+def test_use_mxu_forced_off_past_accum_bound(monkeypatch):
+    """An env pin (or stale winner) cannot enable the MXU path for a plan
+    whose contraction would overflow the declared i32 bound."""
+    from nice_tpu.ops import mxu
+    from nice_tpu.ops.limbs import get_plan
+
+    monkeypatch.setenv("NICE_TPU_MXU", "1")
+    plan = get_plan(40)
+    assert mxu.supports_plan(plan)  # sanity: 40 is MXU-capable
+    assert engine.resolve_tuning("detailed", 40, "jax")[3] == 1
+
+    class _FatPlan:
+        limbs_n = 1 << 20  # accum_bound far past 2**31
+
+    monkeypatch.setattr(
+        "nice_tpu.ops.engine.get_plan", lambda base: _FatPlan()
+    )
+    assert engine.resolve_tuning("detailed", 40, "jax")[3] == 0

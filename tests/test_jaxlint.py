@@ -155,6 +155,33 @@ def test_scatter_add_headroom_is_add_aware():
     assert [ob.prim for ob in bad.obligations] == ["scatter-add"]
 
 
+def test_dot_general_declared_bound_discharges():
+    # The MXU limb-multiply contraction: naive interval (n * max-product)
+    # blows i32, but the digit-split theorem (mxu.accum_bound, declared via
+    # TraceTarget.dot_bound) proves the accumulator fits. Without the
+    # declared bound the dot is an obligation; with it, proven — a declared
+    # bound, not a baseline allow.
+    def contract(toe, h):
+        return jax.lax.dot_general(
+            toe, h, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    args = (sds((16, 8), jnp.int32), sds((8,), jnp.int32))
+    # The naive rule multiplies range maxima by the contraction depth and
+    # cannot see the digit-split pairing, so these bounds overflow i32:
+    wide = {0: (0, 2**20), 1: (0, 2**20)}
+    flagged = run_interval(contract, args, wide)
+    assert [ob.prim for ob in flagged.obligations] == ["dot_general"]
+
+    closed = jax.make_jaxpr(contract)(*args)
+    interp = interval.IntervalInterpreter(
+        dot_bound=(0, 2 * 8 * 255 * 65535)
+    )
+    interp.run(closed, wide)
+    assert interp.obligations == []
+
+
 # ---------------------------------------------------------------------------
 # J1: dtype flow
 

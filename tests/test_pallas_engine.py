@@ -95,16 +95,23 @@ def test_widened_hist_layout():
     """Bases past 126 need a multi-row histogram tile (base+2 bins > 128
     lanes). supports_base previously rejected every such plan, silently
     demoting hi-base detailed scans to jnp; it now admits anything within
-    _HIST_ROWS_MAX rows. Pure layout math — the kernel itself is diffed
-    against the oracle in the slow test below (interpreter-mode XLA compiles
-    of 2-row plans take minutes on CPU)."""
+    _HIST_ROWS_MAX rows — lifted from 4 to the plan-derived 16-row cap
+    (kernelspec.MAX_HIST_ROWS), so 5-row bases past 510 are in. Pure
+    layout math — the kernel itself is diffed against the oracle in the
+    slow tests (interpreter-mode XLA compiles of multi-row plans take
+    minutes on CPU; b127 below, b513 in test_property_differential)."""
     for base, rows, ok in [
         (80, 1, True), (125, 1, True), (127, 2, True), (150, 2, True),
-        (510, 4, True), (512, 5, False),
+        (510, 4, True), (512, 5, True), (513, 5, True), (2045, 16, True),
     ]:
         plan = get_plan(base)
         assert pe._hist_rows(plan) == rows, base
         assert pe.supports_base(plan) is ok, base
+    # Above the contract cap: a 17-row plan must still be rejected.
+    import dataclasses
+
+    fat = dataclasses.replace(get_plan(513), base=2100)
+    assert pe.supports_base(fat) is False
 
 
 @pytest.mark.slow

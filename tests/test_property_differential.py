@@ -219,6 +219,194 @@ def test_limb_array_roundtrip():
     assert limb_arrays_to_ints(cols) == xs
 
 
+# ---------------------------------------------------------------------------
+# MXU banded-Toeplitz multiply vs Python big-int and vs the VPU carry-save
+# path. Sweeps the carry-edge candidates at the bases the jaxlint sweep
+# traces (510 = the widest plan: 29-limb operands, the deepest contraction
+# any supported base feeds the i32 accumulator) — a runtime witness for the
+# declared dot_bound theorem (ops/mxu.accum_bound).
+# ---------------------------------------------------------------------------
+
+_MXU_BASES = [40, 80, 510]
+
+
+def _mxu_candidates(base: int) -> list[int]:
+    cands = _carry_edge_candidates(base)
+    if base >= 500:
+        # Thin the widest plan: eager 29-limb math at every candidate would
+        # blow the tier-1 budget; endpoints + all-ones + an even sample keep
+        # the carry-edge coverage.
+        cands = sorted(set(
+            cands[:2] + cands[-2:] + cands[:: max(1, len(cands) // 6)]
+        ))
+    return cands
+
+
+@pytest.mark.parametrize("base", _MXU_BASES)
+def test_mxu_mul_sqr_limbs_match_bigint(base):
+    """sqr_limbs_mxu(n) == n^2 and mul_limbs_mxu(n^2, n) == n^3 exactly,
+    limb for limb, against Python big-int AND against the VPU carry-save
+    kernels — the MXU arm is a bit-identical drop-in, not an approximation."""
+    from nice_tpu.ops import mxu
+
+    plan = get_plan(base)
+    assert mxu.supports_plan(plan), base
+    ns = _mxu_candidates(base)
+    n_dev = [jnp.asarray(col) for col in ints_to_limb_arrays(ns, plan.limbs_n)]
+    sq = mxu.sqr_limbs_mxu(n_dev, plan.limbs_sq)
+    cu = mxu.mul_limbs_mxu(sq, n_dev, plan.limbs_cu)
+    sq_vpu = ve.sqr_limbs(n_dev, plan.limbs_sq)
+    cu_vpu = ve.mul_limbs(sq_vpu, n_dev, plan.limbs_cu)
+    sq_host = [np.asarray(col) for col in sq]
+    cu_host = [np.asarray(col) for col in cu]
+    for row, n in enumerate(ns):
+        got_sq = [int(col[row]) for col in sq_host]
+        got_cu = [int(col[row]) for col in cu_host]
+        assert got_sq == _bigint_limbs(n * n, plan.limbs_sq), (base, n)
+        assert got_cu == _bigint_limbs(n * n * n, plan.limbs_cu), (base, n)
+    for a, b in zip(sq, sq_vpu):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(cu, cu_vpu):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("base", [40, 80])
+def test_mxu_num_uniques_matches_vpu(base):
+    """The full digit-stats composition (sqr + mul + extraction) agrees
+    lane-for-lane between the MXU and VPU arms."""
+    plan = get_plan(base)
+    ns = _carry_edge_candidates(base)
+    n_dev = [jnp.asarray(col) for col in ints_to_limb_arrays(ns, plan.limbs_n)]
+    u_vpu = ve.num_uniques_lanes(plan, n_dev)
+    u_mxu = ve.num_uniques_lanes(plan, n_dev, use_mxu=True)
+    np.testing.assert_array_equal(np.asarray(u_vpu), np.asarray(u_mxu))
+
+
+# ---------------------------------------------------------------------------
+# Fused residue filter: the on-device congruence mask must reproduce the
+# host residue_filter membership exactly, and the fused (nice, pruned)
+# kernel must agree with the unfused dense count at every MXU arm.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("base", [40, 80])
+@pytest.mark.parametrize("use_mxu", [False, True])
+def test_fused_filter_matches_scalar_oracle(base, use_mxu):
+    from nice_tpu.ops import residue_filter
+
+    plan = get_plan(base)
+    lo, _hi = base_range.get_base_range(base)
+    batch = 2048
+    start = lo + 12345
+    ns = list(range(start, start + batch))
+    start_limbs = [jnp.asarray(c[:1]) for c in ints_to_limb_arrays([start], plan.limbs_n)]
+    start_scalars = [c[0] for c in start_limbs]
+    # Device congruence mask == host residue-set membership, lane for lane.
+    lanes = [jnp.asarray(col) for col in ints_to_limb_arrays(ns, plan.limbs_n)]
+    keep = np.asarray(ve.residue_keep_lanes(plan, lanes))
+    allowed = set(residue_filter.get_residue_filter(base))
+    want_keep = np.array([n % (base - 1) in allowed for n in ns])
+    np.testing.assert_array_equal(keep, want_keep)
+    # Fused (nice, pruned) vs the unfused dense count on the same window.
+    valid = np.int32(batch - 7)  # exercise the valid-count mask too
+    nice_f, pruned = ve.niceonly_filtered_batch(
+        plan, batch, start_scalars, valid, use_mxu=use_mxu
+    )
+    nice_d = ve.niceonly_dense_batch(
+        plan, batch, start_scalars, valid, use_mxu=use_mxu
+    )
+    assert int(nice_f) == int(nice_d), (base, use_mxu)
+    want_pruned = int(sum(1 for n in ns[: int(valid)]
+                          if n % (base - 1) not in allowed))
+    assert int(pruned) == want_pruned, (base, use_mxu)
+
+
+def test_pallas_fused_matches_dense_b40():
+    """The pallas fused-filter stats kernel (interpreter mode off-TPU)
+    agrees with the unfused pallas dense count and reports the same pruned
+    tally as the host oracle."""
+    from nice_tpu.ops import pallas_engine as pe, residue_filter
+
+    base = 40
+    plan = get_plan(base)
+    lo, _hi = base_range.get_base_range(base)
+    batch = 512
+    start = lo + 998
+    start_arr = np.asarray(
+        [c[0] for c in ints_to_limb_arrays([start], plan.limbs_n)],
+        dtype=np.uint32,
+    )
+    valid = np.int32(batch - 3)
+    nice_f, pruned = pe.niceonly_fused_batch(plan, batch, start_arr, valid)
+    nice_d = pe.niceonly_dense_batch(plan, batch, start_arr, valid)
+    assert int(nice_f) == int(nice_d)
+    allowed = set(residue_filter.get_residue_filter(base))
+    want_pruned = sum(1 for n in range(start, start + int(valid))
+                      if n % (base - 1) not in allowed)
+    assert int(pruned) == want_pruned
+
+
+@pytest.mark.slow
+def test_widened_histogram_layout_past_510():
+    """Base 513 needs 5 histogram rows — impossible under the old 4-row
+    pallas cap. With the plan-derived 16-row cap the pallas stats kernel
+    must execute it and lay the histogram out identically to the jnp
+    engine (row-major 128-lane tile flattening, zero padding rows).
+
+    Executes on a hand-built base-513 plan over a tiny sub-range window
+    (d_sq=2, d_cu=3 digits, single-limb numbers): a real 29-limb 513 plan
+    is correct but its interpreter-mode compile runs hours on a small CPU
+    host, while the 5-row histogram scatter/layout — the surface this
+    test exists for — only depends on base, not limb width. Both engines
+    consume the same plan, so the differential stays apples-to-apples;
+    the real-plan contract at 513 is covered by test_widened_hist_layout
+    plus jaxlint's J6 trace probe. Marked slow (~2 min interpreter-mode
+    compile), like the b127 widened-tile test in test_pallas_engine.py."""
+    from nice_tpu.ops import pallas_engine as pe
+    from nice_tpu.ops.limbs import BasePlan, halfwords_for, limbs_for
+
+    base = 513
+    d_sq, d_cu = 2, 3
+    # n in [65, 512): n^2 spans [513, 513^2) = 2 digits, n^3 spans
+    # [513^2, 513^3) = 3 digits, so the exact-digit-count plan contract
+    # holds for the whole window.
+    start, end = 65, 512
+    chunk_e = 1
+    while base ** (chunk_e + 1) <= 1 << 16:
+        chunk_e += 1
+    plan = BasePlan(
+        base=base, range_start=start, range_end=end,
+        d_sq=d_sq, d_cu=d_cu,
+        limbs_n=limbs_for(end),
+        limbs_sq=limbs_for(base**d_sq),
+        limbs_cu=limbs_for(base**d_cu),
+        hw_sq=halfwords_for(base**d_sq),
+        hw_cu=halfwords_for(base**d_cu),
+        chunk_div=base**chunk_e, chunk_e=chunk_e,
+        n_masks=(base + 31) // 32,
+        near_miss_cutoff=4,
+    )
+    assert pe.supports_base(plan), "16-row cap should admit base 513"
+    rows = -(-(base + 2) // 128)
+    assert rows == 5
+    batch = 256
+    start_arr = np.asarray(
+        [c[0] for c in ints_to_limb_arrays([start], plan.limbs_n)],
+        dtype=np.uint32,
+    )
+    valid = np.int32(end - start)
+    hist_pe, nm_pe = pe.detailed_batch(plan, batch, start_arr, valid)
+    hist_ve, nm_ve = ve.detailed_batch(
+        plan, batch, [jnp.asarray(c) for c in start_arr], jnp.int32(valid)
+    )
+    hist_pe = np.asarray(hist_pe)
+    assert hist_pe.shape == (128 * rows,)
+    np.testing.assert_array_equal(
+        hist_pe[: base + 2], np.asarray(hist_ve)
+    )
+    assert not hist_pe[base + 2:].any(), "padding rows must stay zero"
+    assert int(nm_pe) == int(nm_ve)
+
+
 @settings(max_examples=15, deadline=None, derandomize=True)
 @given(base=_BASES, frac=st.floats(0, 1), size=st.integers(2, 20_000))
 def test_msd_filter_drops_only_non_nice_spans(base, frac, size):

@@ -29,6 +29,18 @@ jaxlint:
 jaxlint-fast:
     JAX_PLATFORMS=cpu python scripts/jaxlint.py --strict --bases 40
 
+# thread-ownership race analysis against the ThreadRegistry contract
+racelint:
+    JAX_PLATFORMS=cpu python scripts/racelint.py --strict
+
+# deterministic interleaving explorer over the scenario pack
+racecheck:
+    JAX_PLATFORMS=cpu python scripts/racecheck_smoke.py
+
+# regenerate the runtime lock-order graph racelint R2 cross-checks
+lockorder:
+    JAX_PLATFORMS=cpu python -m nice_tpu.utils.lockdep --dump-graph docs/lockorder.json
+
 # rewrite the nicelint ratchet baseline (justify every entry you keep)
 lint-baseline:
     python scripts/nicelint.py --update-baseline

@@ -1,0 +1,76 @@
+"""racelint: thread-ownership race analysis (the R-rule family).
+
+nicelint reads source AST for project invariants, jaxlint reads traced
+jaxprs; this family reads source AST AGAINST the declared threading
+contract in ``analysis/threadspec.py`` — who may touch which shared state
+from which thread root. Same ratchet baseline, same ``# nicelint: allow``
+escape grammar, same strict gate.
+
+Rules:
+
+* **R1 shared-mutation** — an attribute or module global mutated by code
+  reachable from ≥2 registered thread roots with no common guarding lock
+  and no ownership declaration; plus the coverage gate itself (an
+  unregistered ``Thread(``/pool spawn, or a stale registry entry).
+* **R2 lock-discipline** — every declared ``lock:<label>`` field is
+  written only under that lock; owner-only fields only from their root's
+  reachable set; immutable-after-init fields only from ``__init__``; the
+  static X1 acquisition graph unioned with the runtime lockdep graph
+  (``docs/lockorder.json``) must stay acyclic, so a static/runtime order
+  divergence is flagged before it deadlocks live.
+* **R3 blocking-under-lock** — blocking calls (sqlite, HTTP waits,
+  ``Future.result``, ``queue.get`` without timeout, ``time.sleep``)
+  reachable from a ``may_block=False`` root or lexically inside a lock
+  whose LockSpec says ``may_block_under=False``.
+* **R4 writer-discipline** — ``Future.set_result``/``set_exception`` only
+  inside the writer actor module, and never inside the batch transaction
+  span ("accepted ⇒ durable"); direct ledger mutation outside the writer
+  root's reach.
+* **R5 check-then-act** — a read of a shared dict/cache under a lock,
+  an unlocked window, then a write under the same lock in one function
+  (the status-cache / ``_cached_mesh`` / trust ``peek_known`` shape), and
+  any ``lru_cache`` whose ``cache_clear`` is invoked at runtime (an
+  unguardable clear/rebuild window).
+
+Run via ``scripts/racelint.py`` (or ``just racelint``). The dynamic half
+is ``analysis/schedex.py`` — racelint proves discipline statically,
+schedex replays the interleavings that motivated it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from nice_tpu.analysis import core
+
+_RRULES: Dict[str, object] = {}
+
+
+def rrule(rule_id: str):
+    def deco(fn):
+        _RRULES[rule_id] = fn
+        return fn
+    return deco
+
+
+def all_rrules() -> Dict[str, object]:
+    # Import side-effect registers every R-rule module exactly once.
+    from nice_tpu.analysis.racerules import (  # noqa: F401
+        r1_shared_mutation, r2_lock_discipline, r3_blocking,
+        r4_writer_discipline, r5_check_then_act,
+    )
+    return dict(_RRULES)
+
+
+def run_race_rules(
+    project: core.Project,
+    ctx,
+    only: Optional[Iterable[str]] = None,
+):
+    """(violations, used allow sites) over a built RaceContext, through the
+    shared nicelint runner so inline escapes work identically."""
+    registry = {
+        rule_id: (lambda p, _fn=fn: _fn(p, ctx))
+        for rule_id, fn in all_rrules().items()
+    }
+    return core.run_rules_tracked(project, only=only, registry=registry)

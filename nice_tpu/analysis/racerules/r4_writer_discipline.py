@@ -1,0 +1,109 @@
+"""R4: writer-actor discipline — "accepted ⇒ durable", statically.
+
+The writer actor's contract (server/writer.py): a submission's Future
+resolves ONLY after the batch transaction that made it durable has
+committed, and only the writer thread resolves futures. Three checks:
+
+* ``Future.set_result`` / ``set_exception`` appear nowhere in
+  ``nice_tpu/`` outside the writer module (schedex's instrumented
+  futures in ``analysis/`` are exempt machinery);
+* inside ``server/writer.py`` itself, no future is resolved lexically
+  inside a ``_txn()`` with-span — resolving before commit would
+  acknowledge a write that can still roll back;
+* a mutating ``Db`` method (W1's discovery: ``self._txn`` closure) is
+  never called from a function reachable from a NON-writer thread root
+  outside the sanctioned modules — W1 polices the call-site grammar in
+  ``server/``; this closes the cross-root reachability angle everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from nice_tpu.analysis import astutil
+from nice_tpu.analysis.core import Project, Violation
+from nice_tpu.analysis.racerules import rrule
+from nice_tpu.analysis.rules.w1_writer import mutating_db_methods
+
+WRITER_PATH = "nice_tpu/server/writer.py"
+DB_PATH = "nice_tpu/server/db.py"
+ANALYSIS_PREFIX = "nice_tpu/analysis/"
+RESOLVE_CALLS = ("set_result", "set_exception")
+
+
+def _txn_spans(tree: ast.AST) -> List[tuple]:
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            name = astutil.call_name(expr) if isinstance(expr, ast.Call) \
+                else astutil.dotted(expr)
+            if name and name.rsplit(".", 1)[-1] == "_txn":
+                spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno)))
+    return spans
+
+
+@rrule("R4")
+def check(project: Project, ctx) -> List[Violation]:
+    out: List[Violation] = []
+    mutating = mutating_db_methods(project)
+
+    for src in project.python_files("nice_tpu/"):
+        if src.relpath.startswith(ANALYSIS_PREFIX):
+            continue
+        tree = src.tree()
+        if tree is None:
+            continue
+        enclosing = astutil.enclosing_function_map(tree)
+        txn_spans = _txn_spans(tree) if src.relpath == WRITER_PATH else []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node)
+            if not name or "." not in name:
+                continue
+            method = name.rsplit(".", 1)[-1]
+            line = node.lineno
+            fn = enclosing.get(line, "<module>")
+
+            if method in RESOLVE_CALLS:
+                if src.relpath != WRITER_PATH:
+                    out.append(Violation(
+                        "R4", src.relpath, line,
+                        f"{name}() outside the writer module — only the "
+                        "writer actor resolves futures (accepted ⇒ "
+                        "durable)",
+                        detail=f"resolve-outside-writer:{fn}",
+                    ))
+                elif any(a <= line <= b for a, b in txn_spans):
+                    out.append(Violation(
+                        "R4", src.relpath, line,
+                        f"{name}() inside the batch _txn() span — a "
+                        "future must resolve only after commit, or an "
+                        "acknowledged write can roll back",
+                        detail=f"resolve-inside-txn:{fn}",
+                    ))
+                continue
+
+            # cross-root ledger mutation
+            if method in mutating and src.relpath not in (WRITER_PATH,
+                                                          DB_PATH):
+                obj = name.rpartition(".")[0]
+                if not (obj == "db" or obj.endswith(".db")):
+                    continue
+                roots = ctx.roots_reaching((src.relpath,
+                                            enclosing.get(line, "")))
+                foreign = roots - {"db-writer"}
+                if foreign:
+                    out.append(Violation(
+                        "R4", src.relpath, line,
+                        f"mutating Db call {name}() reachable from "
+                        f"non-writer roots ({', '.join(sorted(foreign))})"
+                        " — route through the writer actor",
+                        detail=f"ledger-foreign:{fn}->{method}",
+                    ))
+    return out

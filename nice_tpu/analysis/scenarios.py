@@ -1,0 +1,374 @@
+"""Targeted schedex scenarios for the coordination plane.
+
+Each scenario names the two (or more) thread roots it crosses, builds
+the shared objects (real production objects where practical, faithful
+models otherwise), and states the invariant that every interleaving
+must preserve.  Scenarios come in pairs where a race was fixed:
+
+* the real-code scenario (``expect = "pass"``) drives the production
+  functions and must hold under *every* explored schedule — this is
+  the regression test the ``nicelint: allow R5`` comments in
+  server/app.py and ops/engine.py point at;
+* a ``*_prefix`` twin (``expect = "race"``) replays the pre-fix body
+  against the same invariant and must FAIL under at least one schedule
+  within the k<=2 preemption bound — proving the explorer can actually
+  see the window the fix closed.
+
+``racy_counter`` is the permanently-racy calibration fixture: if the
+explorer ever stops catching it, the explorer is broken, not the code.
+"""
+
+from __future__ import annotations
+
+import time
+
+from nice_tpu.analysis import schedex
+from nice_tpu.utils import lockdep
+
+
+class Scenario:
+    scenario_name = "?"
+    expect = "pass"  # or "race" for pre-fix twins / calibration fixtures
+
+    def build(self, sched: schedex.Scheduler):
+        raise NotImplementedError
+
+    def check(self) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# status cache: writer batch / lease sweep invalidation vs. fleet rebuild
+# (threads crossed: legacy-httpd request handler vs. db-writer periodics)
+
+
+class _StatusCacheBase(Scenario):
+    """Shared wiring: a skeletal ApiContext whose status-cache lock is a
+    schedex lock (built through the lockdep factory hook), with
+    build_fleet_block patched to read a mutable source-of-truth."""
+
+    def _wire(self, sched: schedex.Scheduler):
+        from nice_tpu.server import app
+        self._app = app
+        ctx = object.__new__(app.ApiContext)
+        ctx.status_cache_ttl = 300.0
+        ctx._status_cache = {}
+        ctx._status_cache_gen = 0
+        with schedex.instrument(sched):
+            ctx._status_cache_lock = lockdep.make_lock(
+                "server.app.ApiContext._status_cache_lock")
+        self.ctx = ctx
+        self.source = {"value": 1}
+        self._orig_build = app.build_fleet_block
+        app.build_fleet_block = lambda _ctx: {"value": self.source["value"]}
+        return ctx
+
+    def _writer(self):
+        # Models a write landing: mutate source of truth, then
+        # invalidate — the real "accepted => durable" ordering.
+        self.source["value"] = 2
+        self.ctx.invalidate_status_cache()
+
+    def check(self) -> None:
+        final = self.ctx.cached_fleet_block()
+        assert final["value"] == 2, (
+            f"stale fleet block served after invalidation: {final} "
+            f"(source={self.source})")
+
+    def cleanup(self) -> None:
+        if getattr(self, "_orig_build", None) is not None:
+            self._app.build_fleet_block = self._orig_build
+            self._orig_build = None
+
+
+class StatusCacheInvalidateVsRebuild(_StatusCacheBase):
+    """Real ApiContext.cached_fleet_block vs. invalidate_status_cache."""
+
+    scenario_name = "status_cache_invalidate_vs_rebuild"
+    expect = "pass"
+
+    def build(self, sched):
+        ctx = self._wire(sched)
+        return [
+            ("status-reader", ctx.cached_fleet_block),
+            ("status-writer", self._writer),
+        ]
+
+
+class StatusCachePreFix(_StatusCacheBase):
+    """The pre-fix body: unconditional store after building outside the
+    lock.  A preemption between build and store caches the stale block."""
+
+    scenario_name = "status_cache_prefix"
+    expect = "race"
+
+    def build(self, sched):
+        ctx = self._wire(sched)
+
+        def prefix_cached_fleet_block():
+            now = time.monotonic()
+            with ctx._status_cache_lock:
+                entry = ctx._status_cache.get("fleet")
+                if entry is not None and now - entry[0] < ctx.status_cache_ttl:
+                    return entry[1]
+            block = self._app.build_fleet_block(ctx)
+            with ctx._status_cache_lock:
+                ctx._status_cache["fleet"] = (time.monotonic(), block)
+            return block
+
+        return [
+            ("status-reader", prefix_cached_fleet_block),
+            ("status-writer", self._writer),
+        ]
+
+
+# ---------------------------------------------------------------------------
+# mesh cache: feed/dispatch rebuild vs. elastic downshift
+# (threads crossed: nice-dispatch callers vs. the downshift path)
+
+
+class _MeshCacheBase(Scenario):
+    """Shared wiring: the real ops.engine mesh-cache globals with the
+    module lock swapped for a schedex lock and make_mesh stubbed.
+
+    The stubbed make_mesh stamps each mesh with the cache generation at
+    build time, so the invariant can state exactly what the fix
+    guarantees: a store never survives an invalidation that happened
+    mid-build (an entry whose build-gen predates the final generation
+    is the downshift-masking bug).  A dispatch whose *argument* tuple
+    is stale but whose build started after the downshift is the
+    caller's live_devices re-read to catch, not the cache's."""
+
+    def _wire(self, sched: schedex.Scheduler):
+        from nice_tpu.ops import engine
+        from nice_tpu.parallel import mesh as pmesh
+        self._engine = engine
+        self._pmesh = pmesh
+        engine._MESH_CACHE.clear()
+        engine._MESH_CACHE_GEN = 0
+        self._orig_lock = engine._mesh_cache_lock
+        engine._mesh_cache_lock = schedex.Lock(
+            sched, "ops.engine._mesh_cache_lock")
+        self._orig_make = pmesh.make_mesh
+        pmesh.make_mesh = lambda devs: (
+            "mesh", tuple(devs), engine._MESH_CACHE_GEN)
+        # Source of truth for which devices are alive; the downshift
+        # marks deaths *before* invalidating, like the real engine.
+        self.alive = {0, 1, 2, 3}
+        self.survivors = (0, 1)
+
+    def _downshift(self):
+        self.alive = set(self.survivors)
+        self._engine._invalidate_mesh_cache()
+        self._engine._cached_mesh(tuple(sorted(self.alive)))
+
+    def check(self) -> None:
+        cache = dict(self._engine._MESH_CACHE)
+        final_gen = self._engine._MESH_CACHE_GEN
+        assert self.survivors in cache, (
+            f"downshift rebuild lost: survivor mesh missing from {cache}")
+        stale = {k: v for k, v in cache.items() if v[2] != final_gen}
+        assert not stale, (
+            f"entries built before an invalidation survived it "
+            f"(final gen {final_gen}): {stale}")
+
+    def cleanup(self) -> None:
+        if getattr(self, "_engine", None) is None:
+            return
+        self._engine._mesh_cache_lock = self._orig_lock
+        self._pmesh.make_mesh = self._orig_make
+        self._engine._MESH_CACHE.clear()
+        self._engine._MESH_CACHE_GEN = 0
+        self._engine = None
+
+
+class MeshCacheClearVsRebuild(_MeshCacheBase):
+    """Real engine._cached_mesh vs. _invalidate_mesh_cache."""
+
+    scenario_name = "mesh_cache_clear_vs_rebuild"
+    expect = "pass"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def dispatch():
+            self._engine._cached_mesh(tuple(sorted(self.alive)))
+
+        return [("nice-dispatch", dispatch), ("downshift", self._downshift)]
+
+
+class MeshCachePreFix(_MeshCacheBase):
+    """The pre-fix lru_cache shape: whatever was built gets stored, even
+    if a downshift invalidated mid-build."""
+
+    scenario_name = "mesh_cache_prefix"
+    expect = "race"
+
+    def build(self, sched):
+        self._wire(sched)
+        engine = self._engine
+
+        def prefix_cached_mesh(devs):
+            with engine._mesh_cache_lock:
+                mesh = engine._MESH_CACHE.get(devs)
+            if mesh is not None:
+                return mesh
+            from nice_tpu.parallel import mesh as pmesh
+            built = pmesh.make_mesh(list(devs))
+            with engine._mesh_cache_lock:
+                return engine._MESH_CACHE.setdefault(devs, built)
+
+        def dispatch():
+            prefix_cached_mesh(tuple(sorted(self.alive)))
+
+        return [("nice-dispatch", dispatch), ("downshift", self._downshift)]
+
+
+# ---------------------------------------------------------------------------
+# lease sweep vs. concurrent submit (modeled on the server's claim flow)
+
+
+class _LeaseBase(Scenario):
+    def _wire(self, sched):
+        self.lock = schedex.Lock(sched, "model.lease_table")
+        self.leases = {"claim-1": "field-A"}
+        self.accepted: list[str] = []
+        self.requeued: list[str] = []
+
+    def _submit(self):
+        # The disciplined submit path: claim-check and accept are one
+        # atomic step, mirroring the 409-on-expired-lease contract.
+        with self.lock:
+            fid = self.leases.pop("claim-1", None)
+            if fid is not None:
+                self.accepted.append(fid)
+
+    def check(self) -> None:
+        hits = [("accepted", f) for f in self.accepted]
+        hits += [("requeued", f) for f in self.requeued]
+        assert len(hits) == 1, (
+            f"field-A must land exactly once (accept XOR requeue), got {hits}")
+
+
+class LeaseSweepVsSubmit(_LeaseBase):
+    """Disciplined sweep: expiry-check and requeue are one atomic step."""
+
+    scenario_name = "lease_sweep_vs_submit"
+    expect = "pass"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def sweep():
+            with self.lock:
+                fid = self.leases.pop("claim-1", None)
+                if fid is not None:
+                    self.requeued.append(fid)
+
+        return [("lease-sweeper", sweep), ("submit-handler", self._submit)]
+
+
+class LeaseSweepPreFix(_LeaseBase):
+    """Check-then-act sweep: expiry decided in one lock block, requeue
+    done in another — a submit in the window double-delivers the field."""
+
+    scenario_name = "lease_sweep_prefix"
+    expect = "race"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def sweep():
+            with self.lock:
+                expired = "claim-1" in self.leases
+            if expired:
+                with self.lock:
+                    self.requeued.append("field-A")
+                    self.leases.pop("claim-1", None)
+
+        return [("lease-sweeper", sweep), ("submit-handler", self._submit)]
+
+
+# ---------------------------------------------------------------------------
+# spool replay vs. claim expiry (modeled on crash-recovery redelivery)
+
+
+class SpoolReplayVsClaimExpiry(Scenario):
+    """Crash-recovery spool replay racing the lease sweeper redelivering
+    an expired claim for the same field: delivery must be exactly-once,
+    which holds because mark-and-deliver is one atomic step."""
+
+    scenario_name = "spool_replay_vs_claim_expiry"
+    expect = "pass"
+
+    def build(self, sched):
+        self.lock = schedex.Lock(sched, "model.delivery_ledger")
+        self.delivered: dict[str, str] = {}
+        self.duplicates: list[tuple[str, str]] = []
+
+        def deliver(fid, src):
+            with self.lock:
+                if fid in self.delivered:
+                    self.duplicates.append((fid, src))
+                    return
+                self.delivered[fid] = src
+
+        def replay():
+            for fid in ("field-1", "field-2"):
+                deliver(fid, "spool-replay")
+
+        def expiry():
+            deliver("field-1", "lease-expiry")
+
+        return [("spool-replayer", replay), ("lease-sweeper", expiry)]
+
+    def check(self) -> None:
+        assert not self.duplicates or all(
+            f in self.delivered for f, _ in self.duplicates), "ledger corrupt"
+        assert set(self.delivered) == {"field-1", "field-2"}, (
+            f"lost fields: delivered={self.delivered}")
+
+
+# ---------------------------------------------------------------------------
+# calibration: a permanently-racy lost-update counter
+
+
+class RacyCounter(Scenario):
+    """Unlocked read-modify-write; any single preemption between the
+    read and the write loses an update.  Must always be caught."""
+
+    scenario_name = "racy_counter"
+    expect = "race"
+
+    def build(self, sched):
+        self.state = {"n": 0}
+
+        def bump(tag):
+            for i in range(2):
+                v = self.state["n"]
+                sched.yield_point(f"{tag}:rmw{i}")
+                self.state["n"] = v + 1
+
+        return [("bump-a", lambda: bump("a")), ("bump-b", lambda: bump("b"))]
+
+    def check(self) -> None:
+        assert self.state["n"] == 4, (
+            f"lost update: counter is {self.state['n']}, want 4")
+
+
+SCENARIOS: dict[str, type[Scenario]] = {
+    cls.scenario_name: cls
+    for cls in (
+        StatusCacheInvalidateVsRebuild,
+        StatusCachePreFix,
+        MeshCacheClearVsRebuild,
+        MeshCachePreFix,
+        LeaseSweepVsSubmit,
+        LeaseSweepPreFix,
+        SpoolReplayVsClaimExpiry,
+        RacyCounter,
+    )
+}

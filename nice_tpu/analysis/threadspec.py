@@ -1,0 +1,427 @@
+"""ThreadRegistry: the declarative ground truth for racelint (R-rules).
+
+nicelint checks syntax-level project invariants and jaxlint checks traced
+kernel plans; racelint checks WHO may touch WHAT from WHICH thread. That
+contract has to live somewhere reviewable, so this module declares:
+
+* :class:`ThreadRoot` — every long-lived thread root in the tree: where it
+  is spawned (file + enclosing scope), what code it runs, its role, which
+  registered locks it is expected to take, and whether it may block.
+  ``scripts/racelint.py`` cross-checks the registry against every
+  ``threading.Thread(`` / ``ThreadPoolExecutor(`` / ``ThreadingHTTPServer(``
+  construction in ``nice_tpu/`` and ``scripts/`` — an unregistered spawn is
+  an R1 finding, a registered root with no surviving spawn site is stale.
+* :class:`LockSpec` — every ``lockdep.make_lock``/``make_rlock`` label,
+  what it guards, and whether blocking work is legitimate while holding it
+  (the db lock guards sqlite itself; the status-cache lock must never be
+  held across I/O). R3 flags blocking calls under ``may_block_under=False``
+  locks; an undeclared label is a finding.
+* :class:`SharedState` — per-object ownership declarations (lock-guarded,
+  owner-thread-only, immutable-after-init, queue-transferred, or
+  GIL-atomic). R2 verifies write sites against the declaration; R1 flags
+  multi-root mutation of anything UNDECLARED with no common lock.
+
+Keep entries honest: the registry is the audit trail ROADMAP item 2
+(sharded coordination plane) will multiply by N processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "ThreadRoot",
+    "LockSpec",
+    "SharedState",
+    "THREAD_ROOTS",
+    "LOCK_SPECS",
+    "SHARED_STATE",
+    "roots_by_site",
+    "lock_spec",
+    "shared_state_for",
+    "SPAWN_KINDS",
+]
+
+# Call-name suffix -> spawn kind the coverage gate matches on.
+SPAWN_KINDS = {
+    "Thread": "thread",
+    "ThreadPoolExecutor": "pool",
+    "ThreadingHTTPServer": "http-server",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One registered thread root (or pool / loop takeover)."""
+
+    name: str            # runtime thread name, or a symbolic id for pools
+    path: str            # repo-relative file containing the spawn call
+    spawn_scope: str     # qualified function enclosing the spawn call
+    entries: Tuple[str, ...]  # qualnames (in ``path``) the root executes;
+                              # empty = stdlib code only (serve_forever)
+    role: str            # writer-actor | event-loop | worker-pool | producer
+                         # | collector | periodic | probe | http-server | helper
+    kind: str = "thread"  # thread | pool | http-server | loop
+    may_block: bool = True
+    locks: Tuple[str, ...] = ()   # lockdep labels this root may acquire
+    notes: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class LockSpec:
+    label: str
+    guards: str
+    may_block_under: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedState:
+    path: str            # repo-relative file owning the object
+    scope: str           # class name, or "<module>" for module globals
+    attr: str
+    ownership: str       # "lock:<label>" | "owner:<root-name>" |
+                         # "immutable-after-init" | "queue-transferred" |
+                         # "atomic"
+    notes: str = ""
+
+    @property
+    def lock_label(self) -> Optional[str]:
+        if self.ownership.startswith("lock:"):
+            return self.ownership.split(":", 1)[1]
+        return None
+
+    @property
+    def owner_root(self) -> Optional[str]:
+        if self.ownership.startswith("owner:"):
+            return self.ownership.split(":", 1)[1]
+        return None
+
+
+THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
+    # ------------------------------------------------------------- server/
+    ThreadRoot(
+        name="db-writer",
+        path="nice_tpu/server/writer.py",
+        spawn_scope="WriteActor.__init__",
+        entries=("WriteActor._run",),
+        role="writer-actor",
+        locks=("server.db.Db._lock",),
+        notes="single mutator of the ledger; futures resolve only after "
+              "the batch txn commits (accepted => durable)",
+    ),
+    ThreadRoot(
+        name="field-queue-refill",
+        path="nice_tpu/server/field_queue.py",
+        spawn_scope="FieldQueue.__init__",
+        entries=("FieldQueue._refill_loop",),
+        role="producer",
+        locks=("server.field_queue.FieldQueue._lock", "server.db.Db._lock"),
+    ),
+    ThreadRoot(
+        name="async-workers",
+        path="nice_tpu/server/async_core.py",
+        spawn_scope="AsyncHTTPServer.__init__",
+        entries=(),
+        role="worker-pool",
+        kind="pool",
+        notes="run_in_executor offload target; handlers run here, never "
+              "on the selector loop",
+    ),
+    ThreadRoot(
+        name="async-loop",
+        path="nice_tpu/server/async_core.py",
+        spawn_scope="AsyncHTTPServer.serve_forever",
+        entries=("AsyncHTTPServer.serve_forever",),
+        role="event-loop",
+        kind="loop",
+        may_block=False,
+        notes="takes over the calling thread (mark_loop_thread); L1/R3 "
+              "forbid blocking work here",
+    ),
+    # ---------------------------------------------------------------- ops/
+    ThreadRoot(
+        name="engine-collector",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_Collector.__init__",
+        entries=("_Collector._run",),
+        role="collector",
+        notes="runtime thread name is the dynamic collector label",
+    ),
+    ThreadRoot(
+        name="mesh-feed",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_SliceFeed.__init__",
+        entries=("_SliceFeed._fill",),
+        role="producer",
+    ),
+    ThreadRoot(
+        name="niceonly-msd",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_niceonly_pallas",
+        entries=("_niceonly_pallas.<locals>.produce",),
+        role="producer",
+    ),
+    ThreadRoot(
+        name="niceonly-msd-pool",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_niceonly_pallas.<locals>.produce",
+        entries=(),
+        role="worker-pool",
+        kind="pool",
+        notes="scoped with-block pool inside the producer",
+    ),
+    ThreadRoot(
+        name="native-detailed-pool",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_native_detailed",
+        entries=(),
+        role="worker-pool",
+        kind="pool",
+        notes="scoped with-block pool for host-native compute",
+    ),
+    ThreadRoot(
+        name="native-niceonly-pool",
+        path="nice_tpu/ops/engine.py",
+        spawn_scope="_native_niceonly",
+        entries=(),
+        role="worker-pool",
+        kind="pool",
+        notes="scoped with-block pool for host-native compute",
+    ),
+    # ---------------------------------------------------------------- obs/
+    ThreadRoot(
+        name="nice-history",
+        path="nice_tpu/obs/history.py",
+        spawn_scope="maybe_start_sampler",
+        entries=("maybe_start_sampler.<locals>._run",),
+        role="periodic",
+        locks=("obs.history._sampler_lock", "obs.history.HistoryStore._lock"),
+    ),
+    ThreadRoot(
+        name="nice-metrics-httpd",
+        path="nice_tpu/obs/serve.py",
+        spawn_scope="serve_metrics",
+        entries=(),
+        role="http-server",
+        kind="http-server",
+        notes="per-connection handler threads from ThreadingHTTPServer",
+    ),
+    ThreadRoot(
+        name="nice-metrics",
+        path="nice_tpu/obs/serve.py",
+        spawn_scope="serve_metrics",
+        entries=(),
+        role="http-server",
+        locks=("obs.serve._started_lock",),
+        notes="runs stdlib serve_forever",
+    ),
+    ThreadRoot(
+        name="legacy-httpd",
+        path="nice_tpu/server/app.py",
+        spawn_scope="serve",
+        entries=(),
+        role="http-server",
+        kind="http-server",
+        notes="legacy NICE_TPU_SERVER_CORE=thread core; per-connection "
+              "handler threads",
+    ),
+    # ------------------------------------------------------------- client/
+    ThreadRoot(
+        name="nice-api-pool",
+        path="nice_tpu/client/api_client.py",
+        spawn_scope="AsyncApi.__init__",
+        entries=(),
+        role="worker-pool",
+        kind="pool",
+        notes="claim/submit overlap pipeline; futures consumed by the "
+              "client main loop",
+    ),
+    ThreadRoot(
+        name="nice-prefetch",
+        path="nice_tpu/client/main.py",
+        spawn_scope="_prefetch_on_claim.<locals>._cb",
+        entries=("_prefetch_on_claim.<locals>._cb.<locals>._warm_all",),
+        role="helper",
+    ),
+    ThreadRoot(
+        name="telemetry-report",
+        path="nice_tpu/client/main.py",
+        spawn_scope="_TelemetryReporter.__init__",
+        entries=("_TelemetryReporter._run",),
+        role="periodic",
+    ),
+    ThreadRoot(
+        name="claim-renew",
+        path="nice_tpu/client/main.py",
+        spawn_scope="_ClaimRenewer.__init__",
+        entries=("_ClaimRenewer._run",),
+        role="periodic",
+    ),
+    ThreadRoot(
+        name="block-renew",
+        path="nice_tpu/client/main.py",
+        spawn_scope="_BlockRenewer.__init__",
+        entries=("_BlockRenewer._run",),
+        role="periodic",
+    ),
+    # -------------------------------------------------------------- utils/
+    ThreadRoot(
+        name="platform-probe",
+        path="nice_tpu/utils/platform.py",
+        spawn_scope="probe_backend",
+        entries=("probe_backend.<locals>.probe",),
+        role="probe",
+        notes="daemon probe joined with a timeout; may outlive the join",
+    ),
+    # ------------------------------------------------------------ scripts/
+    ThreadRoot(
+        name="crash-resume-httpd",
+        path="scripts/crash_resume_smoke.py",
+        spawn_scope="main",
+        entries=(),
+        role="helper",
+        notes="smoke-test server thread (stdlib serve_forever)",
+    ),
+    ThreadRoot(
+        name="telemetry-smoke-httpd",
+        path="scripts/telemetry_smoke.py",
+        spawn_scope="_fleet_smoke",
+        entries=(),
+        role="helper",
+        notes="smoke-test server thread (stdlib serve_forever)",
+    ),
+    ThreadRoot(
+        name="perf-gate-httpd",
+        path="scripts/perf_gate.py",
+        spawn_scope="run_observatory",
+        entries=(),
+        role="helper",
+        notes="observatory server thread (stdlib serve_forever)",
+    ),
+)
+
+
+LOCK_SPECS: Tuple[LockSpec, ...] = (
+    # may_block_under=True is reserved for locks that exist to serialize a
+    # blocking resource — holding them across I/O is the point, not a bug.
+    LockSpec("server.db.Db._lock", "sqlite connection + ledger txns",
+             may_block_under=True),
+    LockSpec("server.db.Db._pool_lock", "read-connection pool",
+             may_block_under=True),
+    LockSpec("server.app.ApiContext._inflight_lock",
+             "in-flight submission dedup set"),
+    LockSpec("server.app.ApiContext._status_cache_lock",
+             "status-cache dict + generation counter"),
+    LockSpec("server.async_core.TokenBucketLimiter._lock",
+             "token-bucket counters"),
+    LockSpec("server.trust.TrustLedger._lock", "trust score cache"),
+    LockSpec("server.field_queue.FieldQueue._lock",
+             "refill inventory + wanted flag"),
+    LockSpec("ops.adaptive_floor.AdaptiveFloor._lock", "controller state"),
+    LockSpec("ops.adaptive_floor._CONTROLLERS_LOCK",
+             "controller registry dict"),
+    LockSpec("ops.compile_cache._lock", "compiled-fn cache",
+             may_block_under=True),
+    LockSpec("ops.autotune._lock", "autotune measurement cache",
+             may_block_under=True),
+    LockSpec("ops.engine._mesh_cache_lock",
+             "device-tuple -> mesh cache + generation counter"),
+    LockSpec("faults.injector.FaultPlan._lock", "fault plan counters"),
+    LockSpec("faults.injector._plan_lock", "active plan slot"),
+    LockSpec("obs.telemetry._lock", "telemetry buffer"),
+    LockSpec("obs.history.HistoryStore._lock", "history ring",
+             may_block_under=True),
+    LockSpec("obs.history._sampler_lock", "sampler once-guard"),
+    LockSpec("obs.trace._lock", "trace ring"),
+    LockSpec("obs.metrics._Metric._lock", "metric cells"),
+    LockSpec("obs.metrics.Registry._lock", "metric registry"),
+    LockSpec("obs.slo.SloEngine._lock", "SLO windows"),
+    LockSpec("obs.stepprof._state_lock", "stepprof install state"),
+    LockSpec("obs.stepprof.StepProfile._lock", "step ring"),
+    LockSpec("obs.flight.FlightRecorder._lock", "flight ring"),
+    LockSpec("obs.flight._install_lock", "recorder install slot"),
+    LockSpec("obs.anomaly.AnomalyEngine._lock", "anomaly windows"),
+    LockSpec("obs.serve._started_lock", "metrics-server once-guard"),
+    LockSpec("obs.journal._client_lock", "journal client slot",
+             may_block_under=True),
+    LockSpec("parallel.mesh._dead_lock", "dead-device set"),
+    LockSpec("parallel.mesh._step_lock", "step-fn cache"),
+    LockSpec("parallel.mesh._DISPATCH_LOCK", "collective dispatch",
+             may_block_under=True),
+    LockSpec("native._build_lock", "native extension build",
+             may_block_under=True),
+    LockSpec("client.main.progress_cb.lock", "progress line state"),
+)
+
+
+SHARED_STATE: Tuple[SharedState, ...] = (
+    # server/app.py — the status cache is the canonical R5 subject: reads
+    # and the generation check are under the lock, the build is not.
+    SharedState("nice_tpu/server/app.py", "ApiContext", "_status_cache",
+                "lock:server.app.ApiContext._status_cache_lock"),
+    SharedState("nice_tpu/server/app.py", "ApiContext", "_status_cache_gen",
+                "lock:server.app.ApiContext._status_cache_lock",
+                notes="invalidation generation; bumped on every invalidate "
+                      "so a stale rebuild cannot store over it"),
+    SharedState("nice_tpu/server/app.py", "ApiContext", "_inflight",
+                "lock:server.app.ApiContext._inflight_lock"),
+    # server/writer.py — ownership by construction: the queue transfers
+    # batches into the writer thread, which alone resolves futures.
+    SharedState("nice_tpu/server/writer.py", "WriteActor", "_q",
+                "queue-transferred"),
+    SharedState("nice_tpu/server/writer.py", "WriteActor", "_closed",
+                "atomic",
+                notes="single bool flip read by submitters, set on close"),
+    SharedState("nice_tpu/server/writer.py", "WriteActor", "_periodics",
+                "owner:db-writer",
+                notes="periodic schedule registered before start, then "
+                      "driven only by the writer loop"),
+    # server/trust.py — the peek_known pattern: cache reads and writes both
+    # under the ledger lock.
+    SharedState("nice_tpu/server/trust.py", "TrustLedger", "_cache",
+                "lock:server.trust.TrustLedger._lock"),
+    # server/field_queue.py
+    SharedState("nice_tpu/server/field_queue.py", "FieldQueue", "_niceonly",
+                "lock:server.field_queue.FieldQueue._lock"),
+    SharedState("nice_tpu/server/field_queue.py", "FieldQueue",
+                "_detailed_thin",
+                "lock:server.field_queue.FieldQueue._lock"),
+    # ops/engine.py — the mesh cache rebuilt on elastic downshift.
+    SharedState("nice_tpu/ops/engine.py", "<module>", "_MESH_CACHE",
+                "lock:ops.engine._mesh_cache_lock"),
+    SharedState("nice_tpu/ops/engine.py", "<module>", "_MESH_CACHE_GEN",
+                "lock:ops.engine._mesh_cache_lock",
+                notes="downshift generation; a rebuild that started before "
+                      "an invalidation must not repopulate the cache"),
+    # parallel/mesh.py
+    SharedState("nice_tpu/parallel/mesh.py", "<module>", "_STEP_CACHE",
+                "lock:parallel.mesh._step_lock"),
+    # obs/history.py
+    SharedState("nice_tpu/obs/history.py", "<module>", "_sampler_started",
+                "lock:obs.history._sampler_lock"),
+)
+
+
+def roots_by_site() -> Dict[Tuple[str, str, str], Tuple[ThreadRoot, ...]]:
+    """(path, spawn_scope, kind) -> registered roots at that site."""
+    out: Dict[Tuple[str, str, str], list] = {}
+    for root in THREAD_ROOTS:
+        out.setdefault((root.path, root.spawn_scope, root.kind),
+                       []).append(root)
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def lock_spec(label: str) -> Optional[LockSpec]:
+    for spec in LOCK_SPECS:
+        if spec.label == label:
+            return spec
+    return None
+
+
+def shared_state_for(path: str, scope: str,
+                     attr: str) -> Optional[SharedState]:
+    for decl in SHARED_STATE:
+        if decl.path == path and decl.scope == scope and decl.attr == attr:
+            return decl
+    return None

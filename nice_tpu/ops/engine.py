@@ -336,14 +336,43 @@ def _run_with_fallback(impl, range_, base, backend, kwargs) -> FieldResults:
         return results
 
 
-import functools
+from nice_tpu.utils import lockdep
+
+# Device-tuple -> mesh cache. Was a functools.lru_cache, but an lru cache's
+# clear/rebuild window cannot be guarded: a dispatch thread entering
+# _cached_mesh between a downshift's cache_clear() and its rebuild could
+# repopulate the cache with a mesh over dead devices (racelint R5; replayed
+# by the schedex mesh_cache_clear_vs_rebuild scenario). Explicit dict +
+# lock + generation instead: reads and the generation check are under the
+# lock, make_mesh runs outside it, and a store only lands if no
+# invalidation happened mid-build.
+_MESH_CACHE: dict = {}
+_MESH_CACHE_GEN = 0
+_mesh_cache_lock = lockdep.make_lock("ops.engine._mesh_cache_lock")
 
 
-@functools.lru_cache(maxsize=None)
 def _cached_mesh(devs: tuple):
     from nice_tpu.parallel import mesh as pmesh
 
-    return pmesh.make_mesh(list(devs))
+    with _mesh_cache_lock:
+        mesh = _MESH_CACHE.get(devs)
+        gen = _MESH_CACHE_GEN
+    if mesh is not None:
+        return mesh
+    built = pmesh.make_mesh(list(devs))
+    with _mesh_cache_lock:
+        if _MESH_CACHE_GEN == gen:
+            return _MESH_CACHE.setdefault(devs, built)
+    # Invalidated while building: serve the mesh without caching it, so a
+    # stale device tuple can never outlive the downshift that killed it.
+    return built
+
+
+def _invalidate_mesh_cache() -> None:
+    global _MESH_CACHE_GEN
+    with _mesh_cache_lock:
+        _MESH_CACHE_GEN += 1
+        _MESH_CACHE.clear()
 
 
 def _mesh_or_none():
@@ -2172,7 +2201,7 @@ def _process_range_detailed(
                 collector.put(("stats_host", folded))
                 since_flush = 0
                 pmesh.clear_step_cache(pmesh.mesh_device_ids(mesh))
-                _cached_mesh.cache_clear()
+                _invalidate_mesh_cache()
                 mesh = _cached_mesh(tuple(survivors))
                 prev_n = n_dev
                 n_dev = len(survivors)
@@ -2712,7 +2741,7 @@ def _process_range_niceonly(
                 # the mesh over the survivors and re-slice the remainder.
                 t_r0 = time.monotonic()
                 pmesh.clear_step_cache(pmesh.mesh_device_ids(mesh))
-                _cached_mesh.cache_clear()
+                _invalidate_mesh_cache()
                 mesh = _cached_mesh(tuple(survivors))
                 prev_n = n_dev
                 n_dev = len(survivors)

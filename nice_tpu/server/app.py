@@ -208,6 +208,11 @@ class ApiContext:
         # data after their own write.
         self.status_cache_ttl = knobs.STATUS_CACHE_SECS.get()
         self._status_cache: dict = {}
+        # Invalidation generation: bumped under the lock on every
+        # invalidate so a rebuild that started before the invalidation
+        # cannot store its stale block back (racelint R5; replayed by the
+        # schedex status_cache_invalidate_vs_rebuild scenario).
+        self._status_cache_gen = 0
         self._status_cache_lock = lockdep.make_lock("server.app.ApiContext._status_cache_lock")
         # Performance observatory: one writer-actor periodic samples every
         # nice_* series (process-global registry + this context's private
@@ -318,14 +323,22 @@ class ApiContext:
             if entry is not None and now - entry[0] < self.status_cache_ttl:
                 SERVER_STATUS_CACHE_EVENTS.labels("hit").inc()
                 return entry[1]
+            gen = self._status_cache_gen
         SERVER_STATUS_CACHE_EVENTS.labels("miss").inc()
         block = build_fleet_block(self)
         with self._status_cache_lock:
-            self._status_cache["fleet"] = (time.monotonic(), block)
+            # Store only if no invalidation landed while we built outside
+            # the lock — otherwise a write that invalidated mid-build
+            # would be masked by this stale block for a full TTL,
+            # breaking the "never see stale data after your own write"
+            # contract documented on _status_cache.
+            if self._status_cache_gen == gen:  # nicelint: allow R5 (generation-checked store; schedex scenario status_cache_invalidate_vs_rebuild replays the window)
+                self._status_cache["fleet"] = (time.monotonic(), block)
         return block
 
     def invalidate_status_cache(self) -> None:
         with self._status_cache_lock:
+            self._status_cache_gen += 1
             self._status_cache.pop("fleet", None)
 
     def enter_request(self) -> bool:

@@ -627,6 +627,46 @@ JAXLINT_MAX_VARIANTS = _k(
     " trace sweep before declaring the recompile surface unbounded.",
     owner="scripts/jaxlint.py", group="analysis",
 )
+RACELINT_RULES = _k(
+    "NICE_TPU_RACELINT_RULES", "str", None,
+    "Comma-separated R-rule subset racelint runs (unset = all).",
+    owner="scripts/racelint.py", group="analysis",
+    default_doc="all rules",
+)
+SCHEDEX = _k(
+    "NICE_TPU_SCHEDEX", "bool", False,
+    "Deterministic interleaving explorer: allow schedex to install its"
+    " instrumented lock/queue/future wrappers. Off means no wrapper is"
+    " ever installed — lockdep.make_lock stays on its zero-overhead path"
+    " (asserted by test, same discipline as stepprof's no-sync"
+    " guarantee).",
+    owner="analysis/schedex.py", group="analysis",
+)
+SCHEDEX_SEEDS = _k(
+    "NICE_TPU_SCHEDEX_SEEDS", "int", 8,
+    "Number of seeded random schedules the explorer runs per scenario on"
+    " top of the systematic preemption-bounded set.",
+    owner="analysis/schedex.py", group="analysis",
+)
+SCHEDEX_PREEMPTIONS = _k(
+    "NICE_TPU_SCHEDEX_PREEMPTIONS", "int", 2,
+    "Preemption bound k for the systematic schedule enumeration (DPOR-"
+    "lite): every schedule with at most k forced preemptions is explored"
+    " up to the schedule cap.",
+    owner="analysis/schedex.py", group="analysis",
+)
+SCHEDEX_MAX_SCHEDULES = _k(
+    "NICE_TPU_SCHEDEX_MAX_SCHEDULES", "int", 256,
+    "Cap on systematic schedules per scenario; past it the preemption-"
+    "point pairs are stride-sampled deterministically.",
+    owner="analysis/schedex.py", group="analysis",
+)
+SCHEDEX_TIMEOUT_SECS = _k(
+    "NICE_TPU_SCHEDEX_TIMEOUT_SECS", "float", 30.0,
+    "Watchdog timeout for one scheduled scenario run; a hang (against"
+    " schedex's blocked-predicate design) fails the run rather than CI.",
+    owner="analysis/schedex.py", group="analysis",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,9 @@ __all__ = [
     "violation_count",
     "order_edges",
     "reset",
+    "set_factory_hook",
+    "factory_hook",
+    "dump_graph",
 ]
 
 
@@ -220,16 +223,38 @@ class _DepLock:
         return f"<DepLock {self._name} wrapping {self._lock!r}>"
 
 
+# Factory hook: schedex (analysis/schedex.py) swaps project locks for its
+# deterministically scheduled wrappers DURING an instrument() window. None
+# on the production path — make_lock's only added cost is this one global
+# load, so NICE_TPU_SCHEDEX=0 installs nothing (asserted by test, same
+# discipline as stepprof's no-sync guarantee).
+_factory_hook = None
+
+
+def set_factory_hook(hook) -> None:
+    """Install (or clear, with None) the schedex lock factory hook."""
+    global _factory_hook
+    _factory_hook = hook
+
+
+def factory_hook():
+    return _factory_hook
+
+
 def make_lock(name: str):
     """A threading.Lock, instrumented when NICE_TPU_LOCKDEP is on. ``name``
     labels the lock in the order graph; use a stable dotted id matching the
     attribute path (e.g. "server.db.Db._lock") so runtime reports line up
     with the static X1 graph."""
+    if _factory_hook is not None:
+        return _factory_hook(name, "lock")
     return _DepLock(name, threading.Lock()) if enabled() else threading.Lock()
 
 
 def make_rlock(name: str):
     """A threading.RLock, instrumented when NICE_TPU_LOCKDEP is on."""
+    if _factory_hook is not None:
+        return _factory_hook(name, "rlock")
     return (
         _DepLock(name, threading.RLock()) if enabled() else threading.RLock()
     )
@@ -258,3 +283,123 @@ def reset() -> None:
         _edge_sites.clear()
         _violations.clear()
         _loop_thread_ids.clear()
+
+
+def dump_graph(path: str, merge: bool = True) -> dict:
+    """Write the observed name-level order graph as JSON (the artifact
+    racelint R2 cross-checks against the static X1 graph).
+
+    ``merge=True`` unions with an existing file so regenerating from a
+    partial exercise never FORGETS an edge another run observed — the
+    graph only grows, matching the ratchet discipline. Returns the edge
+    dict that was written."""
+    import json
+    import os
+
+    edges = {k: sorted(v) for k, v in order_edges().items()}
+    if merge and os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                old = json.load(f).get("edges", {})
+        except (OSError, ValueError):
+            old = {}
+        for outer, inners in old.items():
+            edges[outer] = sorted(set(edges.get(outer, [])) | set(inners))
+    payload = {
+        "comment": "observed lockdep acquisition-order graph; regenerate "
+                   "with `python -m nice_tpu.utils.lockdep --dump-graph "
+                   "docs/lockorder.json` (merges, never forgets edges)",
+        "edges": dict(sorted(edges.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:  # nicelint: allow A1 (dev-only analysis artifact, not crash-safety state)
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return edges
+
+
+def _exercise() -> List[str]:
+    """Drive representative coordination-plane flows in-process so the
+    order graph has real edges to dump: server context construction, field
+    queue refills, status-cache read/invalidate, lease sweep, history
+    tick, and the engine mesh-cache invalidation. Each step is best-effort
+    — a missing optional dep skips the step, never the dump."""
+    import tempfile
+
+    ran: List[str] = []
+
+    def step(name, fn):
+        try:
+            fn()
+            ran.append(name)
+        except Exception as e:  # pragma: no cover - environment-dependent
+            ran.append(f"{name}:SKIPPED({type(e).__name__})")
+
+    ctx_box = {}
+
+    def _build():
+        from nice_tpu.server.app import ApiContext
+        from nice_tpu.server.db import Db
+
+        tmp = tempfile.mkdtemp(prefix="lockdep-exercise-")
+        ctx_box["ctx"] = ApiContext(Db(f"{tmp}/exercise.db"))
+
+    step("api-context", _build)
+    ctx = ctx_box.get("ctx")
+    if ctx is not None:
+        step("refill", lambda: (ctx.queue.refill_niceonly(),
+                                ctx.queue.refill_detailed_thin()))
+        step("status-cache", lambda: (ctx.cached_fleet_block(),
+                                      ctx.invalidate_status_cache(),
+                                      ctx.cached_fleet_block()))
+        step("inflight", lambda: (ctx.enter_request(), ctx.exit_request()))
+        step("lease-sweep", lambda: ctx._sweep_leases())
+        step("history-tick", lambda: ctx.history_tick())
+        step("writer-roundtrip",
+             lambda: ctx.writer.call(lambda: None))
+        step("close", lambda: (ctx.close(), ctx.db.close()))
+    step("mesh-cache", lambda: __import__(
+        "nice_tpu.ops.engine", fromlist=["engine"]
+    )._invalidate_mesh_cache())
+    return ran
+
+
+def _main(argv=None) -> int:  # pragma: no cover - exercised via CLI tests
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(
+        description="lockdep runtime: exercise coordination flows and "
+                    "dump the observed lock-order graph")
+    ap.add_argument("--dump-graph", metavar="PATH", required=True,
+                    help="write the order graph JSON here "
+                         "(docs/lockorder.json in CI)")
+    ap.add_argument("--no-merge", action="store_true",
+                    help="overwrite instead of unioning with the existing "
+                         "file")
+    ap.add_argument("--no-exercise", action="store_true",
+                    help="dump only what this process already observed")
+    args = ap.parse_args(argv)
+
+    os.environ["NICE_TPU_LOCKDEP"] = "1"
+    if not args.no_exercise:
+        ran = _exercise()
+        print("lockdep: exercised " + ", ".join(ran))
+    edges = dump_graph(args.dump_graph, merge=not args.no_merge)
+    n = sum(len(v) for v in edges.values())
+    print(f"lockdep: wrote {len(edges)} nodes / {n} edges "
+          f"to {args.dump_graph}")
+    for v in violations():
+        print(f"lockdep: VIOLATION {v}")
+    return 1 if violations() else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    # Under `python -m` this file runs as the __main__ module, a SECOND
+    # instance separate from the `nice_tpu.utils.lockdep` every project
+    # lock records into — dispatch to the canonical instance or the dump
+    # reads an empty graph.
+    from nice_tpu.utils import lockdep as _canonical
+
+    sys.exit(_canonical._main())

@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""racelint CLI — thread-ownership race analysis for nice_tpu.
+
+Checks the whole tree against the declared threading contract in
+``nice_tpu/analysis/threadspec.py`` (ThreadRegistry + LockSpecs +
+SHARED_STATE ownership): spawn-site coverage, multi-root unguarded
+mutation, lock discipline with a static/runtime order cross-check,
+blocking-under-lock, writer-actor discipline, and check-then-act
+atomicity. Shares nicelint's ratchet baseline and escape grammar.
+
+Usage:
+    python scripts/racelint.py                  # report vs ratchet baseline
+    python scripts/racelint.py --strict         # CI gate: also fail stale
+    python scripts/racelint.py --update-baseline
+    python scripts/racelint.py --json out.json  # archive the full report
+    python scripts/racelint.py --rules R1,R5    # run a subset
+    python scripts/racelint.py --list-roots     # dump the ThreadRegistry
+
+Exit codes: 0 clean, 1 new violations (or stale entries under --strict),
+2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from nice_tpu.analysis import core, threadspec  # noqa: E402
+from nice_tpu.analysis import racerules  # noqa: E402
+from nice_tpu.analysis.racerules import context as racectx  # noqa: E402
+from nice_tpu.utils import knobs  # noqa: E402
+
+FAMILY = ("R1", "R2", "R3", "R4", "R5", core.DEAD_SUPPRESSION_RULE)
+
+
+def _list_roots() -> int:
+    for root in threadspec.THREAD_ROOTS:
+        locks = f" locks={','.join(root.locks)}" if root.locks else ""
+        print(f"{root.name:24s} {root.kind:11s} {root.role:12s} "
+              f"{root.path}:{root.spawn_scope}"
+              f"{'' if root.may_block else ' no-block'}{locks}")
+    print(f"{len(threadspec.THREAD_ROOTS)} roots, "
+          f"{len(threadspec.LOCK_SPECS)} lock specs, "
+          f"{len(threadspec.SHARED_STATE)} shared-state declarations")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on stale baseline entries too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite this family's slice of the shared "
+                         "baseline")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full report as JSON")
+    ap.add_argument("--rules", metavar="IDS",
+                    default=knobs.RACELINT_RULES.get(),
+                    help="comma-separated R-rule subset (e.g. R1,R5)")
+    ap.add_argument("--lockorder", metavar="PATH",
+                    help="runtime lock-order graph for the R2 cross-check "
+                         "(default docs/lockorder.json)")
+    ap.add_argument("--list-roots", action="store_true",
+                    help="dump the ThreadRegistry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_roots:
+        return _list_roots()
+
+    root = os.path.abspath(args.root)
+    project = core.Project(root)
+    ctx = racectx.build_context(root, project,
+                                lockorder_path=args.lockorder)
+    print(f"racelint: {ctx.report['spawn_sites']} spawn sites, "
+          f"{len(threadspec.THREAD_ROOTS)} registered roots, "
+          f"{ctx.report['lock_labels']} locks, "
+          f"{ctx.report['shared_write_identities']} write identities, "
+          f"runtime edges {ctx.report['runtime_edges']}")
+
+    only = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    violations, used = racerules.run_race_rules(project, ctx, only=only)
+
+    if only is None:
+        # the dead-suppression audit (S1) needs every R-rule's usage data,
+        # so it only runs on full (non --rules) invocations
+        rrule_ids = {r for r in FAMILY if r != core.DEAD_SUPPRESSION_RULE}
+        dead, _ = core.filter_allowed(
+            project, core.dead_suppressions(project, rrule_ids, used))
+        violations = sorted(
+            violations + dead,
+            key=lambda v: (v.path, v.line, v.rule, v.detail))
+
+    baseline = core.filter_baseline(core.load_baseline(root), FAMILY)
+    if only:
+        baseline = core.filter_baseline(baseline, set(only))
+    new, stale = core.diff_against_baseline(violations, baseline)
+
+    if args.update_baseline:
+        old = core.load_baseline(root)
+        # preserve the other families' keys — the baseline file is shared
+        entries = {k: v for k, v in old.items()
+                   if k not in core.filter_baseline(old, FAMILY)}
+        for v in violations:
+            entries[v.key] = old.get(v.key, "TODO: justify or fix")
+        core.save_baseline(root, entries)
+        print(f"racelint: baseline rewritten ({len(new)} new, "
+              f"{len(stale)} removed; other families preserved)")
+        return 0
+
+    if args.json:
+        report = {
+            "violations": [v.to_json() for v in violations],
+            "new": [v.to_json() for v in new],
+            "stale_baseline_keys": stale,
+            "baselined": len(violations) - len(new),
+            "registry": {
+                "roots": len(threadspec.THREAD_ROOTS),
+                "lock_specs": len(threadspec.LOCK_SPECS),
+                "shared_state": len(threadspec.SHARED_STATE),
+            },
+            "context": ctx.report,
+        }
+        with open(args.json, "w", encoding="utf-8") as f:  # nicelint: allow A1 (CI artifact, not state)
+            json.dump(report, f, indent=1, default=str)
+            f.write("\n")
+
+    for v in new:
+        print(f"{v.path}:{v.line}: {v.rule}: {v.message}")
+    if stale:
+        print(f"racelint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed violations "
+              "still listed — run --update-baseline to burn them down):")
+        for key in stale:
+            print(f"  stale: {key}")
+
+    baselined = len(violations) - len(new)
+    print(f"racelint: {len(new)} new, {baselined} baselined, "
+          f"{len(stale)} stale")
+    if new:
+        return 1
+    if args.strict and stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,471 @@
+"""racelint + schedex tests: the coverage gate must catch unregistered and
+stale thread roots, every R-rule has a good/bad fixture pair (the seeded
+race shape must be caught; the disciplined version must pass), the
+interleaving explorer reproduces a known-racy fixture within the k<=2
+preemption bound and replays it byte-for-byte from its schedule id, and
+the schedex-off production path provably installs no wrapper."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from nice_tpu.analysis import core, schedex, threadspec  # noqa: E402
+from nice_tpu.analysis import scenarios as scen_mod  # noqa: E402
+from nice_tpu.analysis.racerules import context, run_race_rules  # noqa: E402
+from nice_tpu.utils import lockdep  # noqa: E402
+
+
+def project(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content), encoding="utf-8")
+    return core.Project(str(tmp_path))
+
+
+def race_run(tmp_path, files, rule, monkeypatch,
+             roots=(), locks=(), shared=(), lockorder=None):
+    """Run one R-rule over a fixture project with a synthetic registry."""
+    proj = project(tmp_path, files)
+    monkeypatch.setattr(threadspec, "THREAD_ROOTS", tuple(roots))
+    monkeypatch.setattr(threadspec, "LOCK_SPECS", tuple(locks))
+    monkeypatch.setattr(threadspec, "SHARED_STATE", tuple(shared))
+    ctx = context.build_context(
+        str(tmp_path), proj,
+        lockorder_path=lockorder or str(tmp_path / "no-lockorder.json"))
+    vs, _used = run_race_rules(proj, ctx, only=[rule])
+    return vs
+
+
+def details(vs):
+    return [v.detail for v in vs]
+
+
+PUMP_ROOTS = (
+    threadspec.ThreadRoot(
+        name="pump-run", path="nice_tpu/pump.py",
+        spawn_scope="Pump.__init__", entries=("Pump._run",), role="helper"),
+    threadspec.ThreadRoot(
+        name="pump-poke", path="nice_tpu/pump.py",
+        spawn_scope="Pump.__init__", entries=("Pump.poke",), role="helper"),
+)
+
+PUMP_BAD = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run)
+            self._u = threading.Thread(target=self.poke)
+
+        def _run(self):
+            self._count = 1
+
+        def poke(self):
+            self._count = 2
+"""
+
+PUMP_GOOD = """
+    import threading
+    from nice_tpu.utils import lockdep
+
+    class Pump:
+        def __init__(self):
+            self._lock = lockdep.make_lock("test.pump")
+            self._t = threading.Thread(target=self._run)
+            self._u = threading.Thread(target=self.poke)
+
+        def _run(self):
+            with self._lock:
+                self._count = 1
+
+        def poke(self):
+            with self._lock:
+                self._count = 2
+"""
+
+
+# ---------------------------------------------------------------------------
+# R1: coverage gate + multi-root unguarded mutation
+
+
+def test_r1_unregistered_spawn_is_caught(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/foo.py": """
+            import threading
+
+            def boot():
+                threading.Thread(target=print).start()
+        """,
+    }, "R1", monkeypatch)
+    assert "unregistered-thread:boot" in details(vs)
+
+
+def test_r1_stale_root_is_caught(tmp_path, monkeypatch):
+    vs = race_run(
+        tmp_path, {"nice_tpu/foo.py": "def f():\n    pass\n"},
+        "R1", monkeypatch,
+        roots=(threadspec.ThreadRoot(
+            name="ghost", path="nice_tpu/foo.py", spawn_scope="gone",
+            entries=(), role="helper"),))
+    assert "stale-root:ghost" in details(vs)
+
+
+def test_r1_multi_root_unguarded_write_caught(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {"nice_tpu/pump.py": PUMP_BAD},
+                  "R1", monkeypatch, roots=PUMP_ROOTS)
+    assert "shared:Pump._count" in details(vs)
+
+
+def test_r1_common_lock_or_declaration_is_clean(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {"nice_tpu/pump.py": PUMP_GOOD},
+                  "R1", monkeypatch, roots=PUMP_ROOTS)
+    assert not [d for d in details(vs) if d.startswith("shared:")]
+    # an ownership declaration routes it to R2 instead of R1
+    vs = race_run(
+        tmp_path, {"nice_tpu/pump.py": PUMP_BAD}, "R1", monkeypatch,
+        roots=PUMP_ROOTS,
+        shared=(threadspec.SharedState(
+            path="nice_tpu/pump.py", scope="Pump", attr="_count",
+            ownership="owner:pump-run"),))
+    assert not [d for d in details(vs) if d.startswith("shared:")]
+
+
+# ---------------------------------------------------------------------------
+# R2: declared ownership discipline + lock inventory + order cross-check
+
+
+def test_r2_unlocked_write_of_declared_state(tmp_path, monkeypatch):
+    decl = threadspec.SharedState(
+        path="nice_tpu/pump.py", scope="Pump", attr="_count",
+        ownership="lock:test.pump")
+    vs = race_run(tmp_path, {"nice_tpu/pump.py": PUMP_BAD},
+                  "R2", monkeypatch, roots=PUMP_ROOTS, shared=(decl,))
+    assert any(d.startswith("unlocked:Pump._count") for d in details(vs))
+    vs = race_run(
+        tmp_path, {"nice_tpu/pump.py": PUMP_GOOD}, "R2", monkeypatch,
+        roots=PUMP_ROOTS, shared=(decl,),
+        locks=(threadspec.LockSpec("test.pump", guards="fixture"),))
+    assert not [d for d in details(vs) if d.startswith("unlocked:")]
+
+
+def test_r2_owner_and_immutable_declarations(tmp_path, monkeypatch):
+    owner = threadspec.SharedState(
+        path="nice_tpu/pump.py", scope="Pump", attr="_count",
+        ownership="owner:pump-run")
+    vs = race_run(tmp_path, {"nice_tpu/pump.py": PUMP_BAD},
+                  "R2", monkeypatch, roots=PUMP_ROOTS, shared=(owner,))
+    # poke() is reachable from pump-poke, a foreign root for owner state
+    assert any(d.startswith("foreign-write:Pump._count") for d in details(vs))
+    frozen = threadspec.SharedState(
+        path="nice_tpu/pump.py", scope="Pump", attr="_count",
+        ownership="immutable-after-init")
+    vs = race_run(tmp_path, {"nice_tpu/pump.py": PUMP_BAD},
+                  "R2", monkeypatch, roots=PUMP_ROOTS, shared=(frozen,))
+    assert any(d.startswith("mutated-immutable:") for d in details(vs))
+
+
+def test_r2_lock_inventory_and_missing_lockorder(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/x.py": """
+            from nice_tpu.utils import lockdep
+            _L = lockdep.make_lock("t.mystery")
+        """,
+    }, "R2", monkeypatch,
+        locks=(threadspec.LockSpec("t.gone", guards="nothing"),))
+    ds = details(vs)
+    assert "undeclared-lock:t.mystery" in ds
+    assert "stale-lock:t.gone" in ds
+    assert "missing-lockorder" in ds
+
+
+def test_r2_static_runtime_order_divergence(tmp_path, monkeypatch):
+    lockorder = tmp_path / "lockorder.json"
+    lockorder.write_text(json.dumps({"edges": {"t.B": ["t.A"]}}))
+    vs = race_run(tmp_path, {
+        "nice_tpu/locks.py": """
+            from nice_tpu.utils import lockdep
+            A = lockdep.make_lock("t.A")
+            B = lockdep.make_lock("t.B")
+
+            def fwd():
+                with A:
+                    with B:
+                        pass
+        """,
+    }, "R2", monkeypatch,
+        locks=(threadspec.LockSpec("t.A", guards="a"),
+               threadspec.LockSpec("t.B", guards="b")),
+        lockorder=str(lockorder))
+    # static says A->B, runtime observed B->A: jointly a deadlock
+    assert any(d.startswith("order-divergence:") for d in details(vs))
+
+
+# ---------------------------------------------------------------------------
+# R3: blocking where blocking is forbidden
+
+
+def test_r3_blocking_reachable_from_noblock_root(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/foo.py": """
+            import threading
+            import time
+
+            def boot():
+                threading.Thread(target=work).start()
+
+            def work():
+                time.sleep(1)
+        """,
+    }, "R3", monkeypatch,
+        roots=(threadspec.ThreadRoot(
+            name="no-sleeper", path="nice_tpu/foo.py", spawn_scope="boot",
+            entries=("work",), role="helper", may_block=False),))
+    assert any(d.startswith("noblock:no-sleeper:") for d in details(vs))
+
+
+def test_r3_blocking_under_noblock_lock(tmp_path, monkeypatch):
+    files = {
+        "nice_tpu/foo.py": """
+            import time
+            from nice_tpu.utils import lockdep
+            _L = lockdep.make_lock("t.cachelock")
+
+            def f():
+                with _L:
+                    time.sleep(1)
+        """,
+    }
+    vs = race_run(tmp_path, files, "R3", monkeypatch,
+                  locks=(threadspec.LockSpec("t.cachelock", guards="c"),))
+    assert "block-under:t.cachelock:time.sleep" in details(vs)
+    # a lock declared as serializing a blocking resource is exempt
+    vs = race_run(tmp_path, files, "R3", monkeypatch,
+                  locks=(threadspec.LockSpec(
+                      "t.cachelock", guards="c", may_block_under=True),))
+    assert not details(vs)
+
+
+# ---------------------------------------------------------------------------
+# R4: writer-actor discipline
+
+
+def test_r4_resolve_outside_writer_and_inside_txn(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/handlers.py": """
+            def f(fut):
+                fut.set_result(1)
+        """,
+        "nice_tpu/server/writer.py": """
+            class W:
+                def _txn(self):
+                    pass
+
+                def run(self, fut):
+                    with self._txn():
+                        fut.set_result(1)
+
+                def ok(self, fut):
+                    with self._txn():
+                        pass
+                    fut.set_result(2)
+        """,
+    }, "R4", monkeypatch)
+    ds = details(vs)
+    assert "resolve-outside-writer:f" in ds
+    assert "resolve-inside-txn:W.run" in ds
+    assert not any("W.ok" in d for d in ds)
+
+
+# ---------------------------------------------------------------------------
+# R5: check-then-act atomicity
+
+
+CACHE_BAD = """
+    from nice_tpu.utils import lockdep
+
+    class Cache:
+        def __init__(self):
+            self._lock = lockdep.make_lock("t.cache")
+            self._d = {}
+
+        def get_or_build(self, k):
+            with self._lock:
+                v = self._d.get(k)
+            if v is not None:
+                return v
+            v = object()
+            with self._lock:
+                self._d[k] = v
+            return v
+"""
+
+
+def test_r5_check_then_act_caught(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {"nice_tpu/cache.py": CACHE_BAD},
+                  "R5", monkeypatch)
+    assert "check-then-act:get_or_build:self._d" in details(vs)
+
+
+def test_r5_setdefault_and_allow_are_sanctioned(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/cache.py": CACHE_BAD.replace(
+            "self._d[k] = v",
+            "v = self._d.setdefault(k, v)"),
+    }, "R5", monkeypatch)
+    assert not details(vs)
+    vs = race_run(tmp_path, {
+        "nice_tpu/cache.py": CACHE_BAD.replace(
+            "self._d[k] = v",
+            "self._d[k] = v  # nicelint: allow R5 (fixture)"),
+    }, "R5", monkeypatch)
+    assert not details(vs)
+
+
+def test_r5_lru_cache_clear_caught(tmp_path, monkeypatch):
+    vs = race_run(tmp_path, {
+        "nice_tpu/cache.py": """
+            import functools
+
+            @functools.lru_cache
+            def build(x):
+                return x
+
+            def reset():
+                build.cache_clear()
+        """,
+    }, "R5", monkeypatch)
+    assert "lru-clear:build" in details(vs)
+
+
+# ---------------------------------------------------------------------------
+# schedex: determinism, bounded exploration, zero-cost off
+
+
+def test_schedex_catches_racy_counter_within_bound():
+    report = schedex.explore(scen_mod.RacyCounter,
+                             seeds=0, preemptions=1, max_schedules=32)
+    assert not report.ok
+    first = report.first_failing()
+    # caught by a single forced preemption, k=1
+    assert first.schedule_id.startswith("pre:")
+
+
+def test_schedex_replay_is_byte_for_byte():
+    report = schedex.explore(scen_mod.RacyCounter,
+                             seeds=2, preemptions=1, max_schedules=32,
+                             stop_on_failure=True)
+    first = report.first_failing()
+    a = schedex.replay(scen_mod.RacyCounter, first.schedule_id)
+    b = schedex.replay(scen_mod.RacyCounter, first.schedule_id)
+    assert a.trace == first.trace == b.trace
+    assert not a.ok and not b.ok
+
+
+def test_schedex_random_seed_is_deterministic():
+    a = schedex.run_schedule(scen_mod.RacyCounter, schedex.RandomPolicy(7))
+    b = schedex.run_schedule(scen_mod.RacyCounter, schedex.RandomPolicy(7))
+    c = schedex.run_schedule(scen_mod.RacyCounter, schedex.RandomPolicy(8))
+    assert a.trace == b.trace and a.ok == b.ok
+    assert c.schedule_id != a.schedule_id
+
+
+def test_schedex_deadlock_is_detected():
+    class Deadlock(scen_mod.Scenario):
+        scenario_name = "deadlock_fixture"
+
+        def build(self, sched):
+            la = schedex.Lock(sched, "t.a")
+            lb = schedex.Lock(sched, "t.b")
+
+            def one():
+                with la:
+                    sched.yield_point("one:mid")
+                    with lb:
+                        pass
+
+            def two():
+                with lb:
+                    sched.yield_point("two:mid")
+                    with la:
+                        pass
+
+            return [("one", one), ("two", two)]
+
+    res = schedex.run_schedule(Deadlock, schedex.PreemptPolicy((1,)))
+    assert not res.ok
+    assert any("deadlock" in f.lower() for f in res.failures)
+
+
+def test_status_cache_fix_holds_and_prefix_twin_is_caught():
+    good = schedex.explore(scen_mod.StatusCacheInvalidateVsRebuild,
+                           seeds=4, preemptions=2, max_schedules=64)
+    assert good.ok, [f.failures for f in good.failing]
+    bad = schedex.explore(scen_mod.StatusCachePreFix,
+                          seeds=4, preemptions=2, max_schedules=64,
+                          stop_on_failure=True)
+    assert not bad.ok
+
+
+def test_lease_sweep_fix_holds_and_prefix_twin_is_caught():
+    good = schedex.explore(scen_mod.LeaseSweepVsSubmit,
+                           seeds=4, preemptions=2, max_schedules=64)
+    assert good.ok, [f.failures for f in good.failing]
+    bad = schedex.explore(scen_mod.LeaseSweepPreFix,
+                          seeds=4, preemptions=2, max_schedules=64,
+                          stop_on_failure=True)
+    assert not bad.ok
+
+
+def test_schedex_off_is_zero_cost(monkeypatch):
+    # The production path with NICE_TPU_SCHEDEX off: no factory hook, and
+    # make_lock (lockdep disabled) returns a plain threading primitive.
+    monkeypatch.delenv("NICE_TPU_LOCKDEP", raising=False)
+    monkeypatch.delenv("NICE_TPU_SCHEDEX", raising=False)
+    assert lockdep.factory_hook() is None
+    lock = lockdep.make_lock("zero.cost.fixture")
+    assert type(lock) is type(threading.Lock())
+
+
+def test_instrument_window_installs_and_restores_hook():
+    sched = schedex.Scheduler(schedex.FIFOPolicy())
+    assert lockdep.factory_hook() is None
+    with schedex.instrument(sched):
+        minted = lockdep.make_lock("windowed.fixture")
+        assert isinstance(minted, schedex.Lock)
+        rm = lockdep.make_rlock("windowed.rfixture")
+        assert isinstance(rm, schedex.Lock) and rm._re
+    assert lockdep.factory_hook() is None
+    assert type(lockdep.make_lock("after.fixture")) is type(threading.Lock())
+
+
+def test_lockdep_dump_graph_merges(tmp_path):
+    path = tmp_path / "lockorder.json"
+    path.write_text(json.dumps({"edges": {"t.outer": ["t.inner"]}}))
+    edges = lockdep.dump_graph(str(path), merge=True)
+    assert "t.inner" in edges.get("t.outer", [])
+    data = json.loads(path.read_text())
+    assert "t.inner" in data["edges"]["t.outer"]
+
+
+def test_racecheck_smoke_cli_racy_counter(tmp_path):
+    out = tmp_path / "racecheck.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "racecheck_smoke.py"),
+         "--only", "racy_counter", "--only", "lease_sweep_prefix",
+         "--only", "lease_sweep_vs_submit",
+         "--seeds", "2", "--json", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["ok"]
+    assert report["scenarios"]["racy_counter"]["verdict"] == "OK"
+    assert report["scenarios"]["racy_counter"]["replay"]["trace_identical"]
+    assert report["bench_schedex_off"]["hook_installed"] is False

@@ -300,6 +300,16 @@ def _stepprof_delta(before: dict, after: dict) -> dict:
     return out
 
 
+def _critpath_summary(prof_delta: dict) -> dict | None:
+    """Dominant-segment summary of a stepprof delta window (obs/critpath.py's
+    phase fold): where a mode's device wall actually went, in the same
+    segment taxonomy the server's /critpath endpoint ranks. None when the
+    profiler was off or recorded no wall."""
+    from nice_tpu.obs import critpath
+
+    return critpath.phase_shares(prof_delta)
+
+
 def _init_jax(remaining):
     """Import jax and force backend init, retrying on transient failure.
 
@@ -724,6 +734,9 @@ def main() -> int:
             mode_prof = _stepprof_delta(prof_before, _stepprof_sums())
             if mode_prof:
                 line["phase_breakdown"] = mode_prof
+                cp = _critpath_summary(mode_prof)
+                if cp is not None:
+                    line["critpath"] = cp
             _phase(
                 f"mode.{kind}.{mode}",
                 "error" if ("error" in line or wedged) else "end",
@@ -769,6 +782,9 @@ def main() -> int:
     suite_prof = _stepprof_delta(suite_prof0, _stepprof_sums())
     if suite_prof:
         headline["phase_breakdown"] = suite_prof
+        cp = _critpath_summary(suite_prof)
+        if cp is not None:
+            headline["critpath"] = cp
     _phase("suite", "end", budget_used_secs=round(budget - remaining(), 1))
     print(json.dumps(headline), flush=True)
     return 1 if any("error" in r for r in results.values()) else 0

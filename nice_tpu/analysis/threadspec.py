@@ -299,6 +299,15 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
         role="helper",
         notes="observatory server thread (stdlib serve_forever)",
     ),
+    ThreadRoot(
+        name="critpath-smoke-client",
+        path="scripts/critpath_smoke.py",
+        spawn_scope="main",
+        entries=("_client_worker",),
+        role="helper",
+        notes="concurrent smoke clients (claim + scalar submit over HTTP); "
+              "joined with a timeout before the critpath assertions",
+    ),
 )
 
 
@@ -352,6 +361,13 @@ LOCK_SPECS: Tuple[LockSpec, ...] = (
     LockSpec("native._build_lock", "native extension build",
              may_block_under=True),
     LockSpec("client.main.progress_cb.lock", "progress line state"),
+    LockSpec("obs.stream.StreamHub._lock",
+             "subscriber table + drop/eviction counters; publish never "
+             "blocks under it (bounded put_nowait only)"),
+    LockSpec("obs.critpath.CritpathEngine._lock",
+             "snapshot cache + bottleneck-shift state"),
+    LockSpec("server.app.ApiContext._stream_stage_lock",
+             "journal rows staged for post-commit stream publish"),
 )
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import glob
 import logging
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -159,6 +160,7 @@ class FieldCheckpointer:
 
         A rejected snapshot is deleted so the scan restarts cleanly and the
         next checkpoint overwrites nothing stale."""
+        t0 = time.monotonic()
         try:
             manifest, arrays = read_snapshot(self.path)
         except FileNotFoundError:
@@ -185,11 +187,15 @@ class FieldCheckpointer:
             "restore", claim=self.data.claim_id,
             cursor=str(manifest.get("cursor")),
         )
+        state = _snapshot_to_state(manifest, arrays)
+        # secs covers read + validation + state reconstruction — the
+        # ckpt_resume segment of the field's critical-path waterfall.
         journal.record_client_event(
             "ckpt_resume", claim_id=self.data.claim_id,
             cursor=str(manifest.get("cursor")),
+            secs=round(time.monotonic() - t0, 6),
         )
-        return _snapshot_to_state(manifest, arrays)
+        return state
 
     def delete(self) -> None:
         try:

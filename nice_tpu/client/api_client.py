@@ -287,9 +287,18 @@ def get_field_from_server(
     """GET /claim/{detailed|niceonly} (reference client_api_sync.rs:104-129)."""
     endpoint = "detailed" if mode == SearchMode.DETAILED else "niceonly"
     url = f"{api_base}/claim/{endpoint}?username={urllib.request.quote(username)}"
-    return DataToClient.from_json(
+    t0 = time.monotonic()
+    data = DataToClient.from_json(
         retry_request(url, max_retries=max_retries, endpoint="claim")
     )
+    # Critical-path stamp: the claim round-trip as the CLIENT experienced it
+    # (retries and backoff included — that wait is real end-to-end latency).
+    # Rides the next telemetry snapshot into this field's journal timeline.
+    obs.journal.record_client_event(
+        "claim_rtt", claim_id=data.claim_id,
+        secs=round(time.monotonic() - t0, 6),
+    )
+    return data
 
 
 def submit_field_to_server(
@@ -302,6 +311,7 @@ def submit_field_to_server(
     # where the field's trace_context isn't set, but the claim id is in the
     # payload, so the submit span still joins the field's trace.
     trace_id = obs.claim_trace_id(submit_data.claim_id)
+    t0 = time.monotonic()
     with obs.trace_context(trace_id), obs.span(
         "client.submit", claim=submit_data.claim_id
     ):
@@ -309,6 +319,13 @@ def submit_field_to_server(
             f"{api_base}/submit", submit_data.to_json(),
             max_retries=max_retries, endpoint="submit",
         )
+    # Critical-path stamp (see get_field_from_server): delivered by the
+    # NEXT telemetry snapshot, after the server already journaled
+    # submit_accepted — the waterfall composes both at read time.
+    obs.journal.record_client_event(
+        "submit_rtt", claim_id=submit_data.claim_id,
+        secs=round(time.monotonic() - t0, 6),
+    )
     if isinstance(resp, dict) and resp.get("duplicate"):
         log.info(
             "submit for claim %d was a duplicate: a retried request had "

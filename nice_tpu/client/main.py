@@ -262,6 +262,23 @@ def process_field(
     CLIENT_FIELD_SECONDS.labels(mode_label).observe(elapsed)
     CLIENT_FIELDS.labels(mode_label).inc()
     CLIENT_NUMBERS.inc(data.range_size)
+    # Critical-path stamp: this field's stepprof phase breakdown, keyed to
+    # its claim so the server folds h2d_feed/device_compute/readback into
+    # the field's waterfall. Only when the profiler ran (NICE_TPU_STEPPROF=1
+    # — off means no breakdown exists and the waterfall reports that time
+    # as unaccounted rather than inventing segments).
+    if obs.stepprof.enabled():
+        lb = dict(obs.stepprof.LAST_BREAKDOWN)
+        if lb and lb.get("base") == data.base:
+            phases = {
+                p: round(float(lb.get(p, 0.0) or 0.0), 6)
+                for p in obs.stepprof.PHASES
+            }
+            obs.journal.record_client_event(
+                "phases", claim_id=data.claim_id,
+                wall=round(float(lb.get("wall", elapsed) or elapsed), 6),
+                **phases,
+            )
     rate = data.range_size / elapsed if elapsed > 0 else float("inf")
     log.info(
         "processed %s numbers in %.2fs (%s numbers/sec)",

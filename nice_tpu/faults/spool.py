@@ -26,6 +26,7 @@ import json
 import logging
 import os
 import re
+import time
 from typing import Optional
 
 from nice_tpu.client import api_client
@@ -98,6 +99,7 @@ class SubmissionSpool:
     def _replay_one(
         self, path: str, api_base: str, max_retries: int
     ) -> str:
+        t0 = time.monotonic()
         try:
             with open(path, "r", encoding="utf-8") as f:
                 data = DataToServer.from_json(json.load(f))
@@ -120,6 +122,7 @@ class SubmissionSpool:
                 journal.record_client_event(
                     "spool_replay", claim_id=data.claim_id,
                     outcome="rejected", status=e.status,
+                    secs=round(time.monotonic() - t0, 6),
                 )
                 return "rejected"
             log.warning(
@@ -133,9 +136,13 @@ class SubmissionSpool:
             if resp.get("duplicate") else "",
         )
         self._remove(path)
+        # secs is the replay round-trip only; the time the submission sat
+        # spooled on disk is already visible in the journal as the gap
+        # before this event's timestamp.
         journal.record_client_event(
             "spool_replay", claim_id=data.claim_id, outcome="delivered",
             duplicate=bool(resp.get("duplicate")),
+            secs=round(time.monotonic() - t0, 6),
         )
         return "delivered"
 

@@ -31,6 +31,13 @@ Five pieces, all stdlib-only at import time:
 - ``anomaly``: fleet-pathology detectors (stuck fields, claim churn,
   lease-expiry storms, trust-slash bursts, throughput cliffs) over the
   journal + history, with SLO-style ok/warn/page states.
+- ``critpath``: fleet critical-path profiler — composes journal
+  timelines, client-side RTT/phase stamps, and the writer actor's queue
+  waits into reconciled per-field waterfalls, a USE-style utilization
+  rollup, and a dominant-segment classifier behind ``GET /critpath``.
+- ``stream``: the push-based SSE hub behind ``GET /events/stream`` —
+  bounded per-subscriber queues with drop accounting, heartbeats, and
+  ``Last-Event-ID`` resume over the journal cursor.
 - ``logsink``: the unified JSON-line logging formatter/installer with
   trace_id injection (NICE_TPU_LOG_LEVEL / NICE_TPU_LOG_FILE).
 
@@ -43,6 +50,7 @@ NICE_TPU_FLIGHT_EVENTS (flight-recorder dump dir / ring capacity).
 
 from . import (  # noqa: F401 — importing pre-seeds
     anomaly,
+    critpath,
     flight,
     history,
     journal,
@@ -50,6 +58,7 @@ from . import (  # noqa: F401 — importing pre-seeds
     series,
     slo,
     stepprof,
+    stream,
     telemetry,
 )
 from .metrics import (  # noqa: F401
@@ -95,6 +104,8 @@ __all__ = [
     "telemetry",
     "journal",
     "anomaly",
+    "critpath",
+    "stream",
     "logsink",
     "serve_metrics",
     "maybe_serve_metrics",

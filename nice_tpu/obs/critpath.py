@@ -1,0 +1,478 @@
+"""Fleet critical-path profiler: end-to-end latency attribution.
+
+Every canon-promoted field already leaves three partial timing records
+behind: its journal timeline (server-side transitions with microsecond
+timestamps), the client-side events piggybacked on telemetry (request
+round-trips, checkpoint resume, spool replays, the stepprof phase
+breakdown), and the writer actor's measured queue wait stamped onto
+``submit_accepted``. None of them alone answers "where did this field's
+wall-clock go?". This module composes all three into one segmented
+waterfall per field::
+
+    queue_wait | claim_rtt | ckpt_resume | h2d_feed | device_compute |
+    readback | spool_retry | submit_rtt | writer_wait | canon_promotion |
+    unaccounted
+
+and reconciles it: segments must sum to the observed journal wall clock
+(first queued/generated -> canon_promoted) within a declared tolerance
+(``NICE_TPU_CRITPATH_TOLERANCE`` as a fraction of wall, floored at
+``MIN_TOLERANCE_SECS``). The residual is *never hidden* — it is reported
+signed per field and any positive remainder lands in the visible
+``unaccounted`` segment, so attribution gaps show up as a segment you can
+rank, not as silent slack.
+
+Fleet rollup (:class:`CritpathEngine`): per-segment p50/p95 and
+share-of-total-wall over the last ``NICE_TPU_CRITPATH_WINDOW_FIELDS``
+promoted fields, a USE-style utilization triple (writer-actor busy
+fraction from :meth:`WriteActor.busy_stats`, device busy fraction and feed
+idle fraction from the fleet's stepprof phase totals), and a
+dominant-segment classifier. The engine runs on the writer's history tick
+(gauges land in the same sample as the rest of the observatory), serves
+``GET /critpath``, and emits a ``bottleneck_shift`` flight event + stream
+notification whenever the dominant segment changes or any segment's share
+moves by more than ``NICE_TPU_CRITPATH_SHIFT_RATIO``.
+
+Attribution caveats (accepted, documented): client-side segments are
+measured on the client's monotonic clock and mapped into the server-side
+wall interval, so clock skew between the two never corrupts a segment —
+it surfaces as residual. The client round-trips *contain* the writer-actor
+queue waits (the handler blocks on the writer future), so the measured
+waits are subtracted back out of ``claim_rtt``/``submit_rtt`` and out of
+``queue_wait``'s overlap with the in-flight claim request — segments are
+disjoint slices of wall clock, not independent stopwatches. stepprof's
+``compile`` bucket folds into ``device_compute`` (both are device-side
+work) and ``fold`` into ``readback`` (both are device->host transfers);
+``host_other`` is by definition unattributed and stays in ``unaccounted``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from nice_tpu.utils import knobs, lockdep
+
+from . import flight
+from .series import (
+    CRITPATH_FIELDS_WINDOW,
+    CRITPATH_SEGMENT_P50,
+    CRITPATH_SEGMENT_P95,
+    CRITPATH_SEGMENT_SHARE,
+    CRITPATH_UNRECONCILED,
+    CRITPATH_UTILIZATION,
+)
+
+__all__ = [
+    "SEGMENTS",
+    "MIN_TOLERANCE_SECS",
+    "field_waterfall",
+    "phase_shares",
+    "aggregate",
+    "CritpathEngine",
+]
+
+# Segment taxonomy, in causal order. Kept in sync with the gauge seeds in
+# obs/series.py and the table in README.md — nicelint's registry pass will
+# flag a gauge labeled with a segment not seeded there.
+SEGMENTS = (
+    "queue_wait",       # generated/queued -> claimed (sat in the pool)
+    "claim_rtt",        # client-measured /claim round-trip
+    "ckpt_resume",      # checkpoint load + fast-forward replay
+    "h2d_feed",         # host->device feed stalls (stepprof h2d_feed)
+    "device_compute",   # device execution incl. compile (stepprof)
+    "readback",         # device->host folds + readbacks (stepprof)
+    "spool_retry",      # offline spool replay delay
+    "submit_rtt",       # client-measured /submit round-trip (minus writer wait)
+    "writer_wait",      # writer-actor queue wait, claim + submit ops
+                        # (measured at the actor, not inferred)
+    "canon_promotion",  # submit_accepted -> canon_promoted (trust path)
+    "unaccounted",      # positive residual — visible, never hidden
+)
+
+# Tolerance floor: below this absolute slack, sub-second scheduling jitter
+# (timestamp quantization, GC pauses) would flap the reconciled bit.
+MIN_TOLERANCE_SECS = 0.25
+
+# Journal kinds that anchor the waterfall.
+_START_KINDS = ("generated", "queued")
+_CLAIM_KINDS = ("claimed", "block_claimed")
+
+# stepprof phase -> segment fold (see module docstring for rationale).
+_PHASE_FOLD = {
+    "h2d_feed": "h2d_feed",
+    "device_compute": "device_compute",
+    "compile": "device_compute",
+    "fold": "readback",
+    "readback": "readback",
+}
+
+
+def _parse_ts(value) -> Optional[float]:
+    """Journal ISO timestamp -> epoch seconds (None on junk)."""
+    if not value:
+        return None
+    from nice_tpu.server.db import parse_ts
+
+    try:
+        return parse_ts(str(value)).timestamp()
+    except (ValueError, TypeError):
+        return None
+
+
+def _detail_secs(evt: dict, key: str = "secs") -> float:
+    try:
+        return max(0.0, float((evt.get("detail") or {}).get(key, 0.0) or 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def field_waterfall(
+    events: list[dict],
+    tolerance_frac: Optional[float] = None,
+) -> Optional[dict]:
+    """Compose one field's journal timeline into a reconciled waterfall.
+
+    events: the field's full timeline (Db.get_field_timeline order —
+    ascending per-field seq). Returns None unless the field reached
+    canon_promoted (in-flight fields have no defined wall clock yet).
+
+    The waterfall follows the *canon-producing attempt*: the last
+    claim at or before the accepted submission. Client events from
+    earlier churned claims (an expired lease's ckpt_resume) still belong
+    to this field's end-to-end latency and are summed in — the field
+    waited through them regardless of which claim finally landed.
+    """
+    if tolerance_frac is None:
+        tolerance_frac = float(knobs.CRITPATH_TOLERANCE.get())
+
+    promoted = next(
+        (e for e in reversed(events) if e.get("kind") == "canon_promoted"),
+        None,
+    )
+    if promoted is None:
+        return None
+    end = _parse_ts(promoted.get("ts"))
+    if end is None:
+        return None
+
+    start_evt = next(
+        (e for e in events if e.get("kind") in _START_KINDS), None
+    )
+    claim_evt = next(
+        (e for e in events if e.get("kind") in _CLAIM_KINDS), None
+    )
+    accepted = next(
+        (e for e in events if e.get("kind") == "submit_accepted"), None
+    )
+    start = _parse_ts((start_evt or claim_evt or events[0]).get("ts"))
+    if start is None or end < start:
+        return None
+    wall = end - start
+
+    seg = {s: 0.0 for s in SEGMENTS}
+
+    for evt in events:
+        kind = evt.get("kind")
+        if kind == "client_claim_rtt":
+            seg["claim_rtt"] += _detail_secs(evt)
+        elif kind == "client_submit_rtt":
+            seg["submit_rtt"] += _detail_secs(evt)
+        elif kind == "client_ckpt_resume":
+            seg["ckpt_resume"] += _detail_secs(evt)
+        elif kind == "client_spool_replay":
+            seg["spool_retry"] += _detail_secs(evt)
+        elif kind == "client_phases":
+            detail = evt.get("detail") or {}
+            for phase, target in _PHASE_FOLD.items():
+                seg[target] += _detail_secs({"detail": detail}, phase)
+
+    # Disjointness: the client-measured round-trips CONTAIN the server-side
+    # writer-queue waits (the handler blocks on the writer future), and the
+    # claimed-event timestamp lands INSIDE the claim round-trip. Subtract the
+    # measured overlaps so every segment covers its own slice of wall clock:
+    #   writer_wait   = claim op wait + submit op wait (measured at the actor)
+    #   claim_rtt     = client /claim round-trip minus its writer wait
+    #   submit_rtt    = client /submit round-trip minus its writer wait
+    #   queue_wait    = generated/queued -> claimed stamp, minus the portion
+    #                   the claim request itself was already in flight
+    w_claim = _detail_secs(claim_evt, "writer_wait") if claim_evt else 0.0
+    w_submit = _detail_secs(accepted, "writer_wait") if accepted else 0.0
+    t_claim = _parse_ts(claim_evt.get("ts")) if claim_evt else None
+    if t_claim is not None:
+        overlap = max(seg["claim_rtt"], w_claim)
+        seg["queue_wait"] = max(0.0, (t_claim - start) - overlap)
+    seg["claim_rtt"] = max(0.0, seg["claim_rtt"] - w_claim)
+    seg["submit_rtt"] = max(0.0, seg["submit_rtt"] - w_submit)
+    seg["writer_wait"] = w_claim + w_submit
+
+    t_accept = _parse_ts(accepted.get("ts")) if accepted else None
+    if t_accept is not None:
+        seg["canon_promotion"] = max(0.0, end - t_accept)
+
+    accounted = sum(v for k, v in seg.items() if k != "unaccounted")
+    residual = wall - accounted
+    seg["unaccounted"] = max(0.0, residual)
+    tolerance = max(MIN_TOLERANCE_SECS, tolerance_frac * wall)
+    dominant = max(SEGMENTS, key=lambda s: seg[s])
+    return {
+        "field_id": promoted.get("field_id"),
+        "start_ts": (start_evt or claim_evt or events[0]).get("ts"),
+        "end_ts": promoted.get("ts"),
+        "wall_secs": round(wall, 6),
+        "segments": {s: round(seg[s], 6) for s in SEGMENTS},
+        "residual_secs": round(residual, 6),
+        "tolerance_secs": round(tolerance, 6),
+        "reconciled": abs(residual) <= tolerance,
+        "dominant": dominant,
+    }
+
+
+def phase_shares(prof: dict) -> Optional[dict]:
+    """Critpath summary of a stepprof phase table (bench.py's per-mode and
+    whole-suite breakdowns): fold the profiler's phase buckets into critpath
+    segments, compute each segment's share of the summed wall clock, and name
+    the dominant one. prof is stepprof.cumulative() shaped —
+    {"mode|b<base>|backend": {phase: secs, "wall": secs, ...}}. Returns None
+    when the table carries no wall time (profiler off / nothing ran)."""
+    wall = 0.0
+    totals = {s: 0.0 for s in SEGMENTS}
+    for entry in prof.values():
+        if not isinstance(entry, dict):
+            continue
+        try:
+            wall += max(0.0, float(entry.get("wall", 0.0) or 0.0))
+        except (TypeError, ValueError):
+            continue
+        for phase, target in _PHASE_FOLD.items():
+            try:
+                totals[target] += max(0.0, float(entry.get(phase, 0.0) or 0.0))
+            except (TypeError, ValueError):
+                pass
+    if wall <= 0.0:
+        return None
+    attributed = sum(totals.values())
+    totals["unaccounted"] = max(0.0, wall - attributed)
+    shares = {
+        s: round(totals[s] / wall, 6) for s in SEGMENTS if totals[s] > 0.0
+    }
+    dominant = max(shares, key=shares.get) if shares else None
+    return {
+        "wall_secs": round(wall, 6),
+        "shares": shares,
+        "dominant": dominant,
+    }
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (0 for empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def aggregate(waterfalls: list[dict]) -> dict:
+    """Fleet rollup over per-field waterfalls: per-segment p50/p95 +
+    share-of-total-wall, the dominant segment, and reconciliation stats."""
+    walls = [w["wall_secs"] for w in waterfalls]
+    total_wall = sum(walls)
+    per_seg: dict[str, dict] = {}
+    for s in SEGMENTS:
+        vals = sorted(w["segments"][s] for w in waterfalls)
+        total = sum(vals)
+        per_seg[s] = {
+            "p50": round(_percentile(vals, 0.50), 6),
+            "p95": round(_percentile(vals, 0.95), 6),
+            "total_secs": round(total, 6),
+            "share": round(total / total_wall, 6) if total_wall > 0 else 0.0,
+        }
+    dominant = (
+        max(SEGMENTS, key=lambda s: per_seg[s]["share"])
+        if total_wall > 0
+        else None
+    )
+    unreconciled = [
+        w["field_id"] for w in waterfalls if not w["reconciled"]
+    ]
+    return {
+        "fields": len(waterfalls),
+        "total_wall_secs": round(total_wall, 6),
+        "segments": per_seg,
+        "dominant": dominant,
+        "unreconciled_fields": unreconciled,
+    }
+
+
+class CritpathEngine:
+    """Windowed fleet critical-path state.
+
+    db/writer are the server's; on_event (optional) receives
+    ``(kind, payload)`` for stream fan-out when the bottleneck shifts.
+    Thread model: :meth:`evaluate` runs on the writer thread (history
+    tick); :meth:`snapshot` may be called from any handler thread — reads
+    go through Db's read connections and the short-TTL cache keeps a hot
+    ``/critpath`` endpoint from re-walking timelines per request.
+    """
+
+    # Snapshot cache TTL: /critpath and the history tick share one
+    # computation per interval instead of re-reading N timelines each.
+    CACHE_SECS = 2.0
+
+    def __init__(
+        self,
+        db,
+        writer=None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
+    ):
+        self.db = db
+        self.writer = writer
+        self.on_event = on_event
+        self._lock = lockdep.make_lock("obs.critpath.CritpathEngine._lock")
+        self._cache: Optional[dict] = None
+        self._cache_at = 0.0
+        self._last_dominant: Optional[str] = None
+        self._last_shares: dict[str, float] = {}
+        # Writer busy fraction over the evaluation interval, not process
+        # lifetime: diff consecutive (busy, uptime) samples so a stall NOW
+        # moves the gauge NOW.
+        self._last_busy: Optional[tuple[float, float]] = None
+        self._busy_fraction = 0.0
+        # Fields already counted into the unreconciled counter (bounded;
+        # counter semantics demand we not re-count a field every tick).
+        self._counted_unreconciled: set[int] = set()
+
+    # -- read side ---------------------------------------------------------
+
+    def _utilization(self) -> dict:
+        """USE-style triple. Device/feed fractions come from the fleet's
+        persisted stepprof phase totals (every active client's cumulative
+        breakdown, summed server-side); writer busy from the actor."""
+        if self.writer is not None and self._last_busy is None:
+            # No evaluate() tick yet: fall back to lifetime fraction.
+            busy, uptime = self.writer.busy_stats()
+            writer_busy = busy / uptime if uptime > 0 else 0.0
+        else:
+            writer_busy = self._busy_fraction
+        device_busy = feed_idle = 0.0
+        try:
+            totals = self.db.get_fleet_phase_totals()
+        except Exception:  # noqa: BLE001 — utilization is best-effort
+            totals = {}
+        wall = float(totals.get("wall", 0.0) or 0.0)
+        if wall > 0:
+            device_busy = (
+                float(totals.get("device_compute", 0.0) or 0.0)
+                + float(totals.get("compile", 0.0) or 0.0)
+            ) / wall
+            feed_idle = float(totals.get("h2d_feed", 0.0) or 0.0) / wall
+        return {
+            "writer_busy": round(min(1.0, max(0.0, writer_busy)), 6),
+            "device_busy": round(min(1.0, max(0.0, device_busy)), 6),
+            "feed_idle": round(min(1.0, max(0.0, feed_idle)), 6),
+        }
+
+    def _compute(self) -> dict:
+        window = max(1, int(knobs.CRITPATH_WINDOW_FIELDS.get()))
+        tol = float(knobs.CRITPATH_TOLERANCE.get())
+        field_ids = self.db.get_recent_canon_fields(window)
+        waterfalls = []
+        for fid in field_ids:
+            w = field_waterfall(self.db.get_field_timeline(fid), tol)
+            if w is not None:
+                waterfalls.append(w)
+        agg = aggregate(waterfalls)
+        return {
+            "window_fields": window,
+            "tolerance_frac": tol,
+            "utilization": self._utilization(),
+            "waterfalls": waterfalls,
+            **agg,
+        }
+
+    def snapshot(self, max_age_secs: Optional[float] = None) -> dict:
+        """Current fleet critical-path view (cached for CACHE_SECS)."""
+        ttl = self.CACHE_SECS if max_age_secs is None else max_age_secs
+        now = time.monotonic()
+        with self._lock:
+            if self._cache is not None and now - self._cache_at < ttl:
+                return self._cache
+        snap = self._compute()
+        with self._lock:
+            self._cache = snap
+            self._cache_at = time.monotonic()
+        return snap
+
+    # -- tick side (writer thread) ----------------------------------------
+
+    def evaluate(self) -> Optional[dict]:
+        """History-tick hook: refresh gauges, detect bottleneck shifts.
+
+        Returns the shift event payload when one fired (tests), else None.
+        """
+        if not knobs.CRITPATH.get_bool():
+            return None
+        if self.writer is not None:
+            busy, uptime = self.writer.busy_stats()
+            if self._last_busy is not None:
+                db_busy = busy - self._last_busy[0]
+                db_up = uptime - self._last_busy[1]
+                if db_up > 0:
+                    self._busy_fraction = min(1.0, max(0.0, db_busy / db_up))
+            self._last_busy = (busy, uptime)
+        snap = self.snapshot(max_age_secs=0.0)
+
+        for s in SEGMENTS:
+            info = snap["segments"][s]
+            CRITPATH_SEGMENT_SHARE.labels(s).set(info["share"])
+            CRITPATH_SEGMENT_P50.labels(s).set(info["p50"])
+            CRITPATH_SEGMENT_P95.labels(s).set(info["p95"])
+        for res, val in snap["utilization"].items():
+            CRITPATH_UTILIZATION.labels(res).set(val)
+        CRITPATH_FIELDS_WINDOW.set(snap["fields"])
+        for fid in snap["unreconciled_fields"]:
+            if fid not in self._counted_unreconciled:
+                self._counted_unreconciled.add(fid)
+                CRITPATH_UNRECONCILED.inc()
+        if len(self._counted_unreconciled) > 4096:
+            self._counted_unreconciled.clear()
+
+        return self._detect_shift(snap)
+
+    def _detect_shift(self, snap: dict) -> Optional[dict]:
+        dominant = snap.get("dominant")
+        shares = {s: snap["segments"][s]["share"] for s in SEGMENTS}
+        ratio = float(knobs.CRITPATH_SHIFT_RATIO.get())
+        moved = [
+            s for s in SEGMENTS
+            if abs(shares[s] - self._last_shares.get(s, 0.0)) > ratio
+        ]
+        changed = (
+            self._last_dominant is not None
+            and dominant is not None
+            and dominant != self._last_dominant
+        )
+        prev_dominant, prev_shares = self._last_dominant, self._last_shares
+        if dominant is not None:
+            self._last_dominant = dominant
+            self._last_shares = shares
+        if not changed and not (moved and prev_shares):
+            return None
+        payload = {
+            "dominant": dominant,
+            "previous": prev_dominant,
+            "moved_segments": {
+                s: {
+                    "from": round(prev_shares.get(s, 0.0), 6),
+                    "to": round(shares[s], 6),
+                }
+                for s in moved
+            },
+            "fields": snap["fields"],
+        }
+        flight.record("bottleneck_shift", **payload)
+        if self.on_event is not None:
+            try:
+                self.on_event("critpath", payload)
+            except Exception:  # noqa: BLE001 — stream fan-out is best-effort
+                pass
+        return payload

@@ -68,6 +68,12 @@ CLIENT_EVENT_KINDS = (
     "client_ckpt_resume",
     "client_downgrade",
     "client_spool_replay",
+    # Critical-path segment stamps (obs/critpath.py): request round-trips
+    # measured at the client (detail.secs), and the per-field stepprof
+    # phase breakdown (detail.{h2d_feed,device_compute,readback,...}).
+    "client_claim_rtt",
+    "client_submit_rtt",
+    "client_phases",
 )
 
 

@@ -265,6 +265,19 @@ SERVER_WRITER_QUEUE_DEPTH = metrics.gauge(
     "nice_server_writer_queue_depth",
     "Mutations waiting in the writer actor's queue at batch-drain time.",
 )
+SERVER_WRITER_OP_WAIT_SECONDS = metrics.histogram(
+    "nice_server_writer_op_wait_seconds",
+    "Writer-actor queue wait per mutation: submit()-enqueue to batch-begin."
+    " This is the measured writer-queue-wait segment of the critical path,"
+    " not an inference from endpoint latency.",
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
+)
+SERVER_WRITER_OP_EXEC_SECONDS = metrics.histogram(
+    "nice_server_writer_op_exec_seconds",
+    "Writer-actor execution time per mutation (inside its savepoint,"
+    " excluding queue wait and the shared batch commit).",
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
+)
 SERVER_BLOCK_LEASE_SIZE = metrics.histogram(
     "nice_server_block_lease_size",
     "Fields handed out per /claim_block lease.",
@@ -438,6 +451,60 @@ ANOMALY_TRANSITIONS = metrics.counter(
     labelnames=("detector", "state"),
 )
 
+# --- critical-path engine + live event stream (obs/critpath.py, stream.py)
+CRITPATH_SEGMENT_SHARE = metrics.gauge(
+    "nice_critpath_segment_share",
+    "Fleet-wide share of end-to-end wall-clock attributed to each critical-"
+    "path segment over the recent canon-field window (0..1; includes the"
+    " visible unaccounted residual).",
+    labelnames=("segment",),
+)
+CRITPATH_SEGMENT_P50 = metrics.gauge(
+    "nice_critpath_segment_p50_seconds",
+    "Per-segment p50 across the recent canon-field waterfalls.",
+    labelnames=("segment",),
+)
+CRITPATH_SEGMENT_P95 = metrics.gauge(
+    "nice_critpath_segment_p95_seconds",
+    "Per-segment p95 across the recent canon-field waterfalls.",
+    labelnames=("segment",),
+)
+CRITPATH_UTILIZATION = metrics.gauge(
+    "nice_critpath_utilization",
+    "USE-style utilization rollup (0..1): writer_busy (writer-actor busy"
+    " fraction), device_busy (device-compute share of profiled client"
+    " wall), feed_idle (h2d feed-wait share of profiled client wall).",
+    labelnames=("resource",),
+)
+CRITPATH_FIELDS_WINDOW = metrics.gauge(
+    "nice_critpath_fields_window",
+    "Canon fields in the most recent critical-path aggregation window"
+    " (0 = no waterfall evidence yet).",
+)
+CRITPATH_UNRECONCILED = metrics.counter(
+    "nice_critpath_unreconciled_total",
+    "Per-field waterfalls whose segments failed to reconcile to observed"
+    " wall-clock within NICE_TPU_CRITPATH_TOLERANCE.",
+)
+STREAM_SUBSCRIBERS = metrics.gauge(
+    "nice_stream_subscribers",
+    "Open GET /events/stream subscriptions.",
+)
+STREAM_EVENTS = metrics.counter(
+    "nice_stream_events_total",
+    "Events fanned out to stream subscribers, by event kind (journal /"
+    " anomaly / slo / critpath / heartbeat).",
+    labelnames=("kind",),
+)
+STREAM_DROPPED = metrics.counter(
+    "nice_stream_dropped_total",
+    "Events dropped because a subscriber's bounded queue was full.",
+)
+STREAM_EVICTIONS = metrics.counter(
+    "nice_stream_evictions_total",
+    "Slow consumers evicted after exceeding NICE_TPU_STREAM_MAX_DROPS.",
+)
+
 # --- local metrics endpoint (obs/serve.py) -------------------------------
 METRICS_BOUND_PORT = metrics.gauge(
     "nice_metrics_bound_port",
@@ -527,6 +594,19 @@ for _kind in ("generated", "queued", "claimed", "block_claimed", "renewed",
               "submit_rejected", "spot_check", "consensus_hold",
               "canon_promoted", "disqualified", "requeued"):
     SERVER_JOURNAL_EVENTS.labels(_kind)
+# Critical-path segment taxonomy (kept in sync with obs/critpath.SEGMENTS,
+# which imports these gauges; duplicated here like the journal kinds so a
+# scrape of a fresh server shows every segment at zero).
+for _seg in ("queue_wait", "claim_rtt", "ckpt_resume", "h2d_feed",
+             "device_compute", "readback", "spool_retry", "submit_rtt",
+             "writer_wait", "canon_promotion", "unaccounted"):
+    CRITPATH_SEGMENT_SHARE.labels(_seg)
+    CRITPATH_SEGMENT_P50.labels(_seg)
+    CRITPATH_SEGMENT_P95.labels(_seg)
+for _resource in ("writer_busy", "device_busy", "feed_idle"):
+    CRITPATH_UTILIZATION.labels(_resource)
+for _kind in ("journal", "anomaly", "slo", "critpath", "heartbeat"):
+    STREAM_EVENTS.labels(_kind)
 
 # Flight-recorder + tracing series (M1: declared here, used by obs.flight /
 # obs.trace). Kinds the production hooks emit are pre-seeded so a scrape of
@@ -557,7 +637,10 @@ FLIGHT_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint",
                       "trust_slash", "consensus_hold", "slo_transition",
                       # audit plane (journal write failures are silent
                       # otherwise; anomaly transitions mirror slo_transition)
-                      "journal_write_failed", "anomaly_transition")
+                      "journal_write_failed", "anomaly_transition",
+                      # critical-path engine: the fleet's dominant latency
+                      # segment changed (obs/critpath.py)
+                      "bottleneck_shift")
 for _kind in FLIGHT_KNOWN_KINDS:
     FLIGHT_EVENTS.labels(_kind)
 for _reason in ("crash", "sigusr2", "quarantine", "manual"):

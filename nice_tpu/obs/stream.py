@@ -1,0 +1,315 @@
+"""Push-based live telemetry stream (Server-Sent Events) — the hub.
+
+``GET /events/stream`` upgrades the pull-only observability surface
+(``/events?since=``, adaptive dashboard polling) to push: journal events,
+anomaly/SLO state transitions, and critical-path dominant-segment changes
+multiplex onto one long-lived SSE response per subscriber. The protocol is
+plain SSE so ``EventSource`` in ``web/fleet.html`` consumes it with zero
+client dependencies, and the ``id:`` field carries the *journal* global
+event id so ``Last-Event-ID`` resume composes with the existing
+``/events?since=<id>`` cursor — a reconnecting dashboard replays exactly
+the journal rows it missed (including across a server restart, because the
+cursor is the durable ``field_events`` rowid) and misses nothing, duplicates
+nothing.
+
+Design rules, in order:
+
+1. **Publishers never block.** :meth:`StreamHub.publish` is called from the
+   writer thread (post-commit journal flush, history tick transitions) and
+   must return immediately: each subscriber owns a bounded deque
+   (``NICE_TPU_STREAM_QUEUE``); when it is full the oldest event drops and
+   the subscriber's drop counter increments (surfaced to the consumer as a
+   ``lagged`` event so it KNOWS it has a gap, and to operators via
+   ``nice_stream_dropped_total``). A consumer that keeps lagging past
+   ``NICE_TPU_STREAM_MAX_DROPS`` is evicted — slow consumers shed load,
+   they don't grow it.
+2. **No thread per subscriber.** The hub is sync and loop-agnostic (hence
+   unit-testable without asyncio); the async core bridges wakeups onto the
+   event loop via each subscriber's waker callback
+   (``loop.call_soon_threadsafe``), and the per-connection responder
+   coroutine drains the deque and writes frames.
+3. **Heartbeats bound silence.** Every ``NICE_TPU_STREAM_HEARTBEAT_SECS``
+   without traffic the responder emits a comment-framed heartbeat, so
+   proxies don't idle-kill the socket and dead peers are detected within
+   one heartbeat interval (the write raises).
+
+Event kinds multiplexed: ``journal`` (one per committed field_event, id =
+global journal id), ``slo`` / ``anomaly`` (state transitions from the
+history tick), ``critpath`` (bottleneck shifts), ``hello`` (subscription
+acknowledged, carries the resume cursor), ``lagged`` (drops happened).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from collections import deque
+from typing import Callable, Optional
+
+from nice_tpu.utils import knobs, lockdep
+
+from .series import (
+    STREAM_DROPPED,
+    STREAM_EVENTS,
+    STREAM_EVICTIONS,
+    STREAM_SUBSCRIBERS,
+)
+
+__all__ = [
+    "StreamEvent",
+    "Subscriber",
+    "StreamHub",
+    "sse_frame",
+    "make_sse_responder",
+]
+
+# Catch-up replay page size (one /events?since= page per drain round).
+REPLAY_PAGE = 500
+
+
+class StreamEvent:
+    """One multiplexed event: kind (SSE event name), JSON-able data, and
+    the journal global id when the event IS a journal row (resume cursor)."""
+
+    __slots__ = ("kind", "data", "event_id")
+
+    def __init__(self, kind: str, data: dict, event_id: Optional[int] = None):
+        self.kind = kind
+        self.data = data
+        self.event_id = event_id
+
+
+def sse_frame(event: StreamEvent) -> bytes:
+    """Wire-format one event. ``id:`` only on journal events — SSE clients
+    persist the last seen id and send it back as Last-Event-ID, and only
+    the journal id is a durable resume cursor."""
+    lines = []
+    if event.event_id is not None:
+        lines.append(f"id: {int(event.event_id)}")
+    lines.append(f"event: {event.kind}")
+    data = json.dumps(event.data, separators=(",", ":"), sort_keys=True)
+    for chunk in data.splitlines() or [""]:
+        lines.append(f"data: {chunk}")
+    return ("\n".join(lines) + "\n\n").encode("utf-8")
+
+
+HEARTBEAT_FRAME = b": heartbeat\n\n"
+
+
+class Subscriber:
+    """One consumer's bounded buffer + lag accounting.
+
+    The waker is invoked after the hub releases its lock whenever the
+    queue grows, letting the async side schedule a drain
+    (``call_soon_threadsafe``) without the hub knowing about event loops.
+    """
+
+    __slots__ = ("queue", "dropped", "evicted", "waker", "last_sent_id")
+
+    def __init__(self, maxlen: int, waker: Optional[Callable[[], None]]):
+        self.queue: deque[StreamEvent] = deque(maxlen=maxlen)
+        self.dropped = 0
+        self.evicted = False
+        self.waker = waker
+        # Highest journal id already delivered to this consumer — set
+        # during catch-up replay so live journal events that raced in
+        # behind the replayed page are suppressed (no duplicates).
+        self.last_sent_id = 0
+
+    def pop_all(self) -> list[StreamEvent]:
+        out = []
+        while True:
+            try:
+                out.append(self.queue.popleft())
+            except IndexError:
+                return out
+
+
+class StreamHub:
+    """Fan-out registry: publish-side is non-blocking, subscriber queues
+    are bounded, and all state is behind one lock (publish happens on the
+    writer thread; subscribe/unsubscribe on the event loop; tests poke it
+    from wherever)."""
+
+    def __init__(self):
+        self._lock = lockdep.make_lock("obs.stream.StreamHub._lock")
+        self._subs: list[Subscriber] = []
+
+    # -- subscriber lifecycle ---------------------------------------------
+
+    def subscribe(
+        self, waker: Optional[Callable[[], None]] = None
+    ) -> Optional[Subscriber]:
+        """Register a consumer; None when the subscriber cap is reached
+        (the endpoint answers 503 — shedding beats collapsing)."""
+        cap = int(knobs.STREAM_MAX_SUBSCRIBERS.get())
+        maxlen = max(1, int(knobs.STREAM_QUEUE.get()))
+        with self._lock:
+            if len(self._subs) >= cap:
+                return None
+            sub = Subscriber(maxlen, waker)
+            self._subs.append(sub)
+            STREAM_SUBSCRIBERS.set(len(self._subs))
+        return sub
+
+    def unsubscribe(self, sub: Subscriber) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                return
+            STREAM_SUBSCRIBERS.set(len(self._subs))
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    # -- publish side (any thread, never blocks) ---------------------------
+
+    def publish(
+        self, kind: str, data: dict, event_id: Optional[int] = None
+    ) -> None:
+        """Fan one event out to every live subscriber. Full queue: the
+        deque's maxlen discards the oldest event and the drop counter
+        records the gap; past NICE_TPU_STREAM_MAX_DROPS the subscriber is
+        evicted (marked — its responder notices on next drain)."""
+        evt = StreamEvent(kind, data, event_id)
+        max_drops = int(knobs.STREAM_MAX_DROPS.get())
+        wakers: list[Callable[[], None]] = []
+        with self._lock:
+            if not self._subs:
+                return
+            STREAM_EVENTS.labels(kind).inc()
+            for sub in self._subs:
+                if sub.evicted:
+                    continue
+                if (
+                    event_id is not None
+                    and event_id <= sub.last_sent_id
+                ):
+                    # Journal event already delivered via catch-up replay.
+                    continue
+                if len(sub.queue) == sub.queue.maxlen:
+                    sub.dropped += 1
+                    STREAM_DROPPED.inc()
+                    if sub.dropped >= max_drops:
+                        sub.evicted = True
+                        STREAM_EVICTIONS.inc()
+                sub.queue.append(evt)
+                if sub.waker is not None:
+                    wakers.append(sub.waker)
+        # Wake outside the lock: wakers hop threads (call_soon_threadsafe)
+        # and must not run under the hub lock.
+        for wake in wakers:
+            try:
+                wake()
+            except Exception:  # noqa: BLE001 — a dead loop can't block publish
+                pass
+
+    def publish_journal_rows(self, rows: list[dict]) -> None:
+        """Convenience: one ``journal`` event per enriched journal row
+        (rows carry their assigned global id — the post-commit flush path)."""
+        for row in rows:
+            rid = row.get("id")
+            self.publish(
+                "journal", row, event_id=int(rid) if rid is not None else None
+            )
+
+
+def make_sse_responder(
+    hub: StreamHub,
+    replay: Optional[Callable[[int, int], list[dict]]] = None,
+    since: int = 0,
+):
+    """Build the per-connection async responder the server hands to the
+    async core's Response.stream.
+
+    Resume protocol: ``since`` is the consumer's last seen journal id
+    (``Last-Event-ID`` header, falling back to ``?since=``); ``replay``
+    pages the durable journal feed (Db.get_events_since) so the consumer
+    first catches up from the table — the same cursor ``/events?since=``
+    uses, so resume works across server restarts — then switches to live
+    hub delivery. The no-dup/no-miss invariant is enforced twice: the hub
+    suppresses journal events already covered by the replay cursor at
+    publish time, and the drain loop re-checks each popped journal event
+    against ``last_sent_id`` for events that raced in mid-replay.
+
+    Runs on the event loop; all blocking waits are awaits, all writes are
+    followed by drain() (peer death surfaces there as ConnectionError,
+    handled by the caller)."""
+
+    async def respond(writer) -> None:
+        loop = asyncio.get_running_loop()
+        wake = asyncio.Event()
+        sub = hub.subscribe(
+            waker=lambda: loop.call_soon_threadsafe(wake.set)
+        )
+        if sub is None:  # raced past the cap check at routing time
+            return
+        reported_drops = 0
+        try:
+            cursor = max(0, int(since))
+            # Phase 1: catch up from the durable journal.
+            while replay is not None:
+                page = replay(cursor, REPLAY_PAGE)
+                for row in page:
+                    cursor = max(cursor, int(row["id"]))
+                    writer.write(
+                        sse_frame(StreamEvent("journal", row, int(row["id"])))
+                    )
+                # Advance BEFORE draining so live publishes of these very
+                # ids are suppressed from here on.
+                sub.last_sent_id = max(sub.last_sent_id, cursor)
+                await writer.drain()
+                if len(page) < REPLAY_PAGE:
+                    break
+            sub.last_sent_id = max(sub.last_sent_id, cursor)
+            writer.write(
+                sse_frame(
+                    StreamEvent(
+                        "hello",
+                        {"cursor": cursor,
+                         "subscribers": hub.subscriber_count()},
+                    )
+                )
+            )
+            await writer.drain()
+            # Phase 2: live delivery with heartbeat-bounded silence.
+            heartbeat = max(0.1, float(knobs.STREAM_HEARTBEAT_SECS.get()))
+            while True:
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=heartbeat)
+                    wake.clear()
+                except asyncio.TimeoutError:
+                    writer.write(HEARTBEAT_FRAME)
+                    await writer.drain()
+                    continue
+                wrote = False
+                for evt in sub.pop_all():
+                    if evt.event_id is not None:
+                        if evt.event_id <= sub.last_sent_id:
+                            continue  # replay already delivered it
+                        sub.last_sent_id = evt.event_id
+                    writer.write(sse_frame(evt))
+                    wrote = True
+                if sub.dropped > reported_drops:
+                    writer.write(
+                        sse_frame(
+                            StreamEvent(
+                                "lagged",
+                                {"dropped": sub.dropped,
+                                 "cursor": sub.last_sent_id,
+                                 "evicted": sub.evicted},
+                            )
+                        )
+                    )
+                    reported_drops = sub.dropped
+                    wrote = True
+                if wrote:
+                    await writer.drain()
+                if sub.evicted:
+                    return  # slow consumer: close; it resumes via cursor
+        finally:
+            hub.unsubscribe(sub)
+
+    return respond

@@ -82,6 +82,7 @@ from nice_tpu.server.async_core import (
 )
 from nice_tpu.server.db import Db
 from nice_tpu.server.field_queue import U128_MAX, FieldQueue
+from nice_tpu.server import writer as writer_mod
 from nice_tpu.server.writer import DirectWriter, WriteActor
 from nice_tpu.utils import knobs, lockdep
 
@@ -161,6 +162,19 @@ class ApiContext:
             self.writer = WriteActor(db)
         else:
             self.writer = DirectWriter(db)
+        # Push-based live telemetry: the SSE hub behind GET /events/stream.
+        # Journal events are STAGED in journal_now (which may run inside an
+        # uncommitted writer batch) and published only from the writer's
+        # on_batch_end(committed=True) hook — a rolled-back batch's events
+        # are discarded, so subscribers never see a transition that didn't
+        # durably happen. Wired before FieldQueue below: its bulk pre-claims
+        # journal through the writer while __init__ is still running.
+        self.stream = obs.stream.StreamHub()
+        self._stream_staged: list = []
+        self._stream_stage_lock = lockdep.make_lock(
+            "server.app.ApiContext._stream_stage_lock"
+        )
+        self.writer.on_batch_end = self._flush_stream_staged
         # Crash counterpart of FieldQueue.close(): a SIGKILLed server's
         # in-memory inventory left lease stamps with no claims rows; release
         # them before this process's queue starts bulk-claiming.
@@ -228,6 +242,18 @@ class ApiContext:
         self.history_retention_secs = knobs.HISTORY_RETENTION_SECS.get()
         self.journal_retention_secs = knobs.JOURNAL_RETENTION_SECS.get()
         self._last_history_prune = time.monotonic()
+        # Fleet critical-path engine: waterfalls + USE rollup + dominant-
+        # segment classifier, evaluated on the history tick and served at
+        # GET /critpath. Bottleneck shifts fan out to the stream.
+        self.critpath = obs.critpath.CritpathEngine(
+            db, self.writer,
+            on_event=lambda kind, data: self.stream.publish(kind, data),
+        )
+        # SLO / anomaly state snapshots from the previous tick: history_tick
+        # diffs against them to publish ONLY transitions to the stream (the
+        # full states keep being served by /slo and /anomalies pulls).
+        self._last_slo_states: dict = {}
+        self._last_anomaly_states: dict = {}
         history_secs = obs.history.sample_interval_secs()
         if history_secs > 0:
             self.writer.add_periodic(self.history_tick, history_secs)
@@ -236,6 +262,12 @@ class ApiContext:
         """One observatory beat. Runs on the writer thread between batches
         (its own transaction; exceptions are logged, never fatal). Tests
         with a DirectWriter call this directly to advance history."""
+        # Critical-path gauges refresh FIRST so this tick's registry sample
+        # below captures them fresh instead of one interval stale.
+        try:
+            self.critpath.evaluate()
+        except Exception:  # noqa: BLE001 — attribution must not stop the beat
+            log.exception("critpath evaluation failed")
         self.history.sample_registries(
             [obs.REGISTRY, self.metrics.registry]
         )
@@ -243,8 +275,10 @@ class ApiContext:
         rows = self.history.drain_rows()
         if rows:
             HISTORY_PERSISTED_ROWS.inc(self.db.insert_metric_history(rows))
-        self.slo.evaluate()
-        self.anomaly.evaluate()
+        self._publish_transitions("slo", self.slo.evaluate(), "slo",
+                                  self._last_slo_states)
+        self._publish_transitions("anomaly", self.anomaly.evaluate(),
+                                  "detector", self._last_anomaly_states)
         now = time.monotonic()
         if now - self._last_history_prune >= 600.0:
             self._last_history_prune = now
@@ -289,12 +323,45 @@ class ApiContext:
         if not rows:
             return
         try:
-            self.db.append_field_events(rows)
+            enriched = self.db.append_field_events(rows)
         except Exception:  # noqa: BLE001 — the journal must never take
             # down the mutation it annotates
             SERVER_JOURNAL_WRITE_FAILURES.inc()
             obs.flight.record("journal_write_failed", count=len(rows))
             log.exception("audit journal append failed (%d events)", len(rows))
+            return
+        # Stage for the stream plane: rows fan out to SSE subscribers only
+        # once the enclosing batch commits (on_batch_end flushes).
+        if enriched:
+            with self._stream_stage_lock:
+                self._stream_staged.extend(enriched)
+
+    def _flush_stream_staged(self, committed: bool) -> None:
+        """Writer on_batch_end hook: publish staged journal rows to the SSE
+        hub after COMMIT, discard them after rollback — stream subscribers
+        see exactly the events that became durable."""
+        with self._stream_stage_lock:
+            staged, self._stream_staged[:] = list(self._stream_staged), []
+        if committed and staged:
+            self.stream.publish_journal_rows(staged)
+
+    def _publish_transitions(self, kind: str, results: list, name_key: str,
+                             last_states: dict) -> None:
+        """Diff one engine's evaluate() output against its previous tick
+        and push only the state CHANGES to the stream (dashboards get the
+        edge; steady state stays pull-only)."""
+        for res in results or []:
+            name = res.get(name_key)
+            if name is None:
+                continue
+            prev = last_states.get(name)
+            state = res.get("state")
+            if prev is not None and state != prev:
+                self.stream.publish(
+                    kind,
+                    {"name": name, "from": prev, "to": state, **res},
+                )
+            last_states[name] = state
 
     def _bucket_multiplier(self, key: str) -> float:
         """Trusted veterans earn bigger rate-limit buckets (up to 4x).
@@ -546,11 +613,18 @@ def claim_helper(
             field.field_id, search_mode, user_ip,
             client_token=client_token, lease_secs=lease_secs,
         )
+        # Writer-queue wait measured at the actor (critical-path segment):
+        # the claim's slice of writer_wait, mirroring submit_accepted's.
+        extra = {}
+        wait = writer_mod.current_op_wait_secs()
+        if wait is not None:
+            extra["writer_wait"] = round(wait, 6)
         ctx.journal_now([
             obs.journal.event_row(
                 field.field_id, "claimed", claim_id=claim.claim_id,
                 client=client_token, tier=tier,
                 check_level=field.check_level, mode=search_mode.value,
+                **extra,
             )
         ])
         return field, claim
@@ -624,12 +698,17 @@ def handle_claim_block(
             [f.field_id for f in fields], search_mode, user_ip, block_id,
             client_token=client_token, lease_secs=lease_secs,
         )
+        extra = {}
+        wait = writer_mod.current_op_wait_secs()
+        if wait is not None:
+            extra["writer_wait"] = round(wait, 6)
         ctx.journal_now([
             obs.journal.event_row(
                 field.field_id, "block_claimed", claim_id=claim.claim_id,
                 client=client_token, tier=tier,
                 check_level=field.check_level, block=block_id,
                 mode=search_mode.value,
+                **extra,
             )
             for field, claim in zip(fields, claims)
         ])
@@ -876,12 +955,22 @@ def _journal_submit_accepted(
     bar also lands its canon_promoted event here — the promotion and its
     evidence are one commit."""
     tier = _trust_tier(ctx, client_token)
+    # Critical-path stamp: running inside the persist closure means we are
+    # ON the writer thread, mid-op — current_op_wait_secs() is this very
+    # submission's measured enqueue->begin queue wait, the writer_wait
+    # segment of the field's waterfall (measured at the actor, not inferred
+    # from endpoint latency).
+    extra = {}
+    wait = writer_mod.current_op_wait_secs()
+    if wait is not None:
+        extra["writer_wait"] = round(wait, 6)
     rows = [
         obs.journal.event_row(
             field.field_id, "submit_accepted",
             claim_id=claim_id, client=client_token,
             tier=tier, check_level=field.check_level,
             submission=submission_id, mode=mode_label,
+            **extra,
         )
     ]
     if mode_label == "detailed" and trusted and field.check_level < 2:
@@ -1441,7 +1530,7 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root", "token", "history", "fields", "events"}
+     "root", "token", "history", "fields", "events", "critpath"}
 )
 
 _CORS_HEADERS = {
@@ -1712,6 +1801,53 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "more": len(events) == limit,
                 },
             )
+        if method == "GET" and path == "/events/stream":
+            # Push-based live feed (SSE): journal events + slo/anomaly
+            # transitions + critpath bottleneck shifts. Resume via
+            # Last-Event-ID (or ?since=) over the same durable journal
+            # cursor /events?since= uses. Served on the event loop — the
+            # Response carries a stream coroutine, no worker thread is
+            # held. The legacy thread core answers 501 (make_handler), so
+            # dashboards fall back to polling cleanly.
+            qs = parse_qs(parsed.query)
+            raw_since = request.headers.get("Last-Event-ID") or qs.get(
+                "since", ["0"]
+            )[0]
+            try:
+                since = max(0, int(raw_since))
+            except (TypeError, ValueError):
+                raise ApiError(400, "Last-Event-ID/since must be an integer")
+            cap = int(knobs.STREAM_MAX_SUBSCRIBERS.get())
+            if ctx.stream.subscriber_count() >= cap:
+                raise ApiError(
+                    503,
+                    f"stream subscriber cap reached ({cap}); retry later",
+                    headers={"Retry-After": str(ctx.retry_after_secs)},
+                )
+            return Response(
+                200,
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    **_CORS_HEADERS,
+                },
+                stream=obs.stream.make_sse_responder(
+                    ctx.stream, ctx.db.get_events_since, since
+                ),
+            )
+        if method == "GET" and path == "/critpath":
+            # Fleet critical-path attribution: per-segment p50/p95 + shares
+            # over the recent canon window, USE utilization, the dominant
+            # segment, and (?fields=N, default 10) the newest per-field
+            # waterfalls with their reconciliation verdicts.
+            qs = parse_qs(parsed.query)
+            try:
+                nfields = int(qs.get("fields", ["10"])[0])
+            except ValueError:
+                raise ApiError(400, "fields must be an integer")
+            snap = dict(ctx.critpath.snapshot())
+            snap["waterfalls"] = snap["waterfalls"][: max(0, nfields)]
+            return _json_response(200, snap)
         if method == "GET" and path == "/debug/flight":
             return _json_response(
                 200,
@@ -1876,6 +2012,15 @@ def make_handler(ctx: ApiContext):
             if resp.drop:
                 self.close_connection = True
                 return
+            if resp.stream is not None:
+                # The thread core has no event loop to service a long-lived
+                # SSE socket; a clean 501 is the dashboard's documented cue
+                # to fall back to polling.
+                resp = _error_response(
+                    501,
+                    "event streaming requires the async server core"
+                    " (NICE_TPU_SERVER_CORE=async)",
+                )
             self.send_response(resp.status)
             headers_out = dict(resp.headers)
             headers_out.setdefault("Content-Type", "application/json")

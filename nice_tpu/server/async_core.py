@@ -148,6 +148,13 @@ class Response:
     body: bytes = b""
     drop: bool = False  # close the connection without writing anything
     close: bool = False  # write the response, then close
+    # Streaming responses (SSE): an async callable awaited ON THE LOOP
+    # THREAD with the raw StreamWriter after the head is written. The
+    # router returns one from a pool thread without blocking that pool
+    # slot for the stream's lifetime — long-lived subscribers are
+    # loop-serviced, not worker-occupying. Content-Length is omitted and
+    # the connection always closes when the callable returns.
+    stream: Optional[Callable] = None
 
 
 Router = Callable[[Request], Response]
@@ -305,6 +312,9 @@ class AsyncHTTPServer:
                         self._inflight -= 1
                 if response.drop:
                     return  # chaos "drop": vanish without a response
+                if response.stream is not None:
+                    await self._serve_stream(writer, response)
+                    return
                 keep = self._keep_alive(version, headers) and not response.close
                 await self._write_response(writer, response, keep)
                 if not keep:
@@ -349,6 +359,33 @@ class AsyncHTTPServer:
         if version == "HTTP/1.1":
             return conn != "close"
         return conn == "keep-alive"
+
+    @staticmethod
+    async def _serve_stream(writer, response: Response) -> None:
+        """Write the head sans Content-Length, then hand the socket to the
+        response's stream coroutine (runs on the loop thread until the
+        subscriber disconnects or is evicted). The connection never
+        keep-alives: SSE owns the socket until it dies."""
+        reason = _REASONS.get(response.status, "Unknown")
+        lines = [f"HTTP/1.1 {response.status} {reason}"]
+        headers = dict(response.headers)
+        headers.setdefault("Content-Type", "text/event-stream")
+        headers.setdefault("Cache-Control", "no-cache")
+        headers["Connection"] = "close"
+        for name, value in headers.items():
+            lines.append(f"{name}: {value}")
+        head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+        try:
+            writer.write(head)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            return
+        try:
+            await response.stream(writer)
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001 — a stream bug must not kill the loop
+            log.exception("stream responder crashed")
 
     @staticmethod
     async def _write_response(writer, response: Response, keep_alive: bool):

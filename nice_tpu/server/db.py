@@ -1514,15 +1514,24 @@ class Db:
     # sites inside claim/submit ops commit atomically with the state change
     # they describe). Row shape comes from obs/journal.py:event_row.
 
-    def append_field_events(self, rows: list[dict]) -> int:
+    def append_field_events(self, rows: list[dict]) -> list[dict]:
         """Append journal events; assigns each row the next per-field seq.
 
         The per-field MAX(seq)+1 read is race-free because every write path
         runs under self._lock (single-writer actor); rows for the same field
         within one batch sequence correctly because each insert lands before
-        the next row's MAX runs."""
+        the next row's MAX runs.
+
+        Returns the rows enriched with their assigned global ``id``, per-
+        field ``seq``, and effective ``ts`` — the exact wire shape the
+        /events feed serves — so the caller can stage them for the stream
+        plane's post-commit publish without re-reading the table. Note the
+        ids are NOT durable until the enclosing batch commits (this runs
+        as a savepoint under the writer actor): staging must wait for the
+        on_batch_end(committed=True) signal before publishing."""
         if not rows:
-            return 0
+            return []
+        enriched: list[dict] = []
         with self._lock, self._txn():
             for row in rows:
                 fid = int(row["field_id"])
@@ -1531,14 +1540,15 @@ class Db:
                     " WHERE field_id = ?",
                     (fid,),
                 ).fetchone()[0]
-                self._conn.execute(
+                at = row.get("ts") or ts(now_utc())
+                cur = self._conn.execute(
                     "INSERT INTO field_events (field_id, seq, ts, kind,"
                     " trace_id, client, tier, check_level, detail)"
                     " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (
                         fid,
                         seq,
-                        row.get("ts") or ts(now_utc()),
+                        at,
                         str(row["kind"]),
                         row.get("trace_id"),
                         row.get("client"),
@@ -1547,9 +1557,23 @@ class Db:
                         json.dumps(row.get("detail") or {}, sort_keys=True),
                     ),
                 )
+                enriched.append(
+                    {
+                        "id": int(cur.lastrowid),
+                        "field_id": fid,
+                        "seq": int(seq),
+                        "ts": at,
+                        "kind": str(row["kind"]),
+                        "trace_id": row.get("trace_id"),
+                        "client": row.get("client"),
+                        "tier": row.get("tier"),
+                        "check_level": row.get("check_level"),
+                        "detail": dict(row.get("detail") or {}),
+                    }
+                )
         for row in rows:
             SERVER_JOURNAL_EVENTS.labels(str(row["kind"])).inc()
-        return len(rows)
+        return enriched
 
     @staticmethod
     def _event_row_to_dict(r) -> dict:
@@ -1590,6 +1614,49 @@ class Db:
                 (int(since_id), int(limit)),
             ).fetchall()
         return [self._event_row_to_dict(r) for r in rows]
+
+    def get_recent_canon_fields(self, limit: int = 200) -> list[int]:
+        """Field ids of the most recent canon promotions, newest first —
+        the critical-path engine's rolling attribution window."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT field_id, MAX(id) AS latest FROM field_events"
+                " WHERE kind = 'canon_promoted'"
+                " GROUP BY field_id ORDER BY latest DESC LIMIT ?",
+                (int(limit),),
+            ).fetchall()
+        return [int(r["field_id"]) for r in rows]
+
+    def get_fleet_phase_totals(self, active_secs: float = 900.0) -> dict:
+        """Sum of active clients' cumulative stepprof phase breakdowns
+        ({phase: secs, "wall": secs, "fields": n}), read out of the
+        client_telemetry snapshot JSON (phase_breakdown rides only there —
+        no schema column for a dict older clients never send). Feeds the
+        critical-path USE rollup's device-busy / feed-idle fractions."""
+        cutoff = ts(now_utc() - timedelta(seconds=active_secs))
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT snapshot FROM client_telemetry WHERE last_seen >= ?",
+                (cutoff,),
+            ).fetchall()
+        totals: dict[str, float] = {}
+        for r in rows:
+            try:
+                snap = json.loads(r["snapshot"] or "{}")
+            except (ValueError, TypeError):
+                continue
+            pb = snap.get("phase_breakdown")
+            if not isinstance(pb, dict):
+                continue
+            for entry in pb.values():
+                if not isinstance(entry, dict):
+                    continue
+                for k, v in entry.items():
+                    try:
+                        totals[k] = totals.get(k, 0.0) + float(v or 0.0)
+                    except (TypeError, ValueError):
+                        continue
+        return totals
 
     def count_field_events(self, kinds: tuple, since_iso: str) -> int:
         """How many journal events of the given kinds landed since the ISO

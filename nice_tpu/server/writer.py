@@ -25,8 +25,11 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
+from nice_tpu import faults
 from nice_tpu.obs.series import (
     SERVER_WRITE_BATCH_SIZE,
+    SERVER_WRITER_OP_EXEC_SECONDS,
+    SERVER_WRITER_OP_WAIT_SECONDS,
     SERVER_WRITER_QUEUE_DEPTH,
 )
 from nice_tpu.server.db import Db
@@ -35,6 +38,20 @@ from nice_tpu.utils import knobs
 log = logging.getLogger(__name__)
 
 _STOP = object()
+
+# Writer-thread-local context for the op currently executing: its measured
+# queue wait (enqueue -> batch begin). Emission sites running INSIDE a
+# writer op (the submit persist closures journaling submit_accepted) read
+# it to stamp the writer-queue-wait segment onto the event they append —
+# measured at the source, not inferred from endpoint latency.
+_op_ctx = threading.local()
+
+
+def current_op_wait_secs() -> float | None:
+    """Queue wait of the writer op executing on THIS thread (None when not
+    called from inside a writer op — e.g. under DirectWriter, where there
+    is no queue and the wait is zero by construction)."""
+    return getattr(_op_ctx, "wait", None)
 
 
 class WriterClosed(RuntimeError):
@@ -69,6 +86,16 @@ class WriteActor:
         self._closed = False
         self._periodics: list[dict] = []
         self._thread: threading.Thread | None = None
+        # Post-batch hook (writer thread): called with committed=True after
+        # the batch transaction commits, False after it rolls back. The
+        # stream plane uses it to publish journal events only once they are
+        # durable. Exceptions are contained — never fatal to the writer.
+        self.on_batch_end: Callable[[bool], None] | None = None
+        # USE rollup inputs: cumulative wall time this actor spent executing
+        # batches, against its uptime (busy fraction = how saturated the
+        # single-writer resource is).
+        self._busy_secs = 0.0
+        self._started_monotonic = time.monotonic()
         if start:
             self._thread = threading.Thread(
                 target=self._run, name="db-writer", daemon=True
@@ -81,7 +108,7 @@ class WriteActor:
         if self._closed:
             raise WriterClosed("writer actor is closed")
         fut: Future = Future()
-        self._q.put((fut, fn, args, kwargs))
+        self._q.put((fut, fn, args, kwargs, time.monotonic()))
         return fut
 
     def call(self, fn: Callable, *args, **kwargs) -> Any:
@@ -105,6 +132,14 @@ class WriteActor:
 
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    def busy_stats(self) -> tuple[float, float]:
+        """(cumulative batch-execution seconds, uptime seconds) — the
+        critical-path engine diffs consecutive samples into a writer busy
+        fraction for the USE rollup."""
+        return self._busy_secs, max(
+            1e-9, time.monotonic() - self._started_monotonic
+        )
 
     def close(self) -> None:
         """Stop accepting work, drain what's queued, and join the thread."""
@@ -164,7 +199,9 @@ class WriteActor:
                 batch.append(nxt)
             SERVER_WRITER_QUEUE_DEPTH.set(self._q.qsize())
             SERVER_WRITE_BATCH_SIZE.observe(len(batch))
+            t_batch = time.monotonic()
             self._run_batch(batch)
+            self._busy_secs += time.monotonic() - t_batch
             self._run_periodics()
 
     def _run_batch(self, batch: list) -> None:
@@ -173,29 +210,61 @@ class WriteActor:
         # then, and telling the caller OK before COMMIT would break the
         # exactly-once story if the commit failed.
         settled: list[tuple[Future, Any, BaseException | None]] = []
+        # Chaos site writer.batch: a numeric action stalls the single-writer
+        # actor for that many seconds before the batch runs — the deliberate
+        # writer-actor stall the critical-path smoke injects to prove the
+        # writer_wait segment is attributed, not inferred.
+        act = faults.fire("writer.batch", size=len(batch))
+        if act is not None:
+            try:
+                time.sleep(float(act))
+            except (TypeError, ValueError):
+                pass
+        t_begin = time.monotonic()
         try:
             with self.db._lock, self.db._txn():
-                for fut, fn, args, kwargs in batch:
+                for fut, fn, args, kwargs, t_enq in batch:
+                    SERVER_WRITER_OP_WAIT_SECONDS.observe(
+                        max(0.0, t_begin - t_enq)
+                    )
+                    _op_ctx.wait = max(0.0, t_begin - t_enq)
+                    t_exec = time.monotonic()
                     try:
                         with self.db._txn():
                             out = fn(*args, **kwargs)
                         settled.append((fut, out, None))
                     except BaseException as e:
                         settled.append((fut, None, e))
+                    finally:
+                        SERVER_WRITER_OP_EXEC_SECONDS.observe(
+                            time.monotonic() - t_exec
+                        )
+                        _op_ctx.wait = None
         except BaseException as outer:
             log.exception("writer batch transaction failed (%d ops)", len(batch))
+            self._notify_batch_end(False)
             done = {id(f) for f, _, _ in settled}
             for fut, _, err in settled:
                 fut.set_exception(err if err is not None else outer)
-            for fut, _fn, _a, _k in batch:
+            for fut, _fn, _a, _k, _t in batch:
                 if id(fut) not in done:
                     fut.set_exception(outer)
             return
+        self._notify_batch_end(True)
         for fut, out, err in settled:
             if err is None:
                 fut.set_result(out)
             else:
                 fut.set_exception(err)
+
+    def _notify_batch_end(self, committed: bool) -> None:
+        hook = self.on_batch_end
+        if hook is None:
+            return
+        try:
+            hook(committed)
+        except Exception:  # noqa: BLE001 — observability must not kill the writer
+            log.exception("writer on_batch_end hook failed")
 
 
 class DirectWriter:
@@ -205,17 +274,41 @@ class DirectWriter:
 
     def __init__(self, db: Db):
         self.db = db
+        self.on_batch_end: Callable[[bool], None] | None = None
+
+    def _notify(self, committed: bool) -> None:
+        # Each call is its own "batch": the stream plane's post-commit
+        # publish hook fires symmetrically with the actor path.
+        hook = self.on_batch_end
+        if hook is None:
+            return
+        try:
+            hook(committed)
+        except Exception:  # noqa: BLE001 — same containment as the actor
+            log.exception("direct-writer on_batch_end hook failed")
 
     def submit(self, fn: Callable, *args, **kwargs) -> Future:
         fut: Future = Future()
         try:
             fut.set_result(fn(*args, **kwargs))
         except BaseException as e:
+            self._notify(False)
             fut.set_exception(e)
+        else:
+            self._notify(True)
         return fut
 
     def call(self, fn: Callable, *args, **kwargs) -> Any:
-        return fn(*args, **kwargs)
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            self._notify(False)
+            raise
+        self._notify(True)
+        return out
+
+    def busy_stats(self) -> tuple[float, float]:
+        return 0.0, 1.0
 
     def add_periodic(self, fn: Callable[[], Any], interval_secs: float) -> None:
         """No background thread here: periodics (the lease sweep) simply

@@ -574,6 +574,59 @@ ANOMALY_OVERRIDES = _family(
     " NICE_TPU_ANOMALY_CLAIM_CHURN_PAGE.",
     owner="obs/anomaly.py", group="obs",
 )
+CRITPATH = _k(
+    "NICE_TPU_CRITPATH", "bool", True,
+    "Fleet critical-path engine: per-field latency waterfalls + dominant-"
+    "segment classification served at GET /critpath and re-evaluated on"
+    " every observatory beat.",
+    owner="obs/critpath.py", group="obs",
+)
+CRITPATH_TOLERANCE = _k(
+    "NICE_TPU_CRITPATH_TOLERANCE", "float", 0.15,
+    "Reconciliation tolerance as a fraction of end-to-end wall-clock:"
+    " a waterfall whose |wall - sum(segments)| exceeds"
+    " max(fraction * wall, 0.25s) is reported as unreconciled (the residual"
+    " is always visible in the unaccounted segment either way).",
+    owner="obs/critpath.py", group="obs",
+)
+CRITPATH_WINDOW_FIELDS = _k(
+    "NICE_TPU_CRITPATH_WINDOW_FIELDS", "int", 200,
+    "How many recently canon-promoted fields the fleet-wide per-segment"
+    " p50/p95 aggregation reads.",
+    owner="obs/critpath.py", group="obs",
+)
+CRITPATH_SHIFT_RATIO = _k(
+    "NICE_TPU_CRITPATH_SHIFT_RATIO", "float", 0.25,
+    "Dominant-segment share change (absolute fraction of total) that"
+    " counts as a bottleneck shift: emits the bottleneck_shift flight"
+    " event and a critpath stream event.",
+    owner="obs/critpath.py", group="obs",
+)
+STREAM_QUEUE = _k(
+    "NICE_TPU_STREAM_QUEUE", "int", 256,
+    "Per-subscriber event-queue capacity for GET /events/stream; a full"
+    " queue drops the oldest events (counted per subscriber and fleet-"
+    "wide).",
+    owner="obs/stream.py", group="obs",
+)
+STREAM_HEARTBEAT_SECS = _k(
+    "NICE_TPU_STREAM_HEARTBEAT_SECS", "float", 15.0,
+    "SSE heartbeat cadence: an idle stream still writes one heartbeat"
+    " event per interval (liveness signal + disconnect detection bound).",
+    owner="obs/stream.py", group="obs",
+)
+STREAM_MAX_SUBSCRIBERS = _k(
+    "NICE_TPU_STREAM_MAX_SUBSCRIBERS", "int", 64,
+    "Concurrent GET /events/stream subscribers; past the cap new"
+    " subscriptions get 503 (the dashboard falls back to polling).",
+    owner="obs/stream.py", group="obs",
+)
+STREAM_MAX_DROPS = _k(
+    "NICE_TPU_STREAM_MAX_DROPS", "int", 1024,
+    "Slow-consumer eviction threshold: a subscriber that has dropped this"
+    " many events is disconnected (it can resume via Last-Event-ID).",
+    owner="obs/stream.py", group="obs",
+)
 
 # -- chaos / fault injection -----------------------------------------------
 FAULTS = _k(

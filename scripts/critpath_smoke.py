@@ -1,0 +1,481 @@
+"""Critical-path smoke check: a deliberately stalled writer actor must show
+up as the dominant ``writer_wait`` segment in reconciled per-field
+waterfalls, while the SSE stream delivers the same run live.
+
+Runs a real server subprocess on the async core with a 1 s history cadence
+and ``NICE_TPU_FAULTS="writer.batch:<stall>"`` — every writer batch sleeps
+*before* ``t_begin`` is stamped, so the injected stall lands in the
+actor-measured per-op queue wait (the ``writer_wait`` segment is measured
+at the source, not inferred from endpoint latency). Then:
+
+  1. connect a Server-Sent-Events probe to GET /events/stream (it must
+     say hello, then carry the run's journal events live);
+  2. seed a 3-field base AFTER the server is listening (seeding first
+     would book the multi-second server boot into queue_wait and swamp
+     the stall we are trying to attribute);
+  3. run three concurrent in-process clients through the public API:
+     claim detailed -> scalar-oracle submit -> canon, then POST the
+     buffered client trace events (claim/submit round-trips) via
+     /telemetry so the server can merge them into the timelines;
+  4. GET /critpath must report all 3 fields with reconciled waterfalls
+     (|residual| <= tolerance) whose dominant segment — per field and
+     fleet-wide — is writer_wait, at >= one injected stall each, and the
+     writer_wait share gauge must be live in /metrics;
+  5. resume the stream from a mid-run cursor (?since=<id>, the same
+     durable cursor /events?since= uses) — the replay must contain every
+     journal id after the cursor exactly once (no duplicates, no holes).
+
+Artifact: critpath.json (the /critpath snapshot + stream probe stats) in
+the workdir. Prints ONE JSON line. Usage:
+
+    python scripts/critpath_smoke.py [workdir]
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 10  # [47, 100) -> 3 fields at field_size=20
+FIELD_SIZE = 20
+CLIENTS = 3
+STALL_SECS = 0.4
+POLL_SECS = 0.25
+MERGE_WAIT_SECS = 30.0
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_server(db_path: str, port: int, log_path: str, env: dict):
+    logf = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "nice_tpu.server",
+            "--db", db_path, "--host", "127.0.0.1", "--port", str(port),
+        ],
+        stdout=logf, stderr=subprocess.STDOUT, env=env,
+    )
+    return proc, logf
+
+
+def _wait_listening(port: int, proc, timeout: float = 30) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return False
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_text(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+class StreamProbe(threading.Thread):
+    """Background SSE reader: parses id/event/data frames off a live
+    GET /events/stream connection until stopped. The 2 s server heartbeat
+    keeps the socket read from ever blocking near the urlopen timeout."""
+
+    def __init__(self, url: str, name: str = "critpath-smoke-stream"):
+        super().__init__(name=name, daemon=True)
+        self.url = url
+        self.frames: list = []  # (id_str_or_None, event, data_str)
+        self.heartbeats = 0
+        self.error = None
+        self._halt = threading.Event()
+        self._resp = None
+
+    def run(self):
+        try:
+            self._resp = urllib.request.urlopen(self.url, timeout=30)
+            cur = {"id": None, "event": "message", "data": []}
+            for raw in self._resp:
+                if self._halt.is_set():
+                    break
+                line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                if not line:
+                    if cur["data"]:
+                        self.frames.append(
+                            (cur["id"], cur["event"], "\n".join(cur["data"]))
+                        )
+                    cur = {"id": None, "event": "message", "data": []}
+                elif line.startswith(":"):
+                    self.heartbeats += 1
+                elif line.startswith("id:"):
+                    cur["id"] = line[3:].strip()
+                elif line.startswith("event:"):
+                    cur["event"] = line[6:].strip()
+                elif line.startswith("data:"):
+                    cur["data"].append(line[5:].strip())
+        except Exception as exc:  # noqa: BLE001 — reported via self.error
+            if not self._halt.is_set():
+                self.error = repr(exc)
+
+    def stop(self):
+        self._halt.set()
+        try:
+            if self._resp is not None:
+                self._resp.close()
+        except Exception:  # noqa: BLE001 — teardown only
+            pass
+
+    def events(self, name: str) -> list:
+        return [f for f in self.frames if f[1] == name]
+
+    def journal_ids(self) -> list:
+        out = []
+        for fid, _, _ in self.events("journal"):
+            try:
+                out.append(int(fid))
+            except (TypeError, ValueError):
+                pass
+        return out
+
+    def journal_kinds(self) -> set:
+        kinds = set()
+        for _, _, data in self.events("journal"):
+            try:
+                kinds.add(json.loads(data).get("kind"))
+            except (ValueError, TypeError):
+                pass
+        return kinds
+
+
+def _claim(api_base: str):
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import SearchMode
+
+    return api_client.get_field_from_server(
+        SearchMode.DETAILED, api_base, "critpath-smoke", max_retries=2
+    )
+
+
+def _submit(api_base: str, data) -> dict:
+    """Scalar-oracle submission (no jax): same payload shape + submit_id
+    derivation as client/main.py compile_results."""
+    from nice_tpu.client import api_client
+    from nice_tpu.core.types import DataToServer, FieldSize
+    from nice_tpu.ops import scalar
+
+    results = scalar.process_range_detailed(
+        FieldSize(data.range_start, data.range_end), data.base
+    )
+    payload = DataToServer(
+        claim_id=data.claim_id,
+        username="critpath-smoke",
+        client_version="critpath-smoke",
+        unique_distribution=list(results.distribution),
+        nice_numbers=list(results.nice_numbers),
+    )
+    content = json.dumps(payload.to_json(), sort_keys=True).encode()
+    payload.submit_id = (
+        f"{data.claim_id}-{hashlib.sha256(content).hexdigest()[:16]}"
+    )
+    return api_client.submit_field_to_server(api_base, payload, max_retries=2)
+
+
+def _client_worker(api_base: str, idx: int, results: list):
+    try:
+        claim = _claim(api_base)
+        _submit(api_base, claim)
+        results[idx] = {"field_ok": True, "claim_id": claim.claim_id}
+    except Exception as exc:  # noqa: BLE001 — collected into failures
+        results[idx] = {"error": repr(exc)}
+
+
+def _wait_timelines_merged(api_base: str, field_ids: list, failures: list):
+    """Block until every field's timeline shows canon plus the merged
+    client round-trip events (delivered asynchronously via /telemetry)."""
+    want = {"canon_promoted", "client_claim_rtt", "client_submit_rtt"}
+    deadline = time.monotonic() + MERGE_WAIT_SECS
+    pending = set(field_ids)
+    while pending and time.monotonic() < deadline:
+        for fid in sorted(pending):
+            tl = _get(f"{api_base}/fields/{fid}/timeline")
+            kinds = {e.get("kind") for e in tl.get("events", [])}
+            if want <= kinds:
+                pending.discard(fid)
+        if pending:
+            time.sleep(POLL_SECS)
+    for fid in sorted(pending):
+        failures.append(
+            f"field {fid}: client events never merged into timeline"
+        )
+
+
+def _check_critpath(api_base: str, failures: list) -> dict:
+    """Poll /critpath (2 s snapshot cache) until it covers all fields,
+    then assert reconciliation + writer_wait dominance."""
+    snap = {}
+    deadline = time.monotonic() + MERGE_WAIT_SECS
+    while time.monotonic() < deadline:
+        snap = _get(f"{api_base}/critpath?fields={CLIENTS * 2}")
+        if snap.get("fields", 0) >= CLIENTS:
+            break
+        time.sleep(POLL_SECS)
+    if snap.get("fields", 0) != CLIENTS:
+        failures.append(
+            f"/critpath covered {snap.get('fields')} fields, "
+            f"expected {CLIENTS}"
+        )
+        return snap
+    if snap.get("unreconciled_fields"):
+        failures.append(
+            f"unreconciled fields: {snap['unreconciled_fields']}"
+        )
+    if snap.get("dominant") != "writer_wait":
+        failures.append(
+            f"fleet dominant segment is {snap.get('dominant')!r}, "
+            "expected writer_wait (injected writer stall)"
+        )
+    for w in snap.get("waterfalls", []):
+        fid = w.get("field_id")
+        if not w.get("reconciled"):
+            failures.append(
+                f"field {fid}: waterfall residual {w.get('residual_secs')}s "
+                f"exceeds tolerance {w.get('tolerance_secs')}s"
+            )
+        if w.get("dominant") != "writer_wait":
+            failures.append(
+                f"field {fid}: dominant {w.get('dominant')!r}, "
+                "expected writer_wait"
+            )
+        ww = (w.get("segments") or {}).get("writer_wait", 0.0)
+        if ww < STALL_SECS:
+            failures.append(
+                f"field {fid}: writer_wait {ww}s < one injected "
+                f"stall ({STALL_SECS}s)"
+            )
+    return snap
+
+
+def _check_metrics(api_base: str, failures: list):
+    """The history tick (1 s cadence) must have published the share gauge."""
+    share = None
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        for line in _get_text(f"{api_base}/metrics").splitlines():
+            if line.startswith(
+                'nice_critpath_segment_share{segment="writer_wait"}'
+            ):
+                try:
+                    share = float(line.rsplit(None, 1)[-1])
+                except ValueError:
+                    share = None
+        if share:
+            return share
+        time.sleep(POLL_SECS)
+    failures.append(
+        f"nice_critpath_segment_share{{writer_wait}} never went live "
+        f"(last read: {share})"
+    )
+    return share
+
+
+def _check_resume(api_base: str, failures: list) -> dict:
+    """Reconnect from a mid-run cursor: the replay must carry every journal
+    id after the cursor exactly once — the durable-cursor resume contract
+    fleet.html's Last-Event-ID reconnects rely on."""
+    feed = _get(f"{api_base}/events?since=0&limit=1000")
+    all_ids = [e["id"] for e in feed.get("events", [])]
+    if len(all_ids) < 4:
+        failures.append(f"journal too short to test resume ({len(all_ids)})")
+        return {"journal_rows": len(all_ids)}
+    mid = all_ids[len(all_ids) // 2]
+    expected = [i for i in all_ids if i > mid]
+    probe = StreamProbe(
+        f"{api_base}/events/stream?since={mid}",
+        name="critpath-smoke-resume",
+    )
+    probe.start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if len(probe.journal_ids()) >= len(expected):
+            break
+        time.sleep(POLL_SECS)
+    probe.stop()
+    probe.join(timeout=5)
+    seen = probe.journal_ids()
+    stats = {"cursor": mid, "expected": len(expected), "replayed": len(seen)}
+    if len(seen) != len(set(seen)):
+        failures.append(f"resume replayed duplicate journal ids: {seen}")
+    if [i for i in seen if i <= mid]:
+        failures.append(f"resume re-sent ids at/before cursor {mid}")
+    missing = set(expected) - set(seen)
+    if missing:
+        failures.append(
+            f"resume missed journal ids {sorted(missing)} after cursor {mid}"
+        )
+    return stats
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    if len(sys.argv) > 1:
+        workdir = sys.argv[1]
+        os.makedirs(workdir, exist_ok=True)
+        cleanup = False
+    else:
+        workdir = tempfile.mkdtemp(prefix="critpath-smoke-")
+        cleanup = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    env = dict(
+        os.environ,
+        NICE_TPU_SERVER_CORE="async",
+        NICE_TPU_HISTORY_SECS="1",
+        NICE_TPU_STREAM_HEARTBEAT_SECS="2",
+        NICE_TPU_FAULTS=f"writer.batch:{STALL_SECS}",
+    )
+    db_path = os.path.join(workdir, "critpath.db")
+    port = _pick_port()
+    api_base = f"http://127.0.0.1:{port}"
+    server_log = os.path.join(workdir, "server.log")
+    server, logf = _start_server(db_path, port, server_log, env)
+
+    failures: list = []
+    line = {"workdir": workdir, "stall_secs": STALL_SECS}
+    probe = StreamProbe(f"{api_base}/events/stream?since=0")
+    try:
+        if not _wait_listening(port, server):
+            failures.append("server never listened")
+            raise RuntimeError
+
+        # Live probe first, seed second: everything the run journals from
+        # here on must arrive over the stream as it happens, not via replay.
+        probe.start()
+
+        # Seed AFTER the server is up (WAL + busy_timeout make the
+        # cross-process write safe; the claim path falls back to a direct
+        # pool scan when the pre-claim queue was built before the seed).
+        from nice_tpu.server.db import Db
+
+        db = Db(db_path)
+        db.seed_base(BASE, field_size=FIELD_SIZE)
+        field_ids = [f.field_id for f in db.get_fields_in_base(BASE)]
+        db.close()
+        line["fields"] = len(field_ids)
+
+        results: list = [None] * CLIENTS
+        workers = [
+            threading.Thread(
+                target=_client_worker,
+                args=(api_base, i, results),
+                name=f"critpath-smoke-client-{i}",
+            )
+            for i in range(CLIENTS)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=120)
+        for i, res in enumerate(results):
+            if not res or "error" in res:
+                failures.append(f"client {i} failed: {res}")
+        if failures:
+            raise RuntimeError
+
+        # Deliver the buffered client trace events (claim/submit RTTs)
+        # the same way a real client does: POST /telemetry.
+        from nice_tpu.client import api_client
+        from nice_tpu.obs import telemetry
+
+        api_client.post_telemetry(
+            api_base,
+            telemetry.snapshot(
+                username="critpath-smoke", client_version="critpath-smoke"
+            ),
+            max_retries=2,
+        )
+
+        _wait_timelines_merged(api_base, field_ids, failures)
+        snap = _check_critpath(api_base, failures)
+        line["critpath_dominant"] = snap.get("dominant")
+        line["writer_wait_p50"] = (
+            (snap.get("segments") or {}).get("writer_wait") or {}
+        ).get("p50")
+        line["writer_wait_share"] = _check_metrics(api_base, failures)
+
+        # The live probe must have said hello and carried the run's
+        # lifecycle as it happened (canon_promoted journaled after the
+        # probe connected -> it arrived via push, not replay).
+        if probe.error:
+            failures.append(f"stream probe error: {probe.error}")
+        if not probe.events("hello"):
+            failures.append("stream never sent the hello frame")
+        live_kinds = probe.journal_kinds()
+        for kind in ("claimed", "submit_accepted", "canon_promoted"):
+            if kind not in live_kinds:
+                failures.append(
+                    f"stream never carried a live {kind!r} journal event "
+                    f"(saw {sorted(k for k in live_kinds if k)})"
+                )
+        line["stream"] = {
+            "journal_events": len(probe.events("journal")),
+            "heartbeats": probe.heartbeats,
+            "kinds": sorted(k for k in live_kinds if k),
+        }
+        line["resume"] = _check_resume(api_base, failures)
+
+        with open(os.path.join(workdir, "critpath.json"), "w") as f:
+            json.dump(
+                {
+                    "base": BASE,
+                    "stall_secs": STALL_SECS,
+                    "critpath": snap,
+                    "stream": line.get("stream"),
+                    "resume": line.get("resume"),
+                    "failures": failures,
+                },
+                f, indent=2,
+            )
+    except RuntimeError:
+        pass
+    except Exception as exc:  # noqa: BLE001 — smoke must always print
+        failures.append(f"unexpected: {exc!r}")
+    finally:
+        probe.stop()
+        if server.poll() is None:
+            server.terminate()
+            try:
+                server.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                server.kill()
+                server.wait(timeout=15)
+        logf.close()
+        probe.join(timeout=5)
+
+    line["ok"] = not failures
+    line["failures"] = failures
+    line["elapsed_secs"] = round(time.monotonic() - t_start, 1)
+    print(json.dumps(line), flush=True)
+    if cleanup and not failures:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

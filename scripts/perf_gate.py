@@ -329,16 +329,18 @@ def _baseline_platform(bench: dict) -> str:
 
 def _latest_bench_baseline(platform: str):
     """Newest committed BENCH_r*.json with a parseable suite from the SAME
-    backend — cross-backend diffs (TPU baseline vs CPU CI) are meaningless."""
+    backend — cross-backend diffs (TPU baseline vs CPU CI) are meaningless.
+    Returns (name, parsed headline) — the headline carries the suite plus,
+    from rounds with the profiler on, the critpath dominant-segment block."""
     for path in sorted(glob.glob(str(ROOT / "BENCH_r*.json")), reverse=True):
         try:
             bench = json.loads(Path(path).read_text())
         except (OSError, ValueError):
             continue
-        suite = (bench.get("parsed") or {}).get("suite")
-        if not suite or _baseline_platform(bench) != platform:
+        parsed = bench.get("parsed") or {}
+        if not parsed.get("suite") or _baseline_platform(bench) != platform:
             continue
-        return os.path.basename(path), suite
+        return os.path.basename(path), parsed
     return None, None
 
 
@@ -363,22 +365,25 @@ def run_bench_gate(report: dict, problems: list, budget: int) -> None:
         NICE_BENCH_PLATFORM=platform,
         NICE_BENCH_SUITE="default:detailed,msd-ineffective:niceonly",
         NICE_BENCH_BUDGET=str(budget),
+        # Profiler on so the fresh headline carries a critpath block (the
+        # dominant-segment shares diffed against the committed baseline).
+        NICE_TPU_STEPPROF="1",
     )
     env.pop("NICE_BENCH_T0", None)
     proc = subprocess.run(
         [sys.executable, "bench.py"],
         cwd=ROOT, env=env, capture_output=True, text=True, timeout=budget * 4,
     )
-    suite = None
+    headline = None
     for line in reversed(proc.stdout.splitlines()):
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
         if isinstance(parsed, dict) and "suite" in parsed:
-            suite = parsed["suite"]
+            headline = parsed
             break
-    if proc.returncode != 0 or suite is None:
+    if proc.returncode != 0 or headline is None:
         problems.append(
             f"gate bench run failed (rc={proc.returncode}); "
             f"tail: {proc.stdout[-300:]!r}"
@@ -386,10 +391,12 @@ def run_bench_gate(report: dict, problems: list, budget: int) -> None:
         gate["error"] = f"rc={proc.returncode}"
         return
 
+    suite = headline["suite"]
+    baseline_suite = baseline.get("suite") or {}
     gate["fresh_suite"] = suite
     gate["cases"] = {}
     for case, new in suite.items():
-        old = baseline.get(case)
+        old = baseline_suite.get(case)
         if not old or old.get("skipped") or new.get("skipped"):
             continue
         old_v, new_v = float(old["value"]), float(new["value"])
@@ -407,6 +414,55 @@ def run_bench_gate(report: dict, problems: list, budget: int) -> None:
                 f"numbers/sec/chip ({drop:.0%} drop > "
                 f"{REGRESSION_TOLERANCE:.0%})"
             )
+    _critpath_diff(gate, problems, baseline, headline)
+
+
+def _critpath_diff(
+    gate: dict, problems: list, baseline: dict, headline: dict
+) -> None:
+    """Diff the bench critpath dominant-segment shares between rounds: a
+    segment whose share of wall moved by more than REGRESSION_TOLERANCE
+    (absolute) means the workload's bottleneck shifted — the throughput
+    number alone can hide that (e.g. compute got faster while feed stalls
+    grew to fill the gap)."""
+    block = gate["critpath"] = {}
+    new_cp = headline.get("critpath")
+    if not new_cp:
+        block["note"] = (
+            "fresh run produced no critpath summary (profiler recorded no "
+            "wall); shift diff skipped"
+        )
+        return
+    block["current"] = new_cp
+    old_cp = baseline.get("critpath")
+    if not old_cp:
+        block["note"] = (
+            "baseline round has no critpath block; shift diff starts with "
+            "the next committed bench record"
+        )
+        return
+    block["baseline"] = old_cp
+    old_shares = old_cp.get("shares") or {}
+    new_shares = new_cp.get("shares") or {}
+    shifts = {}
+    for seg in sorted(set(old_shares) | set(new_shares)):
+        a = float(old_shares.get(seg, 0.0))
+        b = float(new_shares.get(seg, 0.0))
+        if abs(b - a) > REGRESSION_TOLERANCE:
+            shifts[seg] = {"baseline": round(a, 4), "current": round(b, 4)}
+    block["shifted_segments"] = shifts
+    dominant_changed = old_cp.get("dominant") != new_cp.get("dominant")
+    block["dominant"] = {
+        "baseline": old_cp.get("dominant"),
+        "current": new_cp.get("dominant"),
+        "changed": dominant_changed,
+    }
+    for seg, move in shifts.items():
+        problems.append(
+            f"critpath segment {seg} share moved "
+            f"{move['baseline']:.0%} -> {move['current']:.0%} "
+            f"(> {REGRESSION_TOLERANCE:.0%} shift vs baseline)"
+        )
 
 
 def run_load_gate(report: dict, problems: list) -> None:

@@ -1,0 +1,215 @@
+"""Critical-path attribution tests: waterfall composition + overlap
+subtraction, reconciliation verdicts, the stepprof phase fold, the fleet
+rollup, and the engine's bottleneck-shift detection."""
+
+from datetime import timedelta, timezone
+
+import pytest
+
+from nice_tpu.obs import critpath
+from nice_tpu.server.db import now_utc, ts
+
+T0 = now_utc().replace(microsecond=0, tzinfo=timezone.utc)
+
+
+def _evt(kind, offset_secs, field_id=1, **detail):
+    return {
+        "field_id": field_id,
+        "kind": kind,
+        "ts": ts(T0 + timedelta(seconds=offset_secs)),
+        "detail": detail or None,
+    }
+
+
+def _canon_timeline(**overrides):
+    """A well-formed 6 s end-to-end timeline whose segments account for
+    (nearly) the whole wall clock. Layout:
+
+      t=0  generated
+      t=2  claimed        (writer_wait 0.5 stamped at the actor)
+           client_claim_rtt 1.0   (contains the 0.5 writer wait)
+      t=5  submit_accepted (writer_wait 0.8)
+           client_submit_rtt 1.2  (contains the 0.8 writer wait)
+           client_phases: device_compute 1.5, h2d_feed 0.2
+      t=6  canon_promoted
+    """
+    vals = {
+        "claim_writer_wait": 0.5,
+        "submit_writer_wait": 0.8,
+        "claim_rtt": 1.0,
+        "submit_rtt": 1.2,
+        "device_compute": 1.5,
+        "h2d_feed": 0.2,
+    }
+    vals.update(overrides)
+    return [
+        _evt("generated", 0),
+        _evt("claimed", 2, writer_wait=vals["claim_writer_wait"]),
+        _evt("client_claim_rtt", 2, secs=vals["claim_rtt"]),
+        _evt("submit_accepted", 5, writer_wait=vals["submit_writer_wait"]),
+        _evt("client_submit_rtt", 5, secs=vals["submit_rtt"]),
+        _evt("client_phases", 5,
+             device_compute=vals["device_compute"],
+             h2d_feed=vals["h2d_feed"]),
+        _evt("canon_promoted", 6),
+    ]
+
+
+def test_waterfall_none_without_canon():
+    events = [_evt("generated", 0), _evt("claimed", 1)]
+    assert critpath.field_waterfall(events) is None
+    assert critpath.field_waterfall([]) is None
+
+
+def test_waterfall_overlap_subtraction_and_reconciliation():
+    w = critpath.field_waterfall(_canon_timeline(), tolerance_frac=0.15)
+    assert w is not None
+    seg = w["segments"]
+    # queue_wait: generated->claimed is 2 s, minus the in-flight claim
+    # round-trip overlap max(claim_rtt=1.0, w_claim=0.5) = 1.0.
+    assert seg["queue_wait"] == pytest.approx(1.0)
+    # Client RTTs shed the writer waits they contain; the waits live in
+    # writer_wait (measured at the actor).
+    assert seg["claim_rtt"] == pytest.approx(0.5)
+    assert seg["submit_rtt"] == pytest.approx(0.4)
+    assert seg["writer_wait"] == pytest.approx(1.3)
+    assert seg["canon_promotion"] == pytest.approx(1.0)
+    assert seg["device_compute"] == pytest.approx(1.5)
+    assert seg["h2d_feed"] == pytest.approx(0.2)
+    # wall 6.0 vs accounted 5.9 -> 0.1 residual, inside
+    # max(MIN_TOLERANCE_SECS, 0.15 * 6.0) = 0.9.
+    assert w["wall_secs"] == pytest.approx(6.0)
+    assert seg["unaccounted"] == pytest.approx(0.1)
+    assert w["reconciled"] is True
+    assert w["dominant"] == "device_compute"
+
+
+def test_waterfall_writer_stall_dominates():
+    # An injected writer stall shows up in the actor-measured waits, not
+    # as inflated round-trips: the RTTs that contain it are clamped to 0.
+    w = critpath.field_waterfall(
+        _canon_timeline(
+            claim_writer_wait=1.4, submit_writer_wait=1.6,
+            claim_rtt=1.5, submit_rtt=1.7,
+        ),
+        tolerance_frac=0.15,
+    )
+    seg = w["segments"]
+    assert seg["writer_wait"] == pytest.approx(3.0)
+    assert seg["claim_rtt"] == pytest.approx(0.1)
+    assert seg["submit_rtt"] == pytest.approx(0.1)
+    assert w["dominant"] == "writer_wait"
+
+
+def test_waterfall_overcounted_segments_fail_reconciliation():
+    # A claim RTT wildly exceeding the wall clock drives the residual
+    # negative past tolerance: flagged, never hidden (unaccounted stays 0,
+    # the signed residual carries the evidence).
+    w = critpath.field_waterfall(
+        _canon_timeline(claim_rtt=30.0), tolerance_frac=0.15
+    )
+    assert w["segments"]["unaccounted"] == 0.0
+    assert w["residual_secs"] < -1.0
+    assert w["reconciled"] is False
+
+
+def test_phase_shares_folds_stepprof_buckets():
+    prof = {
+        "detailed|b10|cpu": {
+            "wall": 10.0, "device_compute": 4.0, "compile": 1.0,
+            "h2d_feed": 2.0, "fold": 0.5, "readback": 0.5,
+        },
+        "junk": "not-a-dict",
+    }
+    out = critpath.phase_shares(prof)
+    assert out["wall_secs"] == pytest.approx(10.0)
+    # compile folds into device_compute, fold into readback.
+    assert out["shares"]["device_compute"] == pytest.approx(0.5)
+    assert out["shares"]["readback"] == pytest.approx(0.1)
+    assert out["shares"]["h2d_feed"] == pytest.approx(0.2)
+    assert out["shares"]["unaccounted"] == pytest.approx(0.2)
+    assert out["dominant"] == "device_compute"
+    assert critpath.phase_shares({}) is None
+    assert critpath.phase_shares({"m": {"wall": 0.0}}) is None
+
+
+def test_aggregate_rollup_shares_and_unreconciled():
+    good = critpath.field_waterfall(_canon_timeline(), tolerance_frac=0.15)
+    bad = critpath.field_waterfall(
+        [dict(e, field_id=2) for e in _canon_timeline(claim_rtt=30.0)],
+        tolerance_frac=0.15,
+    )
+    agg = critpath.aggregate([good, bad])
+    assert agg["fields"] == 2
+    assert agg["total_wall_secs"] == pytest.approx(12.0)
+    assert agg["unreconciled_fields"] == [2]
+    shares = {s: agg["segments"][s]["share"] for s in critpath.SEGMENTS}
+    assert sum(shares.values()) > 0
+    # The overcounted claim_rtt dominates the pooled wall.
+    assert agg["dominant"] == "claim_rtt"
+    assert agg["segments"]["claim_rtt"]["p95"] >= \
+        agg["segments"]["claim_rtt"]["p50"]
+
+
+class _FakeWriter:
+    def __init__(self):
+        self._busy = [(0.0, 0.0), (8.0, 10.0)]
+        self._i = 0
+
+    def busy_stats(self):
+        stats = self._busy[min(self._i, len(self._busy) - 1)]
+        self._i += 1
+        return stats
+
+
+class _FakeDb:
+    def __init__(self):
+        self.timelines = {}
+
+    def get_recent_canon_fields(self, limit):
+        return sorted(self.timelines)[:limit]
+
+    def get_field_timeline(self, fid):
+        return self.timelines[fid]
+
+    def get_fleet_phase_totals(self, active_secs=900.0):
+        return {"wall": 10.0, "device_compute": 4.0, "compile": 1.0,
+                "h2d_feed": 2.0}
+
+
+def test_engine_detects_bottleneck_shift():
+    db = _FakeDb()
+    events = []
+    eng = critpath.CritpathEngine(
+        db, writer=_FakeWriter(),
+        on_event=lambda kind, payload: events.append((kind, payload)),
+    )
+    # Round 1: device_compute dominates. First evaluation establishes the
+    # baseline — no shift event yet.
+    db.timelines[1] = _canon_timeline()
+    assert eng.evaluate() is None
+    assert events == []
+
+    # Round 2: the writer stalls; dominance flips to writer_wait.
+    db.timelines[1] = _canon_timeline(
+        claim_writer_wait=1.4, submit_writer_wait=1.6,
+        claim_rtt=1.5, submit_rtt=1.7,
+    )
+    shift = eng.evaluate()
+    assert shift is not None
+    assert shift["previous"] == "device_compute"
+    assert shift["dominant"] == "writer_wait"
+    assert "writer_wait" in shift["moved_segments"]
+    assert events and events[0][0] == "critpath"
+    # Utilization: busy fraction diffs consecutive samples (8/10), device
+    # busy folds compile into compute (5/10), feed idle 2/10.
+    snap = eng.snapshot(max_age_secs=0.0)
+    assert snap["utilization"]["writer_busy"] == pytest.approx(0.8)
+    assert snap["utilization"]["device_busy"] == pytest.approx(0.5)
+    assert snap["utilization"]["feed_idle"] == pytest.approx(0.2)
+
+
+def test_engine_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_CRITPATH", "0")
+    eng = critpath.CritpathEngine(_FakeDb())
+    assert eng.evaluate() is None

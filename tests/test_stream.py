@@ -1,0 +1,214 @@
+"""SSE stream hub + responder tests: wire framing, bounded-queue lag
+accounting, slow-consumer eviction, the subscriber cap, heartbeat cadence,
+and the Last-Event-ID resume contract (replay-then-live with no duplicate
+and no missing journal ids, even when publishes race the replay)."""
+
+import asyncio
+import contextlib
+import json
+
+from nice_tpu.obs import stream
+
+
+# -- framing ----------------------------------------------------------------
+
+
+def test_sse_frame_carries_journal_id_and_event_name():
+    frame = stream.sse_frame(
+        stream.StreamEvent("journal", {"kind": "claimed"}, event_id=42)
+    ).decode()
+    assert frame == 'id: 42\nevent: journal\ndata: {"kind":"claimed"}\n\n'
+    # Non-journal events carry no id: they are not resume cursors.
+    hello = stream.sse_frame(
+        stream.StreamEvent("hello", {"cursor": 0})
+    ).decode()
+    assert hello.startswith("event: hello\n")
+    assert "id:" not in hello
+
+
+# -- hub: bounded queues, drops, eviction, cap ------------------------------
+
+
+def test_publish_never_grows_a_full_queue(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_STREAM_QUEUE", "4")
+    monkeypatch.setenv("NICE_TPU_STREAM_MAX_DROPS", "100")
+    hub = stream.StreamHub()
+    sub = hub.subscribe()
+    for i in range(10):
+        hub.publish("journal", {"i": i}, event_id=i + 1)
+    assert len(sub.queue) == 4
+    assert sub.dropped == 6
+    assert not sub.evicted
+    # The oldest events dropped first: the survivors are the newest four.
+    assert [e.event_id for e in sub.pop_all()] == [7, 8, 9, 10]
+
+
+def test_slow_consumer_evicted_past_max_drops(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_STREAM_QUEUE", "2")
+    monkeypatch.setenv("NICE_TPU_STREAM_MAX_DROPS", "3")
+    hub = stream.StreamHub()
+    sub = hub.subscribe()
+    for i in range(5):  # 2 buffered + 3 drops -> eviction threshold
+        hub.publish("anomaly", {"i": i})
+    assert sub.dropped == 3
+    assert sub.evicted
+    # Evicted subscribers stop accumulating entirely.
+    hub.publish("anomaly", {"i": 99})
+    assert sub.dropped == 3
+
+
+def test_subscriber_cap(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_STREAM_MAX_SUBSCRIBERS", "2")
+    hub = stream.StreamHub()
+    a, b = hub.subscribe(), hub.subscribe()
+    assert a is not None and b is not None
+    assert hub.subscribe() is None
+    hub.unsubscribe(a)
+    assert hub.subscribe() is not None
+    assert hub.subscriber_count() == 2
+
+
+def test_publish_suppresses_ids_covered_by_replay_cursor():
+    hub = stream.StreamHub()
+    sub = hub.subscribe()
+    sub.last_sent_id = 10
+    hub.publish("journal", {"k": "old"}, event_id=5)
+    hub.publish("journal", {"k": "new"}, event_id=11)
+    hub.publish("slo", {"k": "non-journal"})  # no id -> always delivered
+    assert [e.event_id for e in sub.pop_all()] == [11, None]
+
+
+# -- responder: replay, hello, live, heartbeat, lag -------------------------
+
+
+class _FakeWriter:
+    """Collects the responder's frames; drain() yields to the loop."""
+
+    def __init__(self):
+        self.buf = b""
+
+    def write(self, data: bytes):
+        self.buf += data
+
+    async def drain(self):
+        await asyncio.sleep(0)
+
+    def frames(self):
+        """Parse the SSE byte stream into (id, event, data) tuples;
+        comment frames count separately as heartbeats."""
+        out, heartbeats = [], 0
+        for block in self.buf.decode().split("\n\n"):
+            if not block:
+                continue
+            if block.startswith(":"):
+                heartbeats += 1
+                continue
+            fid, event, data = None, "message", []
+            for line in block.splitlines():
+                if line.startswith("id:"):
+                    fid = int(line[3:].strip())
+                elif line.startswith("event:"):
+                    event = line[6:].strip()
+                elif line.startswith("data:"):
+                    data.append(line[5:].strip())
+            out.append((fid, event, "\n".join(data)))
+        return out, heartbeats
+
+
+async def _run_responder(hub, replay, since, scenario, heartbeat=None,
+                         monkeypatch=None):
+    if heartbeat is not None:
+        monkeypatch.setenv("NICE_TPU_STREAM_HEARTBEAT_SECS", str(heartbeat))
+    writer = _FakeWriter()
+    respond = stream.make_sse_responder(hub, replay, since)
+    task = asyncio.ensure_future(respond(writer))
+    try:
+        await scenario(writer, task)
+    finally:
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+    return writer
+
+
+def _journal_rows(lo, hi):
+    return [{"id": i, "kind": "claimed", "field_id": i} for i in
+            range(lo, hi + 1)]
+
+
+def test_resume_replays_then_goes_live_no_dup_no_miss(monkeypatch):
+    """since=2 over a 5-row journal: rows 3..5 replay from the table, the
+    hello carries the advanced cursor, then live publishes 6..7 arrive —
+    and a racing re-publish of replayed ids is suppressed twice over."""
+    hub = stream.StreamHub()
+    table = _journal_rows(1, 5)
+
+    def replay(since, limit):
+        return [r for r in table if r["id"] > since][:limit]
+
+    async def scenario(writer, task):
+        await asyncio.sleep(0.05)  # replay + hello
+        # Race: the publisher re-announces replayed ids and new ones.
+        for row in _journal_rows(4, 7):
+            hub.publish("journal", row, event_id=row["id"])
+        await asyncio.sleep(0.05)  # drain
+
+    writer = asyncio.run(
+        _run_responder(hub, replay, 2, scenario, heartbeat=30,
+                       monkeypatch=monkeypatch)
+    )
+    frames, _ = writer.frames()
+    journal_ids = [f[0] for f in frames if f[1] == "journal"]
+    assert journal_ids == [3, 4, 5, 6, 7]  # no dup, no miss, in order
+    hellos = [f for f in frames if f[1] == "hello"]
+    assert len(hellos) == 1
+    assert json.loads(hellos[0][2])["cursor"] == 5
+    # Clean teardown unsubscribed the consumer.
+    assert hub.subscriber_count() == 0
+
+
+def test_heartbeats_bound_silence(monkeypatch):
+    hub = stream.StreamHub()
+
+    async def scenario(writer, task):
+        await asyncio.sleep(0.5)
+
+    writer = asyncio.run(
+        _run_responder(hub, None, 0, scenario, heartbeat=0.12,
+                       monkeypatch=monkeypatch)
+    )
+    frames, heartbeats = writer.frames()
+    assert [f[1] for f in frames] == ["hello"]
+    assert heartbeats >= 2  # ~4 intervals in 0.5 s; timing slack for CI
+
+
+def test_lagged_event_reports_gap_and_eviction_closes(monkeypatch):
+    """Overflow a tiny queue while the consumer sleeps: on drain it must
+    learn about the gap (lagged event with the drop count) and, once past
+    the eviction threshold, the responder must close the connection."""
+    monkeypatch.setenv("NICE_TPU_STREAM_QUEUE", "2")
+    monkeypatch.setenv("NICE_TPU_STREAM_MAX_DROPS", "3")
+    hub = stream.StreamHub()
+
+    async def scenario(writer, task):
+        await asyncio.sleep(0.05)  # hello
+        for row in _journal_rows(1, 5):  # 2 buffered + 3 dropped -> evict
+            hub.publish("journal", row, event_id=row["id"])
+        await asyncio.wait_for(task, timeout=2)  # eviction ends the stream
+
+    writer = asyncio.run(
+        _run_responder(hub, None, 0, scenario, heartbeat=30,
+                       monkeypatch=monkeypatch)
+    )
+    frames, _ = writer.frames()
+    lagged = [f for f in frames if f[1] == "lagged"]
+    assert len(lagged) == 1
+    info = json.loads(lagged[0][2])
+    assert info["dropped"] == 3
+    assert info["evicted"] is True
+    # The survivors (newest two) were still delivered before the close,
+    # and the lagged cursor tells the consumer where to resume from.
+    journal_ids = [f[0] for f in frames if f[1] == "journal"]
+    assert journal_ids == [4, 5]
+    assert info["cursor"] == 5
+    assert hub.subscriber_count() == 0

@@ -496,6 +496,8 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
         line["range_clamped"] = True
     if mode == "hi-base" and kind == "detailed":
         line.update(_hi_base_extras(data, batch_size))
+    if mode == "extra-large":
+        line["megaloop_ab"] = _megaloop_extras(data, kind, batch_size)
     # Transfer/cache telemetry for the timed run only (warm-up excluded):
     # readback bytes by payload kind proves the compaction win, and
     # stats_transfers==1 proves the accumulator stayed device-resident.
@@ -558,6 +560,67 @@ def _hi_base_extras(data, batch_size: int) -> dict:
         rng, data.base, backend="jax", batch_size=batch_size
     )
     out["filter_pruned"] = int(ENGINE_FILTER_PRUNED.value(key)) - pruned0
+    return out
+
+
+def _megaloop_extras(data, kind: str, batch_size: int) -> dict:
+    """Megaloop-vs-feed A/B riding the extra-large cases (one per kind).
+
+    The same short fixed slice is timed twice: NICE_TPU_MEGALOOP pinned 0
+    (the per-batch feed loop) then 1 (the device-resident lax.scan segment
+    loop), each after its own warm-up so the pair compares steady-state
+    kernels. Per arm the record carries the timed run's
+    nice_engine_dispatches_total delta and its readback-bytes-by-kind delta
+    — the dispatch_collapse ratio is the megaloop's whole point (one
+    dispatch and one readback per SEGMENT instead of per batch), and the
+    h2d_feed/host_other shrink shows up in the stepprof gate report. The
+    niceonly arm is meaningful off-TPU only (on TPU niceonly takes the
+    strided pallas pipeline, which owns its own dispatch shape and ignores
+    the megaloop; both arms then count 0 engine dispatches)."""
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.obs.series import ENGINE_DISPATCHES, ENGINE_READBACK_BYTES
+    from nice_tpu.ops import engine
+
+    ab_size = min(data.range_size, max(4 * batch_size, 1 << 20))
+    rng = FieldSize(data.range_start, data.range_start + ab_size)
+    run = (
+        engine.process_range_detailed if kind == "detailed"
+        else engine.process_range_niceonly
+    )
+    rb_kinds = ("nm", "count", "survivors", "survivors-dense", "stats",
+                "strided-counts")
+
+    def _rb():
+        return {k: int(ENGINE_READBACK_BYTES.value((k,))) for k in rb_kinds}
+
+    out: dict = {"slice": ab_size}
+    prev = os.environ.get("NICE_TPU_MEGALOOP")
+    try:
+        for field, pin in (("feed", "0"), ("megaloop", "1")):
+            os.environ["NICE_TPU_MEGALOOP"] = pin
+            run(rng, data.base, backend="jax", batch_size=batch_size)  # warm
+            d0 = int(ENGINE_DISPATCHES.value((kind,)))
+            rb0 = _rb()
+            t0 = time.monotonic()
+            run(rng, data.base, backend="jax", batch_size=batch_size)
+            out[field] = {
+                "secs": round(time.monotonic() - t0, 3),
+                "dispatches": int(ENGINE_DISPATCHES.value((kind,))) - d0,
+                "readback_bytes": {
+                    k: v - rb0[k] for k, v in _rb().items() if v - rb0[k]
+                },
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("NICE_TPU_MEGALOOP", None)
+        else:
+            os.environ["NICE_TPU_MEGALOOP"] = prev
+    feed_d = out["feed"]["dispatches"]
+    mega_d = out["megaloop"]["dispatches"]
+    if mega_d > 0:
+        out["dispatch_collapse"] = round(feed_d / mega_d, 2)
+    elif feed_d == 0:
+        out["note"] = "strided pipeline: engine dense loops not exercised"
     return out
 
 

@@ -86,13 +86,20 @@ class ProofStats:
 
 class IntervalInterpreter:
     def __init__(self, ref_bound: Optional[Interval] = None,
-                 dot_bound: Optional[Interval] = None):
+                 dot_bound: Optional[Interval] = None,
+                 carry_bounds: Optional[Dict[int, Interval]] = None):
         self.ref_bound = ref_bound
         # Declared dot_general accumulator bound (TraceTarget.dot_bound):
         # intersected with the naive per-element product bound, so a spec
         # can discharge MXU contraction headroom with a stated theorem
         # (ops/mxu.accum_bound) instead of a baseline allow.
         self.dot_bound = dot_bound
+        # Declared lax.scan carried-state bounds (TraceTarget.carry_bounds),
+        # flat carry index -> interval: the megaloop plans' loop-carry
+        # contract. Consumed by the OUTERMOST scan only (_h_scan); any inner
+        # scan degrades to the while_loop top-out.
+        self.carry_bounds = dict(carry_bounds) if carry_bounds else {}
+        self._carry_bounds_used = False
         self.obligations: List[Obligation] = []
         self.stats = ProofStats()
         # var -> defining record for peephole matching
@@ -613,6 +620,63 @@ def _h_while(interp, eqn, env, grid):
     interp._set(env, eqn.outvars, [interp._top(v) for v in eqn.outvars])
 
 
+def _h_scan(interp, eqn, env, grid):
+    """lax.scan under declared carried-state bounds (the megaloop plans).
+
+    The body is interpreted ONCE with its carry invars seeded from
+    ``carry_bounds`` (undeclared slots seed at dtype top, consts/xs from the
+    outer operand intervals). Each declared bound is an inductive invariant
+    the engine upholds across iterations — the same contract style as
+    HIST_ACC_BOUND for the per-batch accumulator (e.g. the remaining-lanes
+    countdown starts non-negative and only shrinks; the carried histogram
+    stays under the flush budget) — so a single body pass surfaces every
+    intra-iteration wrap obligation, and the loop's carry outputs re-seed at
+    the declared bounds. With no declared bounds this still interprets the
+    body (arithmetic checked against dtype-top seeds) and tops the outputs
+    out, strictly stronger than the old while_loop handling."""
+    from nice_tpu.analysis.jaxrules.tracer import _inner_jaxpr
+    inner = eqn.params.get("jaxpr")
+    ij = _inner_jaxpr(inner) if inner is not None else None
+    num_consts = int(eqn.params.get("num_consts", 0))
+    num_carry = int(eqn.params.get("num_carry", 0))
+    if ij is None or len(ij.invars) != len(eqn.invars):
+        _h_while(interp, eqn, env, grid)
+        return
+    declared = {} if interp._carry_bounds_used else interp.carry_bounds
+    interp._carry_bounds_used = True
+    sub_env: Dict[int, Interval] = {}
+    import numpy as np
+    for cv, cval in zip(ij.constvars, getattr(inner, "consts", [])):
+        try:
+            arr = np.asarray(cval)
+            if arr.dtype.kind in "bui" and arr.size:
+                sub_env[id(cv)] = (int(arr.min()), int(arr.max()))
+        except Exception:
+            pass
+    for i, (iv_var, op) in enumerate(zip(ij.invars, eqn.invars)):
+        if num_consts <= i < num_consts + num_carry:
+            bound = declared.get(i - num_consts) or interp._top(iv_var)
+            if bound is not None:
+                sub_env[id(iv_var)] = bound
+            continue
+        # consts and xs: the outer operand interval bounds every per-
+        # iteration slice the body sees.
+        got = interp._read(env, op)
+        if got is not None:
+            sub_env[id(iv_var)] = got
+    interp.interp(ij, sub_env, grid)
+    outs = []
+    for j, ov in enumerate(eqn.outvars):
+        if j < num_carry:
+            outs.append(declared.get(j) or interp._top(ov))
+        elif j < len(ij.outvars):
+            # stacked ys: the body's per-iteration bound covers every slice
+            outs.append(interp._read(sub_env, ij.outvars[j]))
+        else:
+            outs.append(interp._top(ov))
+    interp._set(env, eqn.outvars, outs)
+
+
 def _h_pallas_call(interp, eqn, env, grid):
     from nice_tpu.analysis.jaxrules.tracer import _inner_jaxpr
     inner = eqn.params.get("jaxpr")
@@ -736,7 +800,7 @@ _HANDLERS = {
     "population_count": _h_popcount,
     "scatter": _h_scatter, "scatter-add": _h_scatter_add,
     "dot_general": _h_dot_general,
-    "cond": _h_cond, "while": _h_while, "scan": _h_while,
+    "cond": _h_cond, "while": _h_while, "scan": _h_scan,
     "pallas_call": _h_pallas_call,
     "program_id": _h_program_id,
     "get": _h_get, "swap": _h_swap, "addupdate": _h_addupdate,

@@ -29,8 +29,11 @@ def check(project: Project, ctx) -> List[Violation]:
     out = {}
     report = ctx.report.setdefault("j2", {})
     for trace in ctx.traces:
-        interp = IntervalInterpreter(ref_bound=trace.target.ref_bound,
-                                     dot_bound=trace.target.dot_bound)
+        interp = IntervalInterpreter(
+            ref_bound=trace.target.ref_bound,
+            dot_bound=trace.target.dot_bound,
+            carry_bounds=dict(trace.target.carry_bounds or ()),
+        )
         interp.run(trace.closed, dict(trace.target.arg_bounds))
         entry = interp.stats.as_report()
         entry["obligations"] = len(interp.obligations)

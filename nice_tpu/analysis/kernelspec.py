@@ -74,9 +74,13 @@ KNOWN_JIT_SURFACES = frozenset({
     "detailed_batch", "uniques_batch", "survivors_batch",
     "detailed_accum_batch", "niceonly_dense_batch",
     "niceonly_filtered_batch",
+    # vector_engine megaloop entry points (lax.scan over the batch kernels)
+    "detailed_accum_megaloop", "niceonly_dense_megaloop",
+    "niceonly_filtered_megaloop",
     # pallas_engine callable factories (lru-cached, jit inside)
     "_stats_callable", "_uniques_callable", "_survivors_callable",
-    "_detailed_accum_callable", "_strided_callable",
+    "_detailed_accum_callable", "_detailed_megaloop_callable",
+    "_strided_callable",
 })
 
 # Donation provenance for rule J3's read-after-donate scan: local names bound
@@ -86,11 +90,17 @@ DONATING_FACTORIES: Dict[str, int] = {
     "_detailed_accum_executable": 0,    # engine AOT wrapper
     "make_sharded_stats_accum_step": 0, # parallel/mesh factory
     "_build_stats_accum_step": 0,
+    # megaloop twins (PR 17): same donated-accumulator position
+    "_detailed_megaloop_callable": 0,
+    "_detailed_megaloop_executable": 0,
+    "make_sharded_megaloop_accum_step": 0,
+    "_build_megaloop_accum_step": 0,
 }
 # Directly-called donating entry points: callee name -> donated positional
 # argument index at the call site.
 DONATING_CALLS: Dict[str, int] = {
     "detailed_accum_batch": 2,          # (plan, batch_size, hist_acc, ...)
+    "detailed_accum_megaloop": 3,       # (plan, batch_size, n_iters, acc, ..)
 }
 
 # Files rule J6 scans for public ``*_batch`` ops that must carry a spec.
@@ -113,6 +123,14 @@ class TraceTarget:
     # per-element bound, so headroom is discharged by a stated theorem about
     # the digit split (ops/mxu.accum_bound), not a baseline allow.
     dot_bound: Optional[Tuple[int, int]] = None
+    # Declared bounds on lax.scan/while carried state, as ((flat_carry_index,
+    # (lo, hi)), ...): J2 seeds the loop-body carry invars from these instead
+    # of topping the whole loop out. Like HIST_ACC_BOUND, each bound IS a
+    # contract the engine upholds (e.g. the megaloop's remaining-lanes
+    # countdown starts from a valid_total the dispatch loop caps, and the
+    # carried histogram stays under the flush budget). Undeclared carry
+    # slots seed at dtype top.
+    carry_bounds: Tuple[Tuple[int, Tuple[int, int]], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -321,6 +339,104 @@ _ve_spec(
 )
 
 
+# -- megaloop specs (PR 17) --------------------------------------------------
+# Whole-segment lax.scan plans: the batch kernels above run inside a scan
+# whose carry is (cursor u32[limbs_n], remaining-lanes countdown, the
+# folded accumulators). J2 discharges the loop-carry headroom from the
+# declared carry_bounds: the countdown starts at a dispatch-capped
+# valid_total (so `rem - min(rem, batch)` cannot wrap), and the carried
+# histogram/counters stay under the engine's flush budget — the same
+# contract HIST_ACC_BOUND states for the per-batch accumulator. Traced at
+# a fixed 2-iteration segment; the carry algebra is independent of the
+# segment length (J5 tracks `segment` as a bounded static instead).
+_TRACE_SEG = 2
+
+# Remaining-lanes countdown: non-negative by the dispatch-loop contract
+# (valid_total <= batch * segment <= the flush budget), which is exactly
+# what makes the in-loop `rem - valid` subtraction provably wrap-free.
+_REM_BOUND = (0, 2**31 - 1)
+_COUNT_ACC_BOUND = (0, 1 << 30)
+
+_STATIC_MEGALOOP = (
+    ("segment", "megaloop iterations fused per dispatch; env/autotuned, "
+     "clamped to the i32 histogram flush budget"),
+)
+
+
+def _build_ve_mega_accum(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+
+    def fn(acc, start, valid_total):
+        return ve.detailed_accum_megaloop(
+            plan, batch, _TRACE_SEG, acc, start, valid_total,
+            carry_interval=ci,
+        )
+    args = ((_sds((plan.base + 2,), "int32"),) +
+            (_sds((plan.limbs_n,), "uint32"), _sds((), "int32")))
+    return TraceTarget(
+        fn, args, {0: HIST_ACC_BOUND, 2: (0, batch * _TRACE_SEG)},
+        donate=(0,),
+        # scan carry: (cursor, rem, hist acc, near-miss acc)
+        carry_bounds=((1, _REM_BOUND), (2, HIST_ACC_BOUND),
+                      (3, _COUNT_ACC_BOUND)),
+    )
+
+
+_ve_spec(
+    "detailed_accum_megaloop", "accum",
+    lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
+    _build_ve_mega_accum, sweep="small",
+    static_domain=_STATIC_RANGE + _STATIC_MEGALOOP,
+)
+
+
+def _build_ve_mega_niceonly(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+
+    def fn(start, valid_total):
+        return ve.niceonly_dense_megaloop(
+            plan, batch, _TRACE_SEG, start, valid_total, carry_interval=ci,
+        )
+    args = (_sds((plan.limbs_n,), "uint32"), _sds((), "int32"))
+    return TraceTarget(
+        fn, args, {1: (0, batch * _TRACE_SEG)},
+        # scan carry: (cursor, rem, count)
+        carry_bounds=((1, _REM_BOUND), (2, _COUNT_ACC_BOUND)),
+    )
+
+
+_ve_spec(
+    "niceonly_dense_megaloop", "niceonly",
+    lambda plan, batch: (((), "int32"),),
+    _build_ve_mega_niceonly, sweep="small",
+    static_domain=_STATIC_RANGE + _STATIC_MEGALOOP,
+)
+
+
+def _build_ve_mega_filtered(plan, batch, ci):
+    from nice_tpu.ops import vector_engine as ve
+
+    def fn(start, valid_total):
+        return ve.niceonly_filtered_megaloop(
+            plan, batch, _TRACE_SEG, start, valid_total, carry_interval=ci,
+        )
+    args = (_sds((plan.limbs_n,), "uint32"), _sds((), "int32"))
+    return TraceTarget(
+        fn, args, {1: (0, batch * _TRACE_SEG)},
+        # scan carry: (cursor, rem, count, pruned)
+        carry_bounds=((1, _REM_BOUND), (2, _COUNT_ACC_BOUND),
+                      (3, _COUNT_ACC_BOUND)),
+    )
+
+
+_ve_spec(
+    "niceonly_filtered_megaloop", "niceonly",
+    lambda plan, batch: (((), "int32"), ((), "int32")),
+    _build_ve_mega_filtered, sweep="small",
+    static_domain=_STATIC_RANGE + _STATIC_MEGALOOP,
+)
+
+
 # Limb-math core traced without jit: sqr + mul + digit extraction exactly as
 # num_uniques_lanes composes them. This is the J2 carry-headroom proof
 # surface — swept over carry_interval {0, 1, max} per base.
@@ -499,6 +615,37 @@ _pe_spec(
     "detailed_accum_batch", "accum",
     lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
     _build_pe_accum,
+)
+
+
+# Pallas megaloop (PR 17): the lax.scan wraps the pallas stats kernel —
+# same carry contract as the jnp twin, with the per-iteration stats tile
+# still bounded by ref_bound.
+def _build_pe_mega_accum(plan, batch, ci):
+    from nice_tpu.ops import pallas_engine as pe
+
+    def fn(acc, start, valid_total):
+        return pe.detailed_accum_megaloop(
+            plan, batch, 2, acc, start, valid_total, carry_interval=ci,
+        )
+    args = (_sds((plan.base + 2,), "int32"),) + _pe_range_args(plan)
+    return TraceTarget(
+        fn, args, {0: HIST_ACC_BOUND, 2: (0, batch * 2)},
+        donate=(0,), ref_bound=PER_BATCH_HIST_BOUND,
+        # scan carry: (cursor, rem, hist acc, near-miss acc)
+        carry_bounds=((1, (0, 2**31 - 1)), (2, HIST_ACC_BOUND),
+                      (3, (0, 1 << 30))),
+    )
+
+
+_pe_spec(
+    "detailed_accum_megaloop", "accum",
+    lambda plan, batch: (((plan.base + 2,), "int32"), ((), "int32")),
+    _build_pe_mega_accum, sweep="small",
+    static_domain=_STATIC_PALLAS + (
+        ("segment", "megaloop iterations fused per dispatch; env/autotuned, "
+         "clamped to the i32 histogram flush budget"),
+    ),
 )
 
 

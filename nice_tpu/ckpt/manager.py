@@ -65,8 +65,14 @@ def plan_signature(mode: SearchMode, base: int, backend: str,
         # (pod-sliced subfields): a v2 snapshot's cursor alone does NOT
         # imply a covered prefix, so pre-slice consumers must reject it —
         # and v1 snapshots (no "state" key) are rejected here symmetrically
-        # by plain signature inequality.
-        "state": 2,
+        # by plain signature inequality. 3 = megaloop segment states: the
+        # remaining-set granularity is a whole megaloop segment
+        # (batch_size * NICE_TPU_MEGALOOP_SEGMENT lanes per device), and
+        # the folded histogram covers every SEGMENT before the marker —
+        # a v2 consumer replaying a v3 snapshot at batch granularity (or
+        # vice versa) would mis-split the remaining set, so v2 <-> v3
+        # snapshots reject cleanly (reason "state_version").
+        "state": 3,
     }
 
 
@@ -180,7 +186,19 @@ class FieldCheckpointer:
                 self.path, manifest.get("signature"), manifest.get("field"),
                 self.signature, self.data.to_json(),
             )
-            CKPT_REJECTED.labels("signature").inc()
+            snap_sig = manifest.get("signature")
+            reason = "signature"
+            if (
+                isinstance(snap_sig, dict)
+                and manifest.get("field") == self.data.to_json()
+                and {k: v for k, v in snap_sig.items() if k != "state"}
+                == {k: v for k, v in self.signature.items() if k != "state"}
+            ):
+                # Same plan, older/newer state contract (e.g. a pre-megaloop
+                # v2 snapshot under a v3 engine): counted separately so a
+                # fleet upgrade's restart cost is visible as such.
+                reason = "state_version"
+            CKPT_REJECTED.labels(reason).inc()
             self.delete()
             return None
         flight.record(

@@ -66,6 +66,14 @@ ENGINE_FILTER_PRUNED = metrics.counter(
     "any limb math ran, by mode and base.",
     labelnames=("mode", "base"),
 )
+ENGINE_DISPATCHES = metrics.counter(
+    "nice_engine_dispatches_total",
+    "Device dispatches issued by the dense engine loops, by mode. With the "
+    "megaloop one dispatch covers a whole segment (batch_size * segment "
+    "lanes per device), so this collapses by the segment factor vs the "
+    "per-batch feed.",
+    labelnames=("mode",),
+)
 
 # --- pallas + mesh dispatch ---------------------------------------------
 PALLAS_DISPATCH_SECONDS = metrics.histogram(
@@ -197,7 +205,8 @@ CKPT_RENEWALS = metrics.counter(
 CKPT_REJECTED = metrics.counter(
     "nice_engine_checkpoint_rejected_total",
     "Snapshots rejected on restore, by reason (corrupt CRC/truncation, "
-    "plan-signature mismatch, unknown format version).",
+    "plan-signature mismatch, state-contract version drift, unknown format "
+    "version).",
     labelnames=("reason",),
 )
 
@@ -548,6 +557,7 @@ for _reason in ("sliver", "host-route", "limbs"):
     ENGINE_HOST_FALLBACK.labels(_reason)
 for _mode in ("detailed", "niceonly"):
     ENGINE_NUMBERS.labels(_mode)
+    ENGINE_DISPATCHES.labels(_mode)
     MESH_DISPATCH_SECONDS.labels(_mode)
     MESH_FEED_IDLE.labels(_mode)
     CLIENT_FIELDS.labels(_mode)
@@ -577,7 +587,7 @@ for _tier in ("trusted", "untrusted", "suspect"):
     SERVER_TRUST_CLIENTS.labels(_tier)
 for _queue in ("niceonly", "detailed_thin"):
     SERVER_FIELD_QUEUE_REFILLS.labels(_queue)
-for _reason in ("corrupt", "signature", "version"):
+for _reason in ("corrupt", "signature", "state_version", "version"):
     CKPT_REJECTED.labels(_reason)
 for _outcome in ("delivered", "rejected", "deferred"):
     SPOOL_REPLAYS.labels(_outcome)

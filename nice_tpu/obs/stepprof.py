@@ -17,7 +17,13 @@ Design constraints, in order:
 2. **Fences only at existing boundaries.** With the profiler on, the one
    new sync is a post-dispatch ``block_until_ready`` that separates
    ``device_compute`` from the host-side loop; ``fold``/``readback`` are
-   timed around the collector's *existing* device->host transfers.
+   timed around the collector's *existing* device->host transfers. Under
+   the megaloop (NICE_TPU_MEGALOOP) a dispatch IS a whole segment — a
+   lax.scan of NICE_TPU_MEGALOOP_SEGMENT batch iterations — so the
+   profiler fences once per segment and never per iteration: one
+   ``device_compute`` span covers the whole in-program loop, and the
+   dispatches-per-slice collapse shows up as fewer, longer spans
+   (nice_engine_dispatches_total tracks the count).
    Attribution caveat (documented, accepted): dispatch is async under jit,
    so with the profiler off nothing changes; with it on, the pipeline
    serializes slightly — which is why the gate report A/Bs both settings.

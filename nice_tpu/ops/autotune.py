@@ -44,6 +44,7 @@ ENV_VARS = {
     "block_rows": "NICE_TPU_BLOCK_ROWS",
     "carry_interval": "NICE_TPU_CARRY_INTERVAL",
     "use_mxu": "NICE_TPU_MXU",
+    "megaloop": "NICE_TPU_MEGALOOP_SEGMENT",
 }
 
 _lock = lockdep.make_lock("ops.autotune._lock")
@@ -227,7 +228,8 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
     best = max(results, key=lambda r: r["numbers_per_sec"])
     new_params = {
         k: best[k]
-        for k in ("batch_size", "block_rows", "carry_interval", "use_mxu")
+        for k in ("batch_size", "block_rows", "carry_interval", "use_mxu",
+                  "megaloop")
         if best.get(k) is not None
     }
     record(
@@ -236,7 +238,7 @@ def sweep(mode: str, bench_mode: str, backend: str, *,
         swept=[
             {k: r.get(k) for k in
              ("batch_size", "block_rows", "carry_interval", "use_mxu",
-              "numbers_per_sec")}
+              "megaloop", "numbers_per_sec")}
             for r in results
         ],
         # The harness subprocess reports a stepprof breakdown when it ran

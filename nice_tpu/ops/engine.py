@@ -48,6 +48,7 @@ from nice_tpu.obs.series import (
     ENGINE_BACKEND_DOWNGRADES,
     ENGINE_BATCH_KERNEL_SECONDS,
     ENGINE_DESCRIPTORS,
+    ENGINE_DISPATCHES,
     ENGINE_DISPATCH_OCCUPANCY,
     ENGINE_FILTER_PRUNED,
     ENGINE_HOST_FALLBACK,
@@ -71,6 +72,14 @@ DEFAULT_BATCH_SIZE = 1 << 18
 # Max batches in flight during pipelined dispatch: bounds live device buffers
 # (and the runtime queue) so arbitrarily large fields run in constant memory.
 DISPATCH_WINDOW = 32
+
+# Megaloop: batch iterations fused into one device-resident lax.scan per
+# dispatch (NICE_TPU_MEGALOOP_SEGMENT overrides; NICE_TPU_MEGALOOP=0 reverts
+# to the per-batch feed). Each segment is one dispatch + one 4-byte readback
+# instead of `segment` of each; the checkpoint cadence (segment boundaries)
+# becomes the only forced sync. 8 amortizes the host RTT ~8x while keeping
+# resume granularity at 8 * batch_size numbers.
+MEGALOOP_SEGMENT_DEFAULT = 8
 
 # Sub-batch size for the rare-path per-lane re-scan: small enough that the
 # device->host uniques transfer stays modest even when the stats batch is 2^28.
@@ -1203,12 +1212,14 @@ def _strided_setup(base: int, field_size: int) -> "_StridedSetup | None":
     )
 
 
-def resolve_tuning(mode: str, base: int, backend: str,
-                   batch_size: int | None = None) -> tuple[int, int, int, int]:
+def resolve_tuning(
+    mode: str, base: int, backend: str, batch_size: int | None = None,
+) -> tuple[int, int, int, int, int]:
     """Resolve the kernel-shape knobs for one dispatch: (batch_size,
-    block_rows, carry_interval, use_mxu) under the autotuner's env > tuned >
-    default precedence (ops/autotune.py; NICE_TPU_BATCH / NICE_TPU_BLOCK_ROWS
-    / NICE_TPU_CARRY_INTERVAL / NICE_TPU_MXU pin a knob for one run).
+    block_rows, carry_interval, use_mxu, megaloop) under the autotuner's
+    env > tuned > default precedence (ops/autotune.py; NICE_TPU_BATCH /
+    NICE_TPU_BLOCK_ROWS / NICE_TPU_CARRY_INTERVAL / NICE_TPU_MXU /
+    NICE_TPU_MEGALOOP_SEGMENT pin a knob for one run).
 
     The table is keyed by the backend string the CALLER requested ("jax" /
     "pallas" / "jnp") — the same spelling scripts/tune_kernels.py records
@@ -1222,9 +1233,14 @@ def resolve_tuning(mode: str, base: int, backend: str,
     use_mxu routes limb products through the banded Toeplitz dot_general
     path (ops/mxu.py, bit-identical); it is forced to 0 for any plan whose
     MXU accumulator bound does not fit i32 (mxu.supports_plan), so a stale
-    pin can never select an unprovable kernel."""
+    pin can never select an unprovable kernel.
+
+    megaloop is the segment length of the device-resident batch loop (number
+    of batch iterations fused into one lax.scan dispatch); 1 means the
+    per-batch feed, and NICE_TPU_MEGALOOP=0 forces it to 1 regardless of
+    any tuned/pinned segment length."""
     if backend not in ("jax", "jnp", "pallas"):
-        return batch_size or DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0
+        return batch_size or DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0, 1
     from nice_tpu.ops import autotune, mxu
 
     if batch_size is None:
@@ -1240,7 +1256,14 @@ def resolve_tuning(mode: str, base: int, backend: str,
     use_mxu = autotune.choose(mode, base, backend, "use_mxu", 0)
     if use_mxu and not mxu.supports_plan(get_plan(base)):
         use_mxu = 0
-    return batch_size, block_rows, carry_interval, 1 if use_mxu else 0
+    if knobs.MEGALOOP.get():
+        megaloop = autotune.choose(
+            mode, base, backend, "megaloop", MEGALOOP_SEGMENT_DEFAULT
+        )
+        megaloop = max(1, int(megaloop))
+    else:
+        megaloop = 1
+    return batch_size, block_rows, carry_interval, 1 if use_mxu else 0, megaloop
 
 
 def _batch_arg_shapes(plan):
@@ -1312,6 +1335,78 @@ def _niceonly_dense_executable(plan, batch_size: int, carry_interval: int = 0,
     )
 
 
+def _detailed_megaloop_executable(plan, batch_size: int, seg: int,
+                                  backend: str, block_rows: int = 0,
+                                  carry_interval: int = 0, use_mxu: int = 0):
+    """AOT-compiled single-device detailed megaloop: a lax.scan of `seg`
+    batch iterations with a device-resident (cursor, remaining, histogram,
+    near-miss) carry — exec(hist_acc, start_limbs, valid_total) ->
+    (new_acc, near_miss_count). One dispatch and one 4-byte readback per
+    segment instead of per batch. Keyed on the segment shape so warm
+    restarts (and tail segments of a different length) hit the executable
+    cache without re-lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        acc = jax.ShapeDtypeStruct((plan.base + 2,), jnp.int32)
+        if backend == "pallas":
+            br = pe._effective_block_rows(
+                batch_size, block_rows or pe.BLOCK_ROWS
+            )
+            jitted = pe._detailed_megaloop_callable(
+                plan, batch_size, seg, br, carry_interval=carry_interval,
+                use_mxu=bool(use_mxu),
+            )
+            return compile_cache.aot(jitted, acc, *_batch_arg_shapes(plan))
+        return compile_cache.aot(
+            ve.detailed_accum_megaloop, plan, batch_size, seg, acc,
+            *_batch_arg_shapes(plan), carry_interval=carry_interval,
+            use_mxu=bool(use_mxu),
+        )
+
+    return compile_cache.executable(
+        ("detailed-mega", backend, plan, batch_size, seg, block_rows,
+         carry_interval, use_mxu),
+        build,
+    )
+
+
+def _niceonly_megaloop_executable(plan, batch_size: int, seg: int,
+                                  carry_interval: int = 0, use_mxu: int = 0,
+                                  fused: bool = False):
+    """AOT-compiled single-device dense niceonly megaloop (jnp): a lax.scan
+    of `seg` count batches with a device-resident carry. Returns
+    exec(start_limbs, valid_total) -> count (unfused) or (count, pruned)
+    (fused residue filter). Keyed on the segment shape like the detailed
+    variant."""
+
+    def build():
+        fn = (
+            ve.niceonly_filtered_megaloop if fused
+            else ve.niceonly_dense_megaloop
+        )
+        return compile_cache.aot(
+            fn, plan, batch_size, seg,
+            *_batch_arg_shapes(plan), carry_interval=carry_interval,
+            use_mxu=bool(use_mxu),
+        )
+
+    return compile_cache.executable(
+        ("niceonly-mega", plan, batch_size, seg, carry_interval, use_mxu,
+         fused),
+        build,
+    )
+
+
+def _clamp_segment(seg: int, batch_size: int, n_dev: int) -> int:
+    """Cap the megaloop segment so one un-flushed segment stays inside the
+    i32 histogram-bin headroom budget: flush_every is computed from the
+    per-dispatch lane count (batch_size * seg * n_dev), and a segment whose
+    own lanes exceed half the i32 range would make flush_every=1 vacuous."""
+    return max(1, min(int(seg), ((1 << 31) - 1) // (2 * batch_size * n_dev)))
+
+
 def warm_detailed(base: int, batch_size: int | None = None,
                   backend: str = "jax") -> None:
     """Pre-lower/AOT-compile the exact per-batch executables a detailed field
@@ -1325,7 +1420,7 @@ def warm_detailed(base: int, batch_size: int | None = None,
     if backend in ("scalar", "native"):
         return
     compile_cache.setup()
-    batch_size, block_rows, carry_interval, use_mxu = resolve_tuning(
+    batch_size, block_rows, carry_interval, use_mxu, mega = resolve_tuning(
         "detailed", base, backend, batch_size
     )
     plan = get_plan(base)
@@ -1337,14 +1432,29 @@ def warm_detailed(base: int, batch_size: int | None = None,
         # parallel/mesh.py caches these per (kind, device ids, shape), so the
         # warm IS the field's step — no second memo layer that would pin a
         # stale Mesh across a downshift.
-        pmesh.make_sharded_stats_accum_step(
-            plan, batch_size, mesh, kernel=backend
-        )
+        n_dev = int(mesh.devices.size)
+        seg = _clamp_segment(mega, batch_size, n_dev)
+        if seg > 1:
+            pmesh.make_sharded_megaloop_accum_step(
+                plan, batch_size, seg, mesh, kernel=backend
+            )
+        else:
+            pmesh.make_sharded_stats_accum_step(
+                plan, batch_size, mesh, kernel=backend
+            )
         pmesh.make_sharded_stats_fold(mesh)
     else:
-        _detailed_accum_executable(
-            plan, batch_size, backend, block_rows, carry_interval, use_mxu
-        )
+        seg = _clamp_segment(mega, batch_size, 1)
+        if seg > 1:
+            _detailed_megaloop_executable(
+                plan, batch_size, seg, backend, block_rows, carry_interval,
+                use_mxu,
+            )
+        else:
+            _detailed_accum_executable(
+                plan, batch_size, backend, block_rows, carry_interval,
+                use_mxu,
+            )
 
 
 def warm_niceonly(base: int, field_size: int = 0, field_start: int | None = None) -> None:
@@ -1849,7 +1959,7 @@ def _process_range_detailed(
     batch_size=None (the default) resolves batch/block_rows/carry_interval
     through the autotuner (resolve_tuning: env > tuned winners > defaults);
     an explicit batch_size pins the batch and still resolves the others."""
-    batch_size, block_rows, carry_interval, use_mxu = resolve_tuning(
+    batch_size, block_rows, carry_interval, use_mxu, mega = resolve_tuning(
         "detailed", base, backend, batch_size
     )
     if backend == "scalar":
@@ -1920,6 +2030,13 @@ def _process_range_detailed(
     else:
         pmesh = None
     n_dev = int(mesh.devices.size) if mesh is not None else 1
+    # Megaloop (PR 17): fuse `seg` batch iterations into one device-resident
+    # lax.scan per dispatch. The feed item granularity becomes one SEGMENT
+    # (batch_size * seg lanes per device); the dispatch/collector/checkpoint
+    # machinery below is untouched because a segment looks exactly like a
+    # large batch to it — one dispatch, one nm readback, markers at segment
+    # boundaries (the only forced sync cadence).
+    seg = _clamp_segment(mega, batch_size, n_dev)
 
     def _bind(mesh_, n_dev_):
         """(dispatch, new_acc, fold_np) for the current mesh layout —
@@ -1927,9 +2044,14 @@ def _process_range_detailed(
         already resolved to exactly "pallas" or "jnp" here; pass it through
         so an explicit backend="jnp" is honored on TPU too."""
         if mesh_ is not None:
-            step = pmesh.make_sharded_stats_accum_step(
-                plan, batch_size, mesh_, kernel=backend
-            )
+            if seg > 1:
+                step = pmesh.make_sharded_megaloop_accum_step(
+                    plan, batch_size, seg, mesh_, kernel=backend
+                )
+            else:
+                step = pmesh.make_sharded_stats_accum_step(
+                    plan, batch_size, mesh_, kernel=backend
+                )
             fold = pmesh.make_sharded_stats_fold(mesh_)
 
             def disp(acc_, item):
@@ -1946,10 +2068,16 @@ def _process_range_detailed(
             # Tuned shape knobs apply on the single-device path; the sharded
             # step above stays at module defaults (its per-device kernel
             # shape is owned by parallel/mesh.py).
-            accum_exec = _detailed_accum_executable(
-                plan, batch_size, backend, block_rows, carry_interval,
-                use_mxu,
-            )
+            if seg > 1:
+                accum_exec = _detailed_megaloop_executable(
+                    plan, batch_size, seg, backend, block_rows,
+                    carry_interval, use_mxu,
+                )
+            else:
+                accum_exec = _detailed_accum_executable(
+                    plan, batch_size, backend, block_rows, carry_interval,
+                    use_mxu,
+                )
 
             def disp(acc_, item):
                 return accum_exec(
@@ -1966,7 +2094,7 @@ def _process_range_detailed(
         return disp, mk_acc, fold_np
 
     dispatch, new_acc, fold_np = _bind(mesh, n_dev)
-    lanes = batch_size * n_dev
+    lanes = batch_size * seg * n_dev
 
     start = core.start()
     total = core.size()
@@ -2082,11 +2210,13 @@ def _process_range_detailed(
                 if collector.failed():
                     break
                 queues = (
-                    pmesh.partition_segments(segments, n_dev, batch_size)
+                    pmesh.partition_segments(
+                        segments, n_dev, batch_size * seg
+                    )
                     if mesh is not None else [list(segments)]
                 )
                 feed = _SliceFeed(
-                    plan, queues, batch_size, core.end(), feed_depth
+                    plan, queues, batch_size * seg, core.end(), feed_depth
                 )
                 markers = _SliceFeed.start_markers(queues)
                 failure = None
@@ -2122,6 +2252,7 @@ def _process_range_detailed(
                                 )
                             t_disp = _time.monotonic() if prof_on else 0.0
                             acc, nm = dispatch(acc, item)
+                            ENGINE_DISPATCHES.labels("detailed").inc()
                             if prof_on:
                                 # Enqueue + jit tracing cost of the call
                                 # itself, then the only profiler-added device
@@ -2207,7 +2338,10 @@ def _process_range_detailed(
                 n_dev = len(survivors)
                 dispatch, new_acc, fold_np = _bind(mesh, n_dev)
                 acc = new_acc()
-                lanes = batch_size * n_dev
+                # seg stays fixed across downshifts (the headroom budget only
+                # GROWS as n_dev shrinks), so the surviving devices reuse the
+                # already-compiled segment executable.
+                lanes = batch_size * seg * n_dev
                 flush_every = max(1, ((1 << 31) - 1) // (2 * lanes))
                 segments = rem
                 reshards += 1
@@ -2323,7 +2457,7 @@ def _process_range_niceonly(
     batch_size=None resolves batch/carry_interval through the autotuner
     (resolve_tuning); the strided pallas pipeline picks its own shapes and
     ignores the dense-scan knobs."""
-    batch_size, _block_rows, carry_interval, use_mxu = resolve_tuning(
+    batch_size, _block_rows, carry_interval, use_mxu, mega = resolve_tuning(
         "niceonly", base, backend, batch_size
     )
     if backend == "scalar":
@@ -2506,6 +2640,9 @@ def _process_range_niceonly(
     else:
         pmesh = None
     n_dev = int(mesh.devices.size) if mesh is not None else 1
+    # Megaloop segment for the dense loop — same contract as the detailed
+    # path: one lax.scan dispatch covers batch_size * seg lanes per device.
+    seg = _clamp_segment(mega, batch_size, n_dev)
 
     def _bind(mesh_, n_dev_):
         """Dispatch closure for the current mesh layout — rebuilt by the
@@ -2516,9 +2653,14 @@ def _process_range_niceonly(
         if mesh_ is not None:
             # The sharded step stays unfused: its per-device kernel shape is
             # owned by parallel/mesh.py.
-            step = pmesh.make_sharded_stats_step(
-                plan, batch_size, mesh_, "niceonly", kernel="jnp"
-            )
+            if seg > 1:
+                step = pmesh.make_sharded_megaloop_count_step(
+                    plan, batch_size, seg, mesh_
+                )
+            else:
+                step = pmesh.make_sharded_stats_step(
+                    plan, batch_size, mesh_, "niceonly", kernel="jnp"
+                )
 
             def disp(item):
                 return step(item.starts, item.valids), None
@@ -2533,9 +2675,14 @@ def _process_range_niceonly(
                 and base > 2
                 and len(residue_filter.get_residue_filter(base)) < base - 1
             )
-            count_exec = _niceonly_dense_executable(
-                plan, batch_size, carry_interval, use_mxu, fused
-            )
+            if seg > 1:
+                count_exec = _niceonly_megaloop_executable(
+                    plan, batch_size, seg, carry_interval, use_mxu, fused
+                )
+            else:
+                count_exec = _niceonly_dense_executable(
+                    plan, batch_size, carry_interval, use_mxu, fused
+                )
             if fused:
 
                 def disp(item):
@@ -2660,11 +2807,13 @@ def _process_range_niceonly(
                 if collector.failed():
                     break
                 queues = (
-                    pmesh.partition_segments(segments, n_dev, batch_size)
+                    pmesh.partition_segments(
+                        segments, n_dev, batch_size * seg
+                    )
                     if mesh is not None else [list(segments)]
                 )
                 feed = _SliceFeed(
-                    plan, queues, batch_size, core.end(), feed_depth
+                    plan, queues, batch_size * seg, core.end(), feed_depth
                 )
                 markers = _SliceFeed.start_markers(queues)
                 failure = None
@@ -2696,6 +2845,7 @@ def _process_range_niceonly(
                                 )
                             t_disp = time.monotonic() if prof_on else 0.0
                             counts = dispatch(item)
+                            ENGINE_DISPATCHES.labels("niceonly").inc()
                             if prof_on:
                                 prof.add(
                                     "device_compute",

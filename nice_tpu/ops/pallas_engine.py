@@ -543,3 +543,47 @@ def detailed_accum_batch(plan: BasePlan, batch_size: int, hist_acc,
                                    carry_interval, use_mxu)
     with _timed("detailed"):
         return run(hist_acc, start_limbs, valid_count)
+
+
+@functools.lru_cache(maxsize=None)
+def _detailed_megaloop_callable(plan: BasePlan, batch_size: int, n_iters: int,
+                                block_rows: int, carry_interval: int = 0,
+                                use_mxu: bool = False):
+    """Megaloop twin of _detailed_accum_callable: a lax.scan around the stats
+    pallas_call advances the field cursor IN-PROGRAM across n_iters batches
+    and folds every histogram into the donated accumulator — one dispatch and
+    one scalar readback per segment (see ve.detailed_accum_megaloop for the
+    carry/masking contract)."""
+    stats_call = _stats_callable(plan, "detailed", batch_size, block_rows,
+                                 carry_interval, use_mxu)
+    width = plan.base + 2
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(hist_acc, start_limbs, valid_total):
+        def body(carry, _):
+            cursor, rem, acc, nm_acc = carry
+            valid = jnp.minimum(rem, jnp.int32(batch_size))
+            hist, nm = stats_call(cursor, valid)
+            return (ve._advance_cursor(plan, cursor, batch_size),
+                    rem - valid, acc + hist[:width], nm_acc + nm), None
+
+        init = (jnp.asarray(start_limbs, jnp.uint32),
+                jnp.asarray(valid_total, jnp.int32), hist_acc, jnp.int32(0))
+        (_cursor, _rem, acc, nm), _ = jax.lax.scan(body, init, None,
+                                                   length=n_iters)
+        return acc, nm
+
+    return run
+
+
+def detailed_accum_megaloop(plan: BasePlan, batch_size: int, n_iters: int,
+                            hist_acc, start_limbs, valid_total,
+                            block_rows: int = BLOCK_ROWS,
+                            carry_interval: int = 0, use_mxu: bool = False):
+    """n_iters batches of the detailed stats kernel folded into the donated
+    hist_acc in one device program; returns (new_acc, near_miss_total)."""
+    block_rows = _effective_block_rows(batch_size, block_rows)
+    run = _detailed_megaloop_callable(plan, batch_size, n_iters, block_rows,
+                                      carry_interval, use_mxu)
+    with _timed("detailed_megaloop"):
+        return run(hist_acc, start_limbs, valid_total)

@@ -527,3 +527,100 @@ def niceonly_filtered_batch(plan: BasePlan, batch_size: int, start_limbs,
     nice = jnp.sum(((sub < cnt) & (uniques == plan.base)).astype(jnp.int32))
     pruned = jnp.sum(valid.astype(jnp.int32)) - cnt
     return nice, pruned
+
+
+# --------------------------------------------------------------------------
+# Megaloop: whole-segment scans with a device-resident carry (PR 17)
+# --------------------------------------------------------------------------
+#
+# One dispatch covers n_iters consecutive batches: a lax.scan advances the
+# field cursor IN-PROGRAM and folds each batch's result into the carried
+# accumulator, so the host's per-batch dispatch/readback work collapses to
+# one launch and one scalar readback per segment. The carry deliberately
+# counts DOWN a `rem` lane budget instead of carrying a loop index: the
+# `rem - valid` subtraction stays provably non-negative under the declared
+# carry bound (see analysis/kernelspec.py carry_bounds), where an `i + 1`
+# index increment seeded at the dtype top would be an undischargeable J2
+# wrap obligation. Tail segments reuse the full-shape executable with a
+# smaller valid_total — over-run lanes mask exactly as the per-batch kernels
+# mask padding lanes, so results are byte-identical to the batch loop.
+
+def _advance_cursor(plan: BasePlan, cursor, batch_size: int):
+    """cursor (u32[limbs_n]) + batch_size, as a stacked u32 array (the scan
+    carry needs an array, not the limb list the batch kernels consume)."""
+    limbs = add_u32([cursor[i] for i in range(plan.limbs_n)],
+                    np.uint32(batch_size))
+    return jnp.stack(limbs)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3,),
+                   static_argnames=("carry_interval", "use_mxu"))
+def detailed_accum_megaloop(plan: BasePlan, batch_size: int, n_iters: int,
+                            hist_acc, start_limbs, valid_total, *,
+                            carry_interval: int = 0, use_mxu: bool = False):
+    """n_iters batches of detailed_batch folded into the donated hist_acc.
+
+    Returns (hist_acc + sum of per-batch histograms, total near-miss count).
+    valid_total is the whole segment's lane budget; each iteration consumes
+    up to batch_size of it, so a short final batch masks exactly as the
+    per-batch path does (spill lanes land in bin 0, which no consumer
+    reads)."""
+    def body(carry, _):
+        cursor, rem, acc, nm_acc = carry
+        valid = jnp.minimum(rem, jnp.int32(batch_size))
+        hist, nm = detailed_batch(plan, batch_size, cursor, valid,
+                                  carry_interval=carry_interval,
+                                  use_mxu=use_mxu)
+        return (_advance_cursor(plan, cursor, batch_size), rem - valid,
+                acc + hist, nm_acc + nm), None
+
+    init = (jnp.asarray(start_limbs, U32),
+            jnp.asarray(valid_total, jnp.int32), hist_acc, jnp.int32(0))
+    (_cursor, _rem, acc, nm), _ = jax.lax.scan(body, init, None,
+                                               length=n_iters)
+    return acc, nm
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2),
+                   static_argnames=("carry_interval", "use_mxu"))
+def niceonly_dense_megaloop(plan: BasePlan, batch_size: int, n_iters: int,
+                            start_limbs, valid_total, *,
+                            carry_interval: int = 0, use_mxu: bool = False):
+    """Total nice count over n_iters batches of niceonly_dense_batch."""
+    def body(carry, _):
+        cursor, rem, count = carry
+        valid = jnp.minimum(rem, jnp.int32(batch_size))
+        c = niceonly_dense_batch(plan, batch_size, cursor, valid,
+                                 carry_interval=carry_interval,
+                                 use_mxu=use_mxu)
+        return (_advance_cursor(plan, cursor, batch_size), rem - valid,
+                count + c), None
+
+    init = (jnp.asarray(start_limbs, U32),
+            jnp.asarray(valid_total, jnp.int32), jnp.int32(0))
+    (_cursor, _rem, count), _ = jax.lax.scan(body, init, None,
+                                             length=n_iters)
+    return count
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2),
+                   static_argnames=("carry_interval", "use_mxu"))
+def niceonly_filtered_megaloop(plan: BasePlan, batch_size: int, n_iters: int,
+                               start_limbs, valid_total, *,
+                               carry_interval: int = 0,
+                               use_mxu: bool = False):
+    """(total nice count, total pruned) over n_iters filtered batches."""
+    def body(carry, _):
+        cursor, rem, count, pruned_acc = carry
+        valid = jnp.minimum(rem, jnp.int32(batch_size))
+        c, pruned = niceonly_filtered_batch(plan, batch_size, cursor, valid,
+                                            carry_interval=carry_interval,
+                                            use_mxu=use_mxu)
+        return (_advance_cursor(plan, cursor, batch_size), rem - valid,
+                count + c, pruned_acc + pruned), None
+
+    init = (jnp.asarray(start_limbs, U32),
+            jnp.asarray(valid_total, jnp.int32), jnp.int32(0), jnp.int32(0))
+    (_cursor, _rem, count, pruned), _ = jax.lax.scan(body, init, None,
+                                                     length=n_iters)
+    return count, pruned

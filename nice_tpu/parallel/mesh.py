@@ -397,6 +397,102 @@ def _build_stats_accum_step(plan, per_device_batch, mesh, kernel):
     return _timed_step(jax.jit(sharded, donate_argnums=(0,)), "detailed-accum")
 
 
+def make_sharded_megaloop_accum_step(
+    plan: BasePlan,
+    per_device_batch: int,
+    seg: int,
+    mesh: Mesh,
+    kernel: str = "auto",
+):
+    """Megaloop variant of make_sharded_stats_accum_step: each device runs a
+    `seg`-iteration lax.scan that advances its own cursor in-program and folds
+    every batch histogram into its row of the donated sharded accumulator —
+    one collective dispatch per SEGMENT instead of per batch, with a single
+    psum'd near-miss total per segment. The per-device valid count is the
+    device's whole-segment lane budget (up to per_device_batch * seg); a
+    short tail masks exactly as the per-batch step does.
+
+    Returns fn(hist_acc i32[n_dev, base+2] sharded on FIELD_AXIS,
+               starts u32[n_dev, limbs_n], valids i32[n_dev])
+      -> (new_hist_acc, sharded; near_miss_total i32, replicated)
+    """
+    return _step_cached(
+        "stats-accum-mega", mesh, (plan, per_device_batch, seg, kernel),
+        lambda: _build_megaloop_accum_step(plan, per_device_batch, seg, mesh,
+                                           kernel),
+    )
+
+
+def _build_megaloop_accum_step(plan, per_device_batch, seg, mesh, kernel):
+    from nice_tpu.ops import pallas_engine as pe
+
+    kernel = _resolve_kernel(plan, per_device_batch, kernel)
+    mod = pe if kernel == "pallas" else ve
+    width = plan.base + 2
+
+    def device_step(hist_row, start_row, valid_row):
+        def body(carry, _):
+            cursor, rem, acc, nm_acc = carry
+            valid = jnp.minimum(rem, jnp.int32(per_device_batch))
+            hist, nm = mod.detailed_batch(
+                plan, per_device_batch, cursor, valid
+            )
+            return (ve._advance_cursor(plan, cursor, per_device_batch),
+                    rem - valid, acc + hist[:width], nm_acc + nm), None
+
+        init = (start_row[0].astype(jnp.uint32),
+                valid_row[0].astype(jnp.int32), hist_row[0], jnp.int32(0))
+        (_c, _r, acc, nm), _ = jax.lax.scan(body, init, None, length=seg)
+        return acc[None, :], jax.lax.psum(nm, FIELD_AXIS)
+
+    sharded = _shard_map(
+        device_step,
+        mesh,
+        in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS, None), P(FIELD_AXIS)),
+        out_specs=(P(FIELD_AXIS, None), P()),
+    )
+    return _timed_step(jax.jit(sharded, donate_argnums=(0,)), "detailed-accum")
+
+
+def make_sharded_megaloop_count_step(
+    plan: BasePlan,
+    per_device_batch: int,
+    seg: int,
+    mesh: Mesh,
+):
+    """Megaloop variant of the sharded niceonly step: each device scans `seg`
+    batches of the dense jnp count kernel over its own in-program cursor; the
+    segment totals are psum-reduced once. Returns fn(starts u32[n_dev,
+    limbs_n], valids i32[n_dev]) -> nice count i32, replicated."""
+    return _step_cached(
+        "stats-mega", mesh, (plan, per_device_batch, seg),
+        lambda: _build_megaloop_count_step(plan, per_device_batch, seg, mesh),
+    )
+
+
+def _build_megaloop_count_step(plan, per_device_batch, seg, mesh):
+    def device_step(start_row, valid_row):
+        def body(carry, _):
+            cursor, rem, count = carry
+            valid = jnp.minimum(rem, jnp.int32(per_device_batch))
+            c = ve.niceonly_dense_batch(plan, per_device_batch, cursor, valid)
+            return (ve._advance_cursor(plan, cursor, per_device_batch),
+                    rem - valid, count + c), None
+
+        init = (start_row[0].astype(jnp.uint32),
+                valid_row[0].astype(jnp.int32), jnp.int32(0))
+        (_c, _r, count), _ = jax.lax.scan(body, init, None, length=seg)
+        return jax.lax.psum(count, FIELD_AXIS)
+
+    sharded = _shard_map(
+        device_step,
+        mesh,
+        in_specs=(P(FIELD_AXIS, None), P(FIELD_AXIS)),
+        out_specs=P(),
+    )
+    return _timed_step(jax.jit(sharded), "niceonly")
+
+
 def make_sharded_stats_fold(mesh: Mesh):
     """The field-end reduction paired with make_sharded_stats_accum_step:
     ONE psum of the per-device accumulator rows over ICI, returning the

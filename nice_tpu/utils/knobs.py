@@ -235,6 +235,20 @@ MXU = _k(
     owner="ops/autotune.py", group="engine",
     default_doc="autotuned per (mode, base, backend)",
 )
+MEGALOOP = _k(
+    "NICE_TPU_MEGALOOP", "bool", True,
+    "Device-resident megaloop: fuse NICE_TPU_MEGALOOP_SEGMENT batch"
+    " iterations into one lax.scan dispatch with an in-program field cursor"
+    " (0 reverts to the per-batch feed loop).",
+    owner="ops/engine.py", group="engine",
+)
+MEGALOOP_SEGMENT = _k(
+    "NICE_TPU_MEGALOOP_SEGMENT", "int", None,
+    "Megaloop segment length override — batch iterations fused per dispatch;"
+    " also the checkpoint/readback cadence (env > autotuned > default 8).",
+    owner="ops/autotune.py", group="engine",
+    default_doc="autotuned per (mode, base, backend)",
+)
 FUSED_FILTER = _k(
     "NICE_TPU_FUSED_FILTER", "bool", True,
     "Fuse the residue filter into the dense niceonly device kernel so"

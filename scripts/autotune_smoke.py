@@ -34,7 +34,7 @@ from nice_tpu.ops import engine, scalar
 from nice_tpu.obs.series import AUTOTUNE_EVENTS
 
 hits0 = AUTOTUNE_EVENTS.value(("hit",))
-bs, br, ci, use_mxu = engine.resolve_tuning("detailed", 40, "jax")
+bs, br, ci, use_mxu, _mega = engine.resolve_tuning("detailed", 40, "jax")
 hits = AUTOTUNE_EVENTS.value(("hit",)) - hits0
 
 lo, _hi = base_range.get_base_range(40)
@@ -95,7 +95,7 @@ def main() -> int:
         json.dump(table, f)
     autotune.reset_for_tests()
     inv0 = AUTOTUNE_EVENTS.value(("invalidated",))
-    bs, _br, _ci, _mxu = engine.resolve_tuning("detailed", 40, "jax")
+    bs, _br, _ci, _mxu, _mega = engine.resolve_tuning("detailed", 40, "jax")
     invalidated = (
         AUTOTUNE_EVENTS.value(("invalidated",)) > inv0
         and bs == engine.DEFAULT_BATCH_SIZE

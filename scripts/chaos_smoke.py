@@ -140,6 +140,11 @@ def main() -> int:
         NICE_TPU_FAULTS=FAULT_SPEC,
         NICE_TPU_FAULTS_SEED=FAULT_SEED,
         NICE_TPU_CLAIM_BLOCK=str(BLOCK),
+        # The fault schedule indexes per-BATCH dispatches (raise@batch=2);
+        # the megaloop collapses a field below that index, so this drill
+        # pins the per-batch feed loop. Fault handling under the megaloop
+        # is covered by crash_resume_smoke --megaloop and test_megaloop.py.
+        NICE_TPU_MEGALOOP="0",
     )
     client_cmd = [
         sys.executable, "-m", "nice_tpu.client", "detailed",

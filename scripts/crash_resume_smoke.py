@@ -17,8 +17,15 @@ field, that the snapshot was retired after the confirmed submit, and that at
 least one /renew_claim heartbeat landed. Prints ONE JSON line. Usage:
 
     python scripts/crash_resume_smoke.py [workdir]
+    python scripts/crash_resume_smoke.py [workdir] --backend jnp --megaloop 2
+
+The second form is the mid-megaloop drill: the client scans with the
+device-resident lax.scan loop (NICE_TPU_MEGALOOP_SEGMENT pinned), so the
+SIGKILL lands between segment dispatches and the resume must re-enter the
+scan from a segment-granular snapshot.
 """
 
+import argparse
 import glob
 import json
 import os
@@ -39,12 +46,12 @@ FIRST_SNAPSHOT_TIMEOUT = 60
 RUN2_TIMEOUT = 180
 
 
-def _client_cmd(api_base: str, ckpt_dir: str) -> list:
+def _client_cmd(api_base: str, ckpt_dir: str, backend: str) -> list:
     return [
         sys.executable, "-m", "nice_tpu.client", "detailed",
         "--api-base", api_base,
         "--checkpoint-dir", ckpt_dir,
-        "--backend", "scalar",
+        "--backend", backend,
         "--batch-size", "2048",
         "--checkpoint-secs", "0.05",
         "--renew-secs", "2",
@@ -54,14 +61,26 @@ def _client_cmd(api_base: str, ckpt_dir: str) -> list:
 
 def main() -> int:
     t_start = time.monotonic()
-    if len(sys.argv) > 1:
-        workdir = sys.argv[1]
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workdir", nargs="?", default=None)
+    ap.add_argument("--backend", default="scalar",
+                    help="client engine backend (scalar = host oracle; jnp "
+                    "exercises the device dispatch loop)")
+    ap.add_argument("--megaloop", default="",
+                    help="pin NICE_TPU_MEGALOOP_SEGMENT for the client so "
+                    "the SIGKILL lands between megaloop segments (device "
+                    "backends only)")
+    args = ap.parse_args()
+    if args.workdir:
+        workdir = args.workdir
         os.makedirs(workdir, exist_ok=True)
         cleanup = False
     else:
         workdir = tempfile.mkdtemp(prefix="crash-resume-smoke-")
         cleanup = True
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.megaloop:
+        os.environ["NICE_TPU_MEGALOOP_SEGMENT"] = args.megaloop
 
     db_path = os.path.join(workdir, "smoke.db")
     ckpt_dir = os.path.join(workdir, "ckpt")
@@ -82,9 +101,11 @@ def main() -> int:
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
 
     failures = []
-    line = {"workdir": workdir}
+    line = {"workdir": workdir, "backend": args.backend}
+    if args.megaloop:
+        line["megaloop"] = int(args.megaloop)
     env = dict(os.environ)
-    cmd = _client_cmd(api_base, ckpt_dir)
+    cmd = _client_cmd(api_base, ckpt_dir, args.backend)
 
     # -- run 1: scan until the first snapshot lands, then SIGKILL ----------
     log1_path = os.path.join(workdir, "run1.log")

@@ -155,6 +155,12 @@ def _run_worker(n: int) -> dict:
 
     env = dict(os.environ)
     force_virtual_cpu(env, max(n, 1))
+    # This harness measures the per-BATCH feed (idle gaps between
+    # dispatches, sync-vs-double-buffered A/B) and the dead@3 reshard
+    # drill indexes per-batch dispatches; the megaloop would collapse the
+    # field below the drill index at 8 devices. Megaloop reshard coverage
+    # lives in test_megaloop.py's mid-slice downshift tests.
+    env["NICE_TPU_MEGALOOP"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--worker", str(n)],
         env=env,

@@ -315,6 +315,78 @@ def run_stepprof(report: dict, problems: list, reps: int) -> None:
     report["stepprof"]["overhead_frac_on_vs_off"] = round(overhead, 4)
 
 
+# Absolute headroom on the feed-idle fraction before the megaloop arm counts
+# as regressed: single-run CPU profiles jitter by a few points, and the gate
+# must not flap on that noise.
+MEGALOOP_IDLE_MARGIN = 0.10
+
+
+def run_megaloop_gate(report: dict, problems: list) -> None:
+    """Feed-idle gate for the megaloop (NICE_TPU_MEGALOOP).
+
+    The megaloop exists to collapse the host-side share of a slice — the
+    ``h2d_feed`` + ``host_other`` stepprof phases that the per-batch feed
+    loop spends staging cursors and bookkeeping between dispatches. Profile
+    the same field with the loop pinned off and on: the megaloop arm's
+    feed-idle fraction must not exceed the per-batch arm's by more than the
+    noise margin, and its dispatch count must actually collapse.
+    """
+    from nice_tpu.core.base_range import get_base_range
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.obs import stepprof
+    from nice_tpu.obs.series import ENGINE_DISPATCHES
+    from nice_tpu.ops import engine
+
+    base = 30
+    start, _ = get_base_range(base)
+    field = FieldSize(start, start + 400_000)
+    arms: dict = {}
+    prev = os.environ.get("NICE_TPU_MEGALOOP")
+    os.environ["NICE_TPU_STEPPROF"] = "1"
+    try:
+        for arm, pin in (("feed", "0"), ("megaloop", "1")):
+            os.environ["NICE_TPU_MEGALOOP"] = pin
+            engine.process_range_detailed(field, base, batch_size=1 << 12)
+            stepprof.reset()
+            d0 = ENGINE_DISPATCHES.value(("detailed",))
+            engine.process_range_detailed(field, base, batch_size=1 << 12)
+            cum = stepprof.cumulative()
+            key = next(k for k in cum if k.startswith("detailed|"))
+            entry = cum[key]
+            idle = entry["h2d_feed"] + entry["host_other"]
+            arms[arm] = {
+                "wall_secs": round(entry["wall"], 4),
+                "h2d_feed_secs": round(entry["h2d_feed"], 4),
+                "host_other_secs": round(entry["host_other"], 4),
+                "idle_frac": round(idle / entry["wall"], 4)
+                if entry["wall"] else 0.0,
+                "dispatches": int(
+                    ENGINE_DISPATCHES.value(("detailed",)) - d0
+                ),
+            }
+    finally:
+        os.environ["NICE_TPU_STEPPROF"] = "0"
+        if prev is None:
+            os.environ.pop("NICE_TPU_MEGALOOP", None)
+        else:
+            os.environ["NICE_TPU_MEGALOOP"] = prev
+    report["stepprof"]["megaloop_feed_idle"] = arms
+    drift = arms["megaloop"]["idle_frac"] - arms["feed"]["idle_frac"]
+    if drift > MEGALOOP_IDLE_MARGIN:
+        problems.append(
+            f"megaloop feed-idle regression: idle frac "
+            f"{arms['megaloop']['idle_frac']:.2f} vs "
+            f"{arms['feed']['idle_frac']:.2f} with the per-batch feed loop "
+            f"(> +{MEGALOOP_IDLE_MARGIN:.2f} margin)"
+        )
+    if arms["megaloop"]["dispatches"] >= arms["feed"]["dispatches"] > 1:
+        problems.append(
+            f"megaloop did not collapse dispatches: "
+            f"{arms['megaloop']['dispatches']} vs "
+            f"{arms['feed']['dispatches']} per-batch"
+        )
+
+
 # -- section 3: regression gate vs committed baselines ----------------------
 
 
@@ -536,6 +608,8 @@ def main(argv=None) -> int:
     run_observatory(report, problems)
     print("== stepprof: profiler A/B engine runs ==")
     run_stepprof(report, problems, args.reps)
+    print("== stepprof: megaloop feed-idle gate ==")
+    run_megaloop_gate(report, problems)
     if not args.skip_bench:
         print("== regression: fresh bench vs committed baseline ==")
         run_bench_gate(report, problems, args.bench_budget)

@@ -193,6 +193,10 @@ def main() -> int:
                    help="limb-multiply engine axis: auto sweeps both the VPU "
                    "carry-save path and the MXU dot_general path (pins "
                    "NICE_TPU_MXU per config); on/off pins one of them")
+    p.add_argument("--megaloop", default="",
+                   help="megaloop segment lengths to sweep (pins "
+                   "NICE_TPU_MEGALOOP_SEGMENT per config; 1 = per-batch feed "
+                   "loop; empty = engine default)")
     p.add_argument("--block-batch", type=int, default=26,
                    help="log2 batch for the blocks sweep (26 matches the "
                    "committed BLOCK_ROWS sweep in ops/pallas_engine.py)")
@@ -224,13 +228,16 @@ def main() -> int:
         if args.sweep_rows else [None]
     carries = [int(c) for c in args.carry.split(",")]
     mxu_sweep = {"auto": [0, 1], "on": [1], "off": [0]}[args.mxu]
+    mega_sweep = [int(m) for m in args.megaloop.split(",")] \
+        if args.megaloop else [None]
 
-    def rec_for(batch_size, rows, carry, floor, el, use_mxu=None):
+    def rec_for(batch_size, rows, carry, floor, el, use_mxu=None,
+                megaloop=None):
         rec = {
             "kind": args.kind, "mode": args.mode, "base": data.base,
             "backend": args.backend, "batch_size": batch_size,
             "block_rows": rows, "carry_interval": carry,
-            "use_mxu": use_mxu,
+            "use_mxu": use_mxu, "megaloop": megaloop,
             "msd_floor": floor, "elapsed_secs": round(el, 6),
             "numbers_per_sec": round(args.slice / el, 1) if el > 0 else None,
         }
@@ -253,18 +260,19 @@ def main() -> int:
     elif args.kind == "stride-blocks":
         sweep_stride_blocks(data, [int(r) for r in args.rows.split(",")])
     elif args.kind == "detailed":
-        for shift, rows, carry, use_mxu in itertools.product(
-                shifts, rows_sweep, carries, mxu_sweep):
+        for shift, rows, carry, use_mxu, mega in itertools.product(
+                shifts, rows_sweep, carries, mxu_sweep, mega_sweep):
             _pin_env("NICE_TPU_BLOCK_ROWS", rows)
             _pin_env("NICE_TPU_CARRY_INTERVAL", carry)
             _pin_env("NICE_TPU_MXU", use_mxu)
+            _pin_env("NICE_TPU_MEGALOOP_SEGMENT", mega)
             el = time_detailed(data, 1 << shift, args.slice, args.backend)
             _emit(
                 args.json,
                 f"  batch 2^{shift} rows {rows or 'def'} carry {carry} "
-                f"mxu {use_mxu}: "
+                f"mxu {use_mxu} mega {mega or 'def'}: "
                 f"{el:8.3f}s  {args.slice / el / 1e6:10.1f} M n/s",
-                rec_for(1 << shift, rows, carry, None, el, use_mxu),
+                rec_for(1 << shift, rows, carry, None, el, use_mxu, mega),
             )
     else:
         from nice_tpu.ops import adaptive_floor
@@ -272,17 +280,19 @@ def main() -> int:
         for floor in (int(f) for f in args.floors.split(",")):
             os.environ["NICE_TPU_MSD_FLOOR"] = str(floor)
             adaptive_floor.reset_for_tests()  # re-read the pin
-            for shift, carry, use_mxu in itertools.product(
-                    shifts, carries, mxu_sweep):
+            for shift, carry, use_mxu, mega in itertools.product(
+                    shifts, carries, mxu_sweep, mega_sweep):
                 _pin_env("NICE_TPU_CARRY_INTERVAL", carry)
                 _pin_env("NICE_TPU_MXU", use_mxu)
+                _pin_env("NICE_TPU_MEGALOOP_SEGMENT", mega)
                 el = time_niceonly(data, args.slice, 1 << shift, args.backend)
                 _emit(
                     args.json,
                     f"  floor {floor:>8} batch 2^{shift} carry {carry} "
-                    f"mxu {use_mxu}: "
+                    f"mxu {use_mxu} mega {mega or 'def'}: "
                     f"{el:8.3f}s  {args.slice / el / 1e6:10.1f} M n/s",
-                    rec_for(1 << shift, None, carry, floor, el, use_mxu),
+                    rec_for(1 << shift, None, carry, floor, el, use_mxu,
+                            mega),
                 )
     return 0
 

@@ -21,7 +21,8 @@ def _isolated_table(tmp_path, monkeypatch):
         "NICE_TPU_AUTOTUNE_FILE", str(tmp_path / "winners.json")
     )
     for var in ("NICE_TPU_BATCH", "NICE_TPU_BLOCK_ROWS",
-                "NICE_TPU_CARRY_INTERVAL", "NICE_TPU_MXU"):
+                "NICE_TPU_CARRY_INTERVAL", "NICE_TPU_MXU",
+                "NICE_TPU_MEGALOOP", "NICE_TPU_MEGALOOP_SEGMENT"):
         monkeypatch.delenv(var, raising=False)
     autotune.reset_for_tests()
     yield
@@ -115,16 +116,43 @@ def test_resolve_tuning_precedence(monkeypatch):
         "detailed", 40, "jax",
         {"batch_size": 4096, "block_rows": 32, "carry_interval": 2},
     )
-    assert engine.resolve_tuning("detailed", 40, "jax") == (4096, 32, 2, 0)
-    bs, br, ci, mxu = engine.resolve_tuning("detailed", 40, "jax", 512)
-    assert (bs, br, ci, mxu) == (512, 32, 2, 0)
+    assert engine.resolve_tuning("detailed", 40, "jax") == (
+        4096, 32, 2, 0, engine.MEGALOOP_SEGMENT_DEFAULT,
+    )
+    bs, br, ci, mxu, mega = engine.resolve_tuning("detailed", 40, "jax", 512)
+    assert (bs, br, ci, mxu, mega) == (
+        512, 32, 2, 0, engine.MEGALOOP_SEGMENT_DEFAULT,
+    )
     monkeypatch.setenv("NICE_TPU_BLOCK_ROWS", "16")
     assert engine.resolve_tuning("detailed", 40, "jax")[1] == 16
     monkeypatch.delenv("NICE_TPU_BLOCK_ROWS")
     assert engine.resolve_tuning("detailed", 40, "scalar") == (
-        engine.DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0,
+        engine.DEFAULT_BATCH_SIZE, pe.BLOCK_ROWS, 0, 0, 1,
     )
     assert engine.resolve_tuning("detailed", 40, "scalar", 64)[0] == 64
+
+
+def test_megaloop_knob_precedence(monkeypatch):
+    """The fifth tuning knob: segment length resolves env > tuned > default,
+    and NICE_TPU_MEGALOOP=0 is an escape hatch that forces segment 1 (the
+    per-batch feed loop) regardless of winner or env segment."""
+    autotune.record("detailed", 40, "jax", {"batch_size": 4096, "megaloop": 4})
+    autotune.reset_for_tests()
+    assert engine.resolve_tuning("detailed", 40, "jax")[4] == 4
+    monkeypatch.setenv("NICE_TPU_MEGALOOP_SEGMENT", "2")
+    assert engine.resolve_tuning("detailed", 40, "jax")[4] == 2
+    monkeypatch.delenv("NICE_TPU_MEGALOOP_SEGMENT")
+    # Untuned key -> default segment.
+    assert (
+        engine.resolve_tuning("niceonly", 40, "jax")[4]
+        == engine.MEGALOOP_SEGMENT_DEFAULT
+    )
+    # Escape hatch wins over everything.
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    assert engine.resolve_tuning("detailed", 40, "jax")[4] == 1
+    # Host backends never megaloop.
+    monkeypatch.delenv("NICE_TPU_MEGALOOP")
+    assert engine.resolve_tuning("detailed", 40, "scalar")[4] == 1
 
 
 def test_use_mxu_roundtrip_and_env_pin(monkeypatch):

@@ -190,7 +190,7 @@ def test_find_resumable(tmp_path):
 # -- engine kill-resume equivalence -----------------------------------------
 
 
-def test_detailed_kill_resume_byte_identical(tmp_path):
+def test_detailed_kill_resume_byte_identical(tmp_path, monkeypatch):
     """The acceptance scenario: run a detailed scan checkpointing to disk,
     'kill' it by discarding the in-memory run at a mid-field snapshot, restart
     from the snapshot on disk, and require the submission payload to be
@@ -205,6 +205,8 @@ def test_detailed_kill_resume_byte_identical(tmp_path):
         ck.save(state)
         states.append(state)
 
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")  # per-batch ckpt cadence;
+    # the megaloop cadence is covered by the mid-megaloop test below.
     uninterrupted = engine.process_range_detailed(
         RANGE, BASE, backend="jnp", batch_size=256,
         checkpoint_cb=save_and_capture, checkpoint_batches=2,
@@ -237,7 +239,8 @@ def test_detailed_kill_resume_byte_identical(tmp_path):
     assert resumed.nice_numbers == ref.nice_numbers
 
 
-def test_niceonly_dense_resume_equivalence():
+def test_niceonly_dense_resume_equivalence(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")  # per-batch ckpt cadence
     states = []
     full = engine.process_range_niceonly(
         RANGE, BASE, backend="jnp", batch_size=256,
@@ -251,6 +254,73 @@ def test_niceonly_dense_resume_equivalence():
     assert resumed.nice_numbers == full.nice_numbers
     ref = scalar.process_range_niceonly(RANGE, BASE, None)
     assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_detailed_mid_megaloop_kill_resume_byte_identical(tmp_path, monkeypatch):
+    """Kill-resume with the megaloop ON: checkpoints fire between segment
+    dispatches (the readback cadence is batch_size * NICE_TPU_MEGALOOP_SEGMENT
+    lanes per device), and a run restarted from a between-segments snapshot
+    must submit byte-identically to an uninterrupted one."""
+    monkeypatch.setenv("NICE_TPU_MEGALOOP_SEGMENT", "2")
+    data = _field()
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), data, SearchMode.DETAILED, "jnp", 128
+    )
+    states = []
+
+    def save_and_capture(state):
+        ck.save(state)
+        states.append(state)
+
+    uninterrupted = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=128,
+        checkpoint_cb=save_and_capture, checkpoint_batches=1,
+        checkpoint_secs=0,
+    )
+    assert len(states) >= 2, "range too small to checkpoint between segments"
+    mid = states[len(states) // 2]
+    ck.save(mid)
+    resume = ck.load()
+    assert resume is not None
+    # Resume at a DIFFERENT segment length: the snapshot's remaining set is
+    # segment-granular but position-absolute, so cadence is not part of the
+    # signature and the resumed scan re-slices it.
+    monkeypatch.setenv("NICE_TPU_MEGALOOP_SEGMENT", "3")
+    resumed = engine.process_range_detailed(
+        RANGE, BASE, backend="jnp", batch_size=128, resume=resume,
+    )
+    a = compile_results(data, uninterrupted, SearchMode.DETAILED, "t")
+    b = compile_results(data, resumed, SearchMode.DETAILED, "t")
+    assert json.dumps(a.to_json(), sort_keys=True) == json.dumps(
+        b.to_json(), sort_keys=True
+    )
+    ref = scalar.process_range_detailed(RANGE, BASE)
+    assert resumed.distribution == ref.distribution
+    assert resumed.nice_numbers == ref.nice_numbers
+
+
+def test_manager_rejects_state_version_drift(tmp_path):
+    """A snapshot whose signature differs ONLY in the state-contract version
+    (e.g. a pre-megaloop v2 snapshot under the v3 engine) is rejected with
+    the dedicated 'state_version' reason — a fleet upgrade's restart cost is
+    visible as such, not lumped under generic signature drift."""
+    from nice_tpu.ckpt.snapshot import write_snapshot
+
+    rejected0 = CKPT_REJECTED.value(("state_version",))
+    sig_rejected0 = CKPT_REJECTED.value(("signature",))
+    ck = ckpt.FieldCheckpointer(
+        str(tmp_path), _field(), SearchMode.DETAILED, "jnp", 1024
+    )
+    assert ck.signature["state"] == 3
+    manifest, arrays = ckpt.manager._state_to_snapshot(_state())
+    manifest["signature"] = {**ck.signature, "state": 2}
+    manifest["field"] = ck.data.to_json()
+    write_snapshot(ck.path, manifest, arrays)
+    assert ck.load() is None
+    assert CKPT_REJECTED.value(("state_version",)) == rejected0 + 1
+    # Not double-counted under the generic reason, and the file is removed.
+    assert CKPT_REJECTED.value(("signature",)) == sig_rejected0
+    assert not os.path.exists(ck.path)
 
 
 def test_scalar_chunked_resume_equivalence():

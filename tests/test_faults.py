@@ -273,7 +273,10 @@ def _field(size):
     return FieldSize(lo, lo + size)
 
 
-def test_detailed_fallback_jnp_to_scalar_is_equivalent():
+def test_detailed_fallback_jnp_to_scalar_is_equivalent(monkeypatch):
+    # raise@2 indexes per-BATCH dispatches; the megaloop would collapse this
+    # field to one dispatch (megaloop fault fallback: test_megaloop.py).
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
     r = _field(40_000)
     canon = scalar.process_range_detailed(r, BASE)
     faults.configure("engine.dispatch:raise@2", seed=0)
@@ -321,6 +324,7 @@ def test_fallback_resumes_rather_than_restarts(monkeypatch):
         return orig(range_, base, mode, chunk, progress, checkpoint_cb,
                     resume, *args, **kwargs)
 
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")  # per-batch dispatch indexing
     monkeypatch.setattr(engine, "_chunked_host_scan", spy)
     faults.configure("engine.dispatch:raise@3", seed=0)
     res = engine.process_range_detailed(r, BASE, backend="jnp", batch_size=1024)

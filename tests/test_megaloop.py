@@ -1,0 +1,274 @@
+"""Megaloop equivalence tests: the device-resident lax.scan batch fusion.
+
+The megaloop (NICE_TPU_MEGALOOP / NICE_TPU_MEGALOOP_SEGMENT) folds segments
+of batch iterations into ONE dispatch with an in-program field cursor; its
+results must be byte-identical to the per-batch feed loop it replaces —
+across modes (detailed / niceonly dense / niceonly fused-filtered), kernels
+(jnp + pallas), shard layouts, segment lengths {1, 3, default}, and an
+elastic downshift that lands mid-slice.
+
+The conftest forces 8 virtual CPU devices, so unqualified runs exercise the
+sharded per-device megaloops (parallel/mesh.py); NICE_TPU_SHARD=0 runs pin
+the single-device executables (ops/vector_engine.py / ops/pallas_engine.py
+through ops/engine.py's compile cache).
+"""
+
+import jax
+import pytest
+
+from nice_tpu import faults
+from nice_tpu.core import base_range
+from nice_tpu.core.types import FieldSize
+from nice_tpu.obs.series import ENGINE_DISPATCHES
+from nice_tpu.ops import engine, scalar
+from nice_tpu.parallel import mesh as pmesh
+
+# None = default cadence (MEGALOOP_SEGMENT_DEFAULT); "1" pins the degenerate
+# one-iteration scan, which must route through the per-batch executables.
+SEGMENTS = ("1", "3", None)
+
+
+@pytest.fixture(autouse=True)
+def _mesh_and_cleanup(monkeypatch):
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
+    for var in ("NICE_TPU_MEGALOOP", "NICE_TPU_MEGALOOP_SEGMENT"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+    faults.reset()
+    pmesh.heal_devices()
+
+
+def _rng(base: int, count: int) -> FieldSize:
+    lo, _hi = base_range.get_base_range(base)
+    return FieldSize(lo, lo + count)
+
+
+def _pin_segment(monkeypatch, seg):
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "1")
+    if seg is None:
+        monkeypatch.delenv("NICE_TPU_MEGALOOP_SEGMENT", raising=False)
+    else:
+        monkeypatch.setenv("NICE_TPU_MEGALOOP_SEGMENT", seg)
+
+
+# -- detailed ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seg", SEGMENTS)
+def test_sharded_detailed_megaloop_matches_feed_loop(monkeypatch, seg):
+    """Sharded detailed at base 40: megaloop == per-batch feed == scalar
+    oracle on a ragged field (not a super-batch multiple, so the in-program
+    tail masking is exercised on the last segment)."""
+    base, rng = 40, _rng(40, 3000)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_detailed(
+        rng, base, backend="jax", batch_size=128
+    )
+    _pin_segment(monkeypatch, seg)
+    got = engine.process_range_detailed(
+        rng, base, backend="jax", batch_size=128
+    )
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    oracle = scalar.process_range_detailed(rng, base)
+    assert got.distribution == oracle.distribution
+    assert got.nice_numbers == oracle.nice_numbers
+
+
+@pytest.mark.slow  # XLA compile of the 29-limb plan runs multi-minute on CPU
+@pytest.mark.parametrize("seg", ("3", None))
+def test_sharded_detailed_megaloop_base510(monkeypatch, seg):
+    """Base 510 is the widest sweep plan (29 u32 limbs): the in-program
+    cursor advance must carry-propagate across every limb identically to the
+    host-side advance of the feed loop."""
+    base, rng = 510, _rng(510, 1500)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_detailed(
+        rng, base, backend="jax", batch_size=128
+    )
+    _pin_segment(monkeypatch, seg)
+    got = engine.process_range_detailed(
+        rng, base, backend="jax", batch_size=128
+    )
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+
+
+@pytest.mark.slow  # interpreter-mode compile of the scanned pallas callable
+def test_single_device_detailed_pallas_megaloop(monkeypatch):
+    """NICE_TPU_SHARD=0 + backend=pallas: the scanned _stats_callable
+    (pallas_engine megaloop) against the per-batch pallas path."""
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    base, rng = 40, _rng(40, 2000)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_detailed(
+        rng, base, backend="pallas", batch_size=256
+    )
+    _pin_segment(monkeypatch, "3")
+    got = engine.process_range_detailed(
+        rng, base, backend="pallas", batch_size=256
+    )
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+
+
+def test_megaloop_near_misses_extracted(monkeypatch):
+    """The rare-path survivor re-scan spans whole segments: base 10's known
+    near misses (incl. 69) must come back exactly through the megaloop."""
+    _pin_segment(monkeypatch, "3")
+    got = engine.process_range_detailed(
+        FieldSize(47, 100), 10, backend="jax", batch_size=16
+    )
+    want = scalar.process_range_detailed(FieldSize(47, 100), 10)
+    assert got.nice_numbers == want.nice_numbers
+    assert any(n.number == 69 for n in got.nice_numbers)
+
+
+def test_cursor_advance_b510_carry_propagation():
+    """Tier-1 witness for the in-program cursor at the widest plan (the full
+    b510 engine runs above are slow-marked: XLA's compile of the 29-limb
+    digit kernels is multi-minute on CPU). The scanned advance must match
+    host big-int addition across multi-limb carry chains."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nice_tpu.ops import vector_engine as ve
+    from nice_tpu.ops.limbs import get_plan, int_to_limbs, limbs_to_int
+
+    plan = get_plan(510)
+    lo, _hi = base_range.get_base_range(510)
+    # Engineered carry edges: range start, an all-ones low-limb block (the
+    # +batch carry ripples through every saturated limb), and a mid chain.
+    for start in (lo, lo | ((1 << 96) - 1), lo + (1 << 64) - 1):
+        cur = jnp.asarray(
+            np.array(int_to_limbs(start, plan.limbs_n), dtype=np.uint32)
+        )
+        for step in (1, 4096, (1 << 28)):
+            adv = ve._advance_cursor(plan, cur, step)
+            assert limbs_to_int(list(np.asarray(adv))) == start + step, (
+                start, step,
+            )
+
+
+# -- niceonly ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seg", SEGMENTS)
+def test_sharded_niceonly_megaloop_matches_feed_loop(monkeypatch, seg):
+    base, rng = 40, _rng(40, 30_000)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=128
+    )
+    _pin_segment(monkeypatch, seg)
+    got = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=128
+    )
+    assert got.nice_numbers == want.nice_numbers
+    oracle = scalar.process_range_niceonly(rng, base)
+    assert got.nice_numbers == oracle.nice_numbers
+
+
+def test_sharded_niceonly_megaloop_finds_69(monkeypatch):
+    """Positive-signal check: the aggregate per-segment count gates the
+    survivor extraction, which must still surface base 10's single nice
+    number through a multi-iteration scan."""
+    _pin_segment(monkeypatch, "3")
+    got = engine.process_range_niceonly(
+        FieldSize(47, 100), 10, backend="jnp", batch_size=16
+    )
+    assert [n.number for n in got.nice_numbers] == [69]
+
+
+@pytest.mark.slow  # XLA compile of the 29-limb plan runs multi-minute on CPU
+@pytest.mark.parametrize("seg", ("3", None))
+def test_sharded_niceonly_megaloop_base510(monkeypatch, seg):
+    base, rng = 510, _rng(510, 1500)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=128
+    )
+    _pin_segment(monkeypatch, seg)
+    got = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=128
+    )
+    assert got.nice_numbers == want.nice_numbers
+
+
+@pytest.mark.parametrize("fused", ("0", "1"))
+def test_single_device_niceonly_megaloop_fused_and_dense(monkeypatch, fused):
+    """NICE_TPU_SHARD=0 exercises the single-device niceonly megaloops:
+    fused=1 scans ve.niceonly_filtered_megaloop (residue filter + pruned
+    tally in the carry), fused=0 the dense kernel."""
+    monkeypatch.setenv("NICE_TPU_SHARD", "0")
+    monkeypatch.setenv("NICE_TPU_FUSED_FILTER", fused)
+    base, rng = 40, _rng(40, 30_000)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    want = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=256
+    )
+    _pin_segment(monkeypatch, "3")
+    got = engine.process_range_niceonly(
+        rng, base, backend="jnp", batch_size=256
+    )
+    assert got.nice_numbers == want.nice_numbers
+    oracle = scalar.process_range_niceonly(rng, base)
+    assert got.nice_numbers == oracle.nice_numbers
+
+
+# -- elastic downshift mid-slice --------------------------------------------
+
+
+def test_downshift_mid_megaloop_slice(monkeypatch):
+    """Kill a mesh device on segment-dispatch 3 with the megaloop ON: the
+    downshift reslices the un-dispatched remainder at the SAME segment
+    length over the survivors and the result stays byte-identical to the
+    fault-free scalar oracle — no whole-field downgrade."""
+    _pin_segment(monkeypatch, "2")
+    faults.configure("mesh.dispatch:dead@3")
+    rng = FieldSize(5541, 30941)  # full base-17 range: 25,400 candidates
+    got = engine.process_range_detailed(
+        rng, 17, backend="jnp", batch_size=128
+    )
+    want = scalar.process_range_detailed(rng, 17)
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades == ()
+    stats = engine.LAST_FEED_STATS
+    assert stats["reshards"] == 1
+    assert stats["n_dev_start"] == 8
+    assert stats["n_dev_end"] == 7
+
+
+def test_downshift_mid_megaloop_niceonly(monkeypatch):
+    _pin_segment(monkeypatch, "2")
+    faults.configure("mesh.dispatch:dead:0@2")
+    rng = FieldSize(5541, 30941)
+    got = engine.process_range_niceonly(
+        rng, 17, backend="jnp", batch_size=128
+    )
+    want = scalar.process_range_niceonly(rng, 17, None)
+    assert got.nice_numbers == want.nice_numbers
+    assert got.backend_downgrades == ()
+    assert engine.LAST_FEED_STATS["n_dev_end"] == 7
+
+
+# -- dispatch collapse ------------------------------------------------------
+
+
+def test_dispatch_counter_collapses_by_segment_factor(monkeypatch):
+    """The point of the megaloop: dispatches-per-slice drop by the segment
+    factor (nice_engine_dispatches_total{mode} — the counter bench.py and
+    the fleet page read)."""
+    base, rng = 40, _rng(40, 8192)
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+    d0 = ENGINE_DISPATCHES.value(("detailed",))
+    engine.process_range_detailed(rng, base, backend="jax", batch_size=128)
+    feed = ENGINE_DISPATCHES.value(("detailed",)) - d0
+    _pin_segment(monkeypatch, "4")
+    d1 = ENGINE_DISPATCHES.value(("detailed",))
+    engine.process_range_detailed(rng, base, backend="jax", batch_size=128)
+    mega = ENGINE_DISPATCHES.value(("detailed",)) - d1
+    # 8192 lanes over 128*8 per feed dispatch = 8; over 128*4*8 = 2.
+    assert feed == 8
+    assert mega == 2

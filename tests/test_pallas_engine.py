@@ -18,6 +18,15 @@ BR = 8  # block_rows for interpreter-mode tests
 BL = BR * 128  # lanes per block
 
 
+@pytest.fixture(autouse=True)
+def _per_batch_pallas(monkeypatch):
+    # These tests pin the per-BATCH pallas kernels; the default megaloop
+    # would wrap every engine dispatch in a scanned pallas callable whose
+    # interpreter-mode compile runs minutes per shape. The scanned path is
+    # covered by tests/test_megaloop.py.
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")
+
+
 def test_detailed_kernel_b10_golden():
     plan = get_plan(10)
     h, nm = pe.detailed_batch(

@@ -120,6 +120,7 @@ _EST_SECS = {
     ("msd-ineffective", "niceonly"): 20.0,
     ("extra-large", "niceonly"): 45.0,
     ("hi-base", "detailed"): 60.0,
+    ("multi-tenant", "detailed"): 60.0,
     ("massive", "niceonly"): 230.0,
 }
 _EST_DEFAULT = 60.0
@@ -153,6 +154,7 @@ DEFAULT_SUITE = (
     ("msd-ineffective", "niceonly"),
     ("extra-large", "niceonly"),
     ("hi-base", "detailed"),
+    ("multi-tenant", "detailed"),
     ("massive", "niceonly"),
 )
 HEADLINE = ("extra-large", "detailed")
@@ -406,6 +408,11 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     from nice_tpu.core.types import FieldSize
     from nice_tpu.ops import engine
 
+    if mode == "multi-tenant":
+        # Synthetic scheduler case, not a reference benchmark field: runs
+        # its own A/B and returns before the single-workload machinery.
+        return _run_multi_tenant(batch_size, n_chips)
+
     data = get_benchmark_field(BenchmarkMode(mode))
     # NICE_BENCH_SIZE clamps the field so huge cases (hi-base: 1e9 @ b80) can
     # EXECUTE as a short slice on CPU instead of budget-skipping: the line is
@@ -508,6 +515,95 @@ def _run_mode(mode: str, kind: str, batch_size: int, n_chips: int) -> dict:
     if cache_delta:
         line["compile_cache"] = cache_delta
     return line
+
+
+def _run_multi_tenant(batch_size: int, n_chips: int) -> dict:
+    """Aggregate-throughput A/B for the multi-tenant scheduler: a detailed
+    and a niceonly tenant interleaved page-by-page on one mesh vs the same
+    two workloads run back-to-back. Both arms run warm (compiles excluded),
+    so vs_sequential isolates the scheduler's switching overhead — the
+    zero-recompile-stall design predicts ~1.0. Results are also checked
+    byte-identical across arms (the ledger-equivalence contract)."""
+    from nice_tpu.core.benchmark import BenchmarkMode, get_benchmark_field
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import engine
+    from nice_tpu.sched import (
+        MultiTenantScheduler,
+        StaticSource,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    data = get_benchmark_field(BenchmarkMode("extra-large"))  # base 40
+    base = data.base
+    slice_size = max(4 * batch_size, 1 << 20)
+    size_cap = int(os.environ.get("NICE_BENCH_SIZE", "0"))
+    if 0 < size_cap < slice_size:
+        slice_size = size_cap
+    det_rng = FieldSize(data.range_start, data.range_start + slice_size)
+    nice_rng = FieldSize(
+        data.range_start + slice_size, data.range_start + 2 * slice_size
+    )
+
+    # Warm both tenants' shapes out of both timed regions.
+    engine.warm_detailed(base, batch_size=batch_size)
+    engine.process_range_niceonly(
+        FieldSize(data.range_start, data.range_start + 1), base,
+        backend="jax", batch_size=batch_size,
+    )
+
+    t0 = time.monotonic()
+    seq_det = engine.process_range_detailed(
+        det_rng, base, backend="jax", batch_size=batch_size
+    )
+    seq_nice = engine.process_range_niceonly(
+        nice_rng, base, backend="jax", batch_size=batch_size
+    )
+    seq_secs = time.monotonic() - t0
+
+    registry = TenantRegistry([
+        TenantSpec(name="det", mode="detailed", base=base, priority=2,
+                   backend="jax", batch_size=batch_size),
+        TenantSpec(name="nice", mode="niceonly", base=base, priority=1,
+                   backend="jax", batch_size=batch_size),
+    ])
+    source = StaticSource({
+        "det": [("det/f0", base, det_rng.start(), det_rng.end())],
+        "nice": [("nice/f0", base, nice_rng.start(), nice_rng.end())],
+    })
+    sched = MultiTenantScheduler(
+        registry, source, policy="deficit", page_batches=1,
+        quantum_secs=1e-9,
+    )
+    t0 = time.monotonic()
+    stats = sched.run()
+    int_secs = time.monotonic() - t0
+
+    got_det = source.results["det"]["det/f0"]
+    got_nice = source.results["nice"]["nice/f0"]
+    equal = (
+        got_det.distribution == seq_det.distribution
+        and got_det.nice_numbers == seq_det.nice_numbers
+        and got_nice.nice_numbers == seq_nice.nice_numbers
+    )
+    total = 2 * slice_size
+    value = total / int_secs / n_chips
+    return {
+        "metric": f"numbers/sec/chip sched (multi-tenant, base {base})",
+        "value": round(value, 1),
+        "unit": "numbers/sec/chip",
+        "vs_sequential": round(seq_secs / int_secs, 3),
+        "elapsed_secs": round(int_secs, 3),
+        "sequential_secs": round(seq_secs, 3),
+        "range_size": total,
+        "n_chips": n_chips,
+        "hits": len(got_det.nice_numbers) + len(got_nice.nice_numbers),
+        "pages": {t: s["pages"] for t, s in stats["tenants"].items()},
+        "preemptions": {
+            t: s["preemptions"] for t, s in stats["tenants"].items()
+        },
+        "results_equal": equal,
+    }
 
 
 def _hi_base_extras(data, batch_size: int) -> dict:

@@ -265,6 +265,21 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
         entries=("_BlockRenewer._run",),
         role="periodic",
     ),
+    # -------------------------------------------------------------- sched/
+    ThreadRoot(
+        name="sched-slo",
+        path="nice_tpu/sched/scheduler.py",
+        spawn_scope="MultiTenantScheduler.start_slo_thread",
+        entries=("MultiTenantScheduler.start_slo_thread.<locals>._slo_run",),
+        role="periodic",
+        locks=(
+            "sched.scheduler.MultiTenantScheduler._lock",
+            "obs.slo.SloEngine._lock",
+            "obs.history.HistoryStore._lock",
+        ),
+        notes="per-tenant SLO burn evaluation for long runs; tests drive "
+              "_slo_tick synchronously instead",
+    ),
     # -------------------------------------------------------------- utils/
     ThreadRoot(
         name="platform-probe",
@@ -298,6 +313,14 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
         entries=(),
         role="helper",
         notes="observatory server thread (stdlib serve_forever)",
+    ),
+    ThreadRoot(
+        name="sched-smoke-httpd",
+        path="scripts/sched_smoke.py",
+        spawn_scope="_start_server",
+        entries=(),
+        role="helper",
+        notes="smoke-test server thread (stdlib serve_forever)",
     ),
     ThreadRoot(
         name="critpath-smoke-client",
@@ -355,6 +378,10 @@ LOCK_SPECS: Tuple[LockSpec, ...] = (
     LockSpec("obs.journal._client_lock", "journal client slot",
              may_block_under=True),
     LockSpec("parallel.mesh._dead_lock", "dead-device set"),
+    LockSpec("parallel.mesh.OccupancyMeter._lock",
+             "busy-interval accumulator + observation window"),
+    LockSpec("sched.scheduler.MultiTenantScheduler._lock",
+             "per-tenant deficit/skip/boost maps + run counters"),
     LockSpec("parallel.mesh._step_lock", "step-fn cache"),
     LockSpec("parallel.mesh._DISPATCH_LOCK", "collective dispatch",
              may_block_under=True),
@@ -416,6 +443,20 @@ SHARED_STATE: Tuple[SharedState, ...] = (
     # obs/history.py
     SharedState("nice_tpu/obs/history.py", "<module>", "_sampler_started",
                 "lock:obs.history._sampler_lock"),
+    # sched/scheduler.py — the run loop mutates these while the sched-slo
+    # periodic and stats() readers look on.
+    SharedState("nice_tpu/sched/scheduler.py", "MultiTenantScheduler",
+                "_boost",
+                "lock:sched.scheduler.MultiTenantScheduler._lock"),
+    SharedState("nice_tpu/sched/scheduler.py", "MultiTenantScheduler",
+                "_deficit",
+                "lock:sched.scheduler.MultiTenantScheduler._lock"),
+    SharedState("nice_tpu/sched/scheduler.py", "MultiTenantScheduler",
+                "_skipped",
+                "lock:sched.scheduler.MultiTenantScheduler._lock"),
+    SharedState("nice_tpu/sched/scheduler.py", "MultiTenantScheduler",
+                "_exhausted",
+                "lock:sched.scheduler.MultiTenantScheduler._lock"),
 )
 
 

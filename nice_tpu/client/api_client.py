@@ -282,11 +282,26 @@ def retry_request(
 
 
 def get_field_from_server(
-    mode: SearchMode, api_base: str, username: str, max_retries: int = DEFAULT_MAX_RETRIES
+    mode: SearchMode, api_base: str, username: str,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    tenant: Optional[str] = None,
+    base_min: Optional[int] = None,
+    base_max: Optional[int] = None,
 ) -> DataToClient:
-    """GET /claim/{detailed|niceonly} (reference client_api_sync.rs:104-129)."""
+    """GET /claim/{detailed|niceonly} (reference client_api_sync.rs:104-129).
+
+    tenant / base_min / base_max are the multi-tenant scheduler's claim
+    routing: the claim row is stamped with the tenant name and the field is
+    drawn from the tenant's base window. Pre-sched servers ignore the extra
+    query params, so the scheduler degrades to unrouted claims."""
     endpoint = "detailed" if mode == SearchMode.DETAILED else "niceonly"
     url = f"{api_base}/claim/{endpoint}?username={urllib.request.quote(username)}"
+    if tenant is not None:
+        url += f"&tenant={urllib.request.quote(tenant)}"
+    if base_min is not None:
+        url += f"&base_min={int(base_min)}"
+    if base_max is not None:
+        url += f"&base_max={int(base_max)}"
     t0 = time.monotonic()
     data = DataToClient.from_json(
         retry_request(url, max_retries=max_retries, endpoint="claim")
@@ -358,15 +373,27 @@ def claim_block_from_server(
     username: str,
     count: int,
     max_retries: int = DEFAULT_MAX_RETRIES,
+    tenant: Optional[str] = None,
+    base_min: Optional[int] = None,
+    base_max: Optional[int] = None,
 ) -> tuple[str, list[DataToClient]]:
     """POST /claim_block — N fields per round-trip under one block lease.
 
     Returns (block_id, fields). A server that predates block leases answers
-    404; callers treat that ApiError as "fall back to per-field claims"."""
+    404; callers treat that ApiError as "fall back to per-field claims".
+    tenant / base_min / base_max route the whole block for a scheduler
+    tenant (see get_field_from_server)."""
     mode_arg = "detailed" if mode == SearchMode.DETAILED else "niceonly"
+    payload = {"mode": mode_arg, "count": count, "username": username}
+    if tenant is not None:
+        payload["tenant"] = tenant
+    if base_min is not None:
+        payload["base_min"] = int(base_min)
+    if base_max is not None:
+        payload["base_max"] = int(base_max)
     resp = retry_request(
         f"{api_base}/claim_block",
-        {"mode": mode_arg, "count": count, "username": username},
+        payload,
         max_retries=max_retries,
         endpoint="claim_block",
     )

@@ -151,6 +151,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(env NICE_TPU_CLAIM_BLOCK)",
     )
     p.add_argument(
+        "--tenants",
+        default=knobs.TENANTS.raw(),
+        help="run the multi-tenant scheduler instead of the single-workload "
+        "loop: semicolon-separated name:mode:base[:opt...] tenant specs "
+        "(opts prio=N, slo=SECS, bases=LO-HI, batch=N, backend=NAME; modes "
+        "also near-miss / hi-base) — see README 'Multi-tenant scheduling' "
+        "(env NICE_TPU_TENANTS)",
+    )
+    p.add_argument(
         "--renew-secs",
         type=float,
         default=float(_env("RENEW_SECS", 900)),
@@ -912,6 +921,47 @@ def run_block_pipelined_loop(
         block_id, fields = next_block.result()
 
 
+def run_tenants(args) -> int:
+    """Multi-tenant scheduler mode (--tenants / NICE_TPU_TENANTS): parse
+    the tenant specs, claim with tenant routing, and interleave every
+    tenant's pages on this process's mesh. --repeat keeps each tenant
+    claiming until the server runs dry; otherwise each tenant runs one
+    field (the smoke-friendly bound)."""
+    from nice_tpu import sched
+
+    registry = sched.TenantRegistry(sched.parse_tenants(args.tenants))
+    if not len(registry):
+        log.error("--tenants parsed to zero tenants")
+        return 2
+    source = sched.ServerSource(
+        args.api_base, args.username,
+        fields_per_tenant=None if args.repeat else 1,
+        max_retries=args.max_retries,
+    )
+    scheduler = sched.MultiTenantScheduler(registry, source)
+    from nice_tpu.ops import autotune
+
+    for row in autotune.tenant_report(
+        [(s.name, s.mode, s.base, s.backend) for s in registry]
+    ):
+        log.info(
+            "tenant %s: %s tuned=%s batch=%d megaloop=%d page_quantum=%d",
+            row["tenant"], row["key"], row["tuned"], row["batch_size"],
+            row["megaloop"], row["page_quantum"],
+        )
+    scheduler.start_slo_thread()
+    try:
+        stats = scheduler.run()
+    finally:
+        scheduler.stop_slo_thread()
+    log.info(
+        "scheduler done: %d rounds, occupancy %.2f; per-tenant %s",
+        stats["rounds"], stats["occupancy"],
+        {t: (v["fields"], v["pages"]) for t, v in stats["tenants"].items()},
+    )
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     # Unified JSON-line sink (trace_id injection; NICE_TPU_LOG_LEVEL /
@@ -950,6 +1000,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return run_benchmark(args)
     if args.validate:
         return run_validate(args)
+    if args.tenants:
+        return run_tenants(args)
     mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
     api = api_client.AsyncApi(args.api_base, args.username, args.max_retries)
     spool = spool_mod.maybe_spool(args.spool_dir, args.checkpoint_dir)

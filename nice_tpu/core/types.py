@@ -320,6 +320,9 @@ class ClaimRecord:
     client_token: Optional[str] = None
     lease_expiry: Optional[datetime] = None
     lease_secs: Optional[float] = None
+    # Multi-tenant scheduler routing: which named tenant this claim was
+    # issued for (None on single-workload claims and pre-sched rows).
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -339,6 +342,9 @@ class SubmissionRecord:
     distribution: Optional[list[UniquesDistribution]]
     numbers: list[NiceNumber]
     client_token: Optional[str] = None
+    # Derived from the owning claim (claims.tenant) when the row was
+    # submitted under a scheduler tenant; analytics group by it.
+    tenant: Optional[str] = None
 
 
 @dataclass(frozen=True)

@@ -49,6 +49,34 @@ def _untrusted_submission_ids(
     return frozenset(out)
 
 
+def _one_vote_per_tenant(
+    submissions: list[SubmissionRecord],
+) -> list[SubmissionRecord]:
+    """Collapse repeat submissions of identical content from the same named
+    tenant to the earliest one, so a standing re-scan tenant (near-miss
+    mining resubmits canon fields on every pass) cannot single-handedly
+    inflate a field's check level: each (tenant, content) pair casts one
+    consensus vote. Untenanted rows pass through untouched, so with no
+    tenants in play the consensus input — and output — is byte-identical
+    to before."""
+    seen: set = set()
+    out: list[SubmissionRecord] = []
+    for sub in submissions:  # id ASC: the earliest per pair is kept
+        if sub.tenant is None or sub.distribution is None:
+            out.append(sub)
+            continue
+        distribution = distribution_stats.shrink_distribution(sub.distribution)
+        distribution.sort(key=lambda d: d.num_uniques)
+        numbers = number_stats.shrink_numbers(sub.numbers)
+        numbers.sort(key=lambda n: n.number)
+        key = (sub.tenant, tuple(distribution), tuple(numbers))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(sub)
+    return out
+
+
 def run_consensus_for_base(db: Db, base: int) -> int:
     """Returns the number of fields whose canon/check_level changed."""
     from nice_tpu.utils import knobs
@@ -57,7 +85,9 @@ def run_consensus_for_base(db: Db, base: int) -> int:
     threshold = knobs.TRUST_THRESHOLD.get()
     trust_cache: dict = {}
     for field in db.get_fields_with_detailed_submissions(base):
-        submissions = db.get_detailed_submissions_by_field(field.field_id)
+        submissions = _one_vote_per_tenant(
+            db.get_detailed_submissions_by_field(field.field_id)
+        )
         untrusted_ids = _untrusted_submission_ids(
             db, submissions, threshold, trust_cache
         )

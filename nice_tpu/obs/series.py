@@ -615,8 +615,60 @@ for _seg in ("queue_wait", "claim_rtt", "ckpt_resume", "h2d_feed",
     CRITPATH_SEGMENT_P95.labels(_seg)
 for _resource in ("writer_busy", "device_busy", "feed_idle"):
     CRITPATH_UTILIZATION.labels(_resource)
-for _kind in ("journal", "anomaly", "slo", "critpath", "heartbeat"):
+for _kind in ("journal", "anomaly", "slo", "critpath", "heartbeat", "sched"):
     STREAM_EVENTS.labels(_kind)
+
+# --- multi-tenant scheduler (sched/) ------------------------------------
+# Tenant labels are operator-chosen names, so nothing here is pre-seeded:
+# the series appear the moment the scheduler dispatches its first page.
+SCHED_PAGES = metrics.counter(
+    "nice_sched_pages_total",
+    "Device pages dispatched by the multi-tenant scheduler, by tenant. One "
+    "page = one batch-aligned megaloop-segment quantum of a field.",
+    labelnames=("tenant",),
+)
+SCHED_PAGE_SECONDS = metrics.histogram(
+    "nice_sched_page_seconds",
+    "Wall time of one scheduled page (engine dispatch + fold), by tenant. "
+    "The per-tenant SLO specs (obs/slo.tenant_specs) burn against this.",
+    labelnames=("tenant",),
+    buckets=(0.01, 0.05, 0.25, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0),
+)
+SCHED_PREEMPTIONS = metrics.counter(
+    "nice_sched_preemptions_total",
+    "Tenant turns ended at a segment boundary before their work drained, "
+    "by preempted tenant and reason (quantum = time-slice expiry; "
+    "slo_boost = a burning tenant took the mesh).",
+    labelnames=("tenant", "reason"),
+)
+SCHED_OCCUPANCY = metrics.gauge(
+    "nice_sched_tenant_occupancy",
+    "Share of scheduler device-busy time attributed to each tenant over "
+    "the run so far (0..1; sums to ~1 across tenants once work flows).",
+    labelnames=("tenant",),
+)
+SCHED_MESH_OCCUPANCY = metrics.gauge(
+    "nice_sched_mesh_occupancy",
+    "Fraction of scheduler wall-clock the mesh spent executing pages "
+    "(0..1) — the interleaving win over sequential single-tenant runs.",
+)
+SCHED_SLO_BURN = metrics.gauge(
+    "nice_sched_slo_burn",
+    "Short-window SLO burn rate per tenant (1.0 = burning exactly at the "
+    "objective; drives the scheduler's priority boost).",
+    labelnames=("tenant",),
+)
+SCHED_STARVED = metrics.counter(
+    "nice_sched_tenant_starved_total",
+    "Anti-starvation interventions: rounds where a runnable tenant had "
+    "been skipped past the starvation bound and was force-scheduled.",
+    labelnames=("tenant",),
+)
+SCHED_FIELDS = metrics.counter(
+    "nice_sched_fields_total",
+    "Fields fully drained (all pages folded) by the scheduler, by tenant.",
+    labelnames=("tenant",),
+)
 
 # Flight-recorder + tracing series (M1: declared here, used by obs.flight /
 # obs.trace). Kinds the production hooks emit are pre-seeded so a scrape of
@@ -650,7 +702,11 @@ FLIGHT_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint",
                       "journal_write_failed", "anomaly_transition",
                       # critical-path engine: the fleet's dominant latency
                       # segment changed (obs/critpath.py)
-                      "bottleneck_shift")
+                      "bottleneck_shift",
+                      # multi-tenant scheduler (sched/): a tenant lost its
+                      # turn at a segment boundary, or the anti-starvation
+                      # bound fired for a skipped tenant.
+                      "sched_preemption", "tenant_starved")
 for _kind in FLIGHT_KNOWN_KINDS:
     FLIGHT_EVENTS.labels(_kind)
 for _reason in ("crash", "sigusr2", "quarantine", "manual"):

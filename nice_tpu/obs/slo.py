@@ -195,6 +195,32 @@ def default_specs() -> List[SloSpec]:
     ]
 
 
+def tenant_specs(pairs) -> List[SloSpec]:
+    """Per-tenant page-latency SLOs for the multi-tenant scheduler.
+
+    ``pairs`` is an iterable of ``(tenant_name, page_budget_secs)``; tenants
+    with a zero/negative budget get no spec. Windows are short (60s/300s)
+    because the scheduler feeds one point per page and reacts at page
+    granularity — the usual fleet-scale hour window would lag the
+    preemption decision it exists to drive. Thresholds and objectives stay
+    overridable through the NICE_TPU_SLO_* family like every other spec.
+    """
+    specs: List[SloSpec] = []
+    for name, budget_secs in pairs:
+        if budget_secs is None or budget_secs <= 0:
+            continue
+        specs.append(SloSpec(
+            f"tenant_{name}", "quantile",
+            series_prefix="nice_sched_page_seconds",
+            label_filter=f'tenant="{name}"',
+            threshold=float(budget_secs), objective=0.25,
+            short_secs=60.0, long_secs=300.0,
+            description=f"tenant {name}: page latency <= {budget_secs:g}s "
+                        "for 75% of pages",
+        ))
+    return specs
+
+
 class SloEngine:
     """Evaluates a spec list against a HistoryStore, tracking state
     transitions. Thread-safe: evaluate() runs on the writer periodic while

@@ -146,6 +146,36 @@ def choose(mode: str, base: int, backend: str, param: str, default: int) -> int:
     return default
 
 
+def tenant_report(workloads) -> list[dict]:
+    """Tuning status for a set of scheduler tenants: one row per
+    (name, mode, base, backend) workload saying whether a signature-valid
+    winner exists and what shape the tenant will actually run with
+    (resolve_tuning's precedence applied per tenant, not per process).
+    The multi-tenant scheduler logs this at startup and sched_smoke
+    archives it, treating the tuning table as production infrastructure
+    rather than a local file."""
+    from nice_tpu.ops import engine
+
+    out = []
+    for name, mode, base, backend in workloads:
+        tuned = params(mode, base, backend)
+        batch, rows, carry, mxu_flag, megaloop = engine.resolve_tuning(
+            mode, base, backend
+        )
+        out.append({
+            "tenant": name,
+            "key": key(mode, base, backend),
+            "tuned": tuned is not None,
+            "batch_size": batch,
+            "block_rows": rows,
+            "carry_interval": carry,
+            "use_mxu": mxu_flag,
+            "megaloop": megaloop,
+            "page_quantum": engine.page_quantum(mode, base, backend),
+        })
+    return out
+
+
 def record(mode: str, base: int, backend: str, new_params: dict,
            throughput: float | None = None, swept: list | None = None,
            phase_breakdown: dict | None = None) -> Path:

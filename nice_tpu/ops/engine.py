@@ -1266,6 +1266,21 @@ def resolve_tuning(
     return batch_size, block_rows, carry_interval, 1 if use_mxu else 0, megaloop
 
 
+def page_quantum(
+    mode: str, base: int, backend: str, batch_size: int | None = None,
+) -> int:
+    """Numbers per megaloop segment for this workload's tuned shape:
+    batch_size * megaloop under the same env > tuned > default precedence
+    as resolve_tuning. This is the scheduler's page-alignment quantum —
+    a sub-range cut at multiples of it starts and ends exactly on segment
+    boundaries, so a page handoff is an elastic interruption point and
+    never splits a fused lax.scan dispatch."""
+    resolved_batch, _rows, _carry, _mxu, megaloop = resolve_tuning(
+        mode, base, backend, batch_size
+    )
+    return max(1, int(resolved_batch)) * max(1, int(megaloop))
+
+
 def _batch_arg_shapes(plan):
     """Example (start_limbs, valid_count) arg shapes for AOT lowering."""
     import jax

@@ -1,0 +1,35 @@
+"""Multi-tenant ragged scheduler: pack heterogeneous (mode, base)
+workloads onto one pod.
+
+Layering: sched sits above ops/engine (pages run through the ordinary
+process_range_* entry points, so crash-resume, elastic downshift, and the
+megaloop all apply unchanged) and above parallel/mesh (occupancy
+accounting); obs provides the per-tenant SLO burn feedback. Nothing under
+nice_tpu/ imports sched — the client opts in via NICE_TPU_TENANTS /
+--tenants, and the server only sees the tenant name on claim rows.
+"""
+
+from nice_tpu.sched.pagetable import FieldWork, Page, PageTable
+from nice_tpu.sched.scheduler import MultiTenantScheduler
+from nice_tpu.sched.source import ServerSource, StaticSource
+from nice_tpu.sched.tenants import (
+    TenantRegistry,
+    TenantSpec,
+    hi_base_sweep_tenant,
+    near_miss_tenant,
+    parse_tenants,
+)
+
+__all__ = [
+    "FieldWork",
+    "Page",
+    "PageTable",
+    "MultiTenantScheduler",
+    "ServerSource",
+    "StaticSource",
+    "TenantRegistry",
+    "TenantSpec",
+    "hi_base_sweep_tenant",
+    "near_miss_tenant",
+    "parse_tenants",
+]

@@ -537,16 +537,22 @@ def _claim_fields(
     max_check_level: int,
     max_range_size: int,
     count: int,
+    base_min: int | None = None,
+    base_max: int | None = None,
 ):
     """Pick up to count fields: queue fast path first, then the claim engine,
     then the possibly-active fallback (reference api/src/main.rs:150-168).
     Runs inside a writer-actor operation, so the pops + lease stamps of one
-    block are a single transaction."""
+    block are a single transaction. Tenant base predicates (base_min /
+    base_max) bypass the prefilled queues — those hold an unpredicated mix —
+    and go straight to the claim engine's SQL window."""
+    predicated = base_min is not None or base_max is not None
     fields = []
     if search_mode == SearchMode.NICEONLY:
-        fields = ctx.queue.claim_niceonly_many(count)
+        if not predicated:
+            fields = ctx.queue.claim_niceonly_many(count)
         if len(fields) < count:
-            if not fields:
+            if not fields and not predicated:
                 log.warning("niceonly queue exhausted; direct database claim")
             fields += ctx.db._claim_batch(
                 FieldClaimStrategy.NEXT,
@@ -554,9 +560,11 @@ def _claim_fields(
                 0,
                 max_range_size,
                 count - len(fields),
+                base_min=base_min,
+                base_max=base_max,
             )
     else:
-        if claim_strategy == FieldClaimStrategy.THIN:
+        if claim_strategy == FieldClaimStrategy.THIN and not predicated:
             fields = ctx.queue.claim_detailed_thin_many(count)
         if len(fields) < count:
             fields += ctx.db._claim_batch(
@@ -565,6 +573,8 @@ def _claim_fields(
                 max_check_level,
                 max_range_size,
                 count - len(fields),
+                base_min=base_min,
+                base_max=base_max,
             )
     if not fields:
         # Everything is recently claimed: fall back to possibly-active fields
@@ -576,8 +586,29 @@ def _claim_fields(
         fields = ctx.db._claim_batch(
             FieldClaimStrategy.NEXT, now_utc(), max_check_level,
             max_range_size, count, order_by=ctx.db.PREFER_ABANDONED,
+            base_min=base_min, base_max=base_max,
         )
     return fields
+
+
+def _parse_tenant_args(args: dict) -> tuple[str | None, int | None, int | None]:
+    """Extract (tenant, base_min, base_max) from query params / payload.
+    Tenant names are length-capped free text (they label journal rows and
+    metrics); base bounds must be integers when present."""
+    tenant = args.get("tenant")
+    if tenant is not None:
+        tenant = str(tenant).strip()[:64] or None
+    bounds = []
+    for key in ("base_min", "base_max"):
+        raw = args.get(key)
+        if raw is None or raw == "":
+            bounds.append(None)
+            continue
+        try:
+            bounds.append(int(raw))
+        except (TypeError, ValueError):
+            raise ApiError(400, f"{key} must be an integer, got {raw!r}")
+    return tenant, bounds[0], bounds[1]
 
 
 def claim_helper(
@@ -585,6 +616,9 @@ def claim_helper(
     search_mode: SearchMode,
     user_ip: str,
     client_token: str | None = None,
+    tenant: str | None = None,
+    base_min: int | None = None,
+    base_max: int | None = None,
 ) -> DataToClient:
     """Claim one field (the per-field compatibility path)."""
     untrusted = client_token is not None and not ctx.trust.is_trusted(
@@ -600,7 +634,8 @@ def claim_helper(
 
     def op():
         fields = _claim_fields(
-            ctx, search_mode, claim_strategy, max_check_level, max_range_size, 1
+            ctx, search_mode, claim_strategy, max_check_level, max_range_size,
+            1, base_min=base_min, base_max=base_max,
         )
         if not fields:
             raise ApiError(
@@ -611,11 +646,13 @@ def claim_helper(
         field = fields[0]
         claim = ctx.db.insert_claim(
             field.field_id, search_mode, user_ip,
-            client_token=client_token, lease_secs=lease_secs,
+            client_token=client_token, lease_secs=lease_secs, tenant=tenant,
         )
         # Writer-queue wait measured at the actor (critical-path segment):
         # the claim's slice of writer_wait, mirroring submit_accepted's.
         extra = {}
+        if tenant is not None:
+            extra["tenant"] = tenant
         wait = writer_mod.current_op_wait_secs()
         if wait is not None:
             extra["writer_wait"] = round(wait, 6)
@@ -630,6 +667,12 @@ def claim_helper(
         return field, claim
 
     field, claim = ctx.write(op)
+    if tenant is not None:
+        ctx.stream.publish("sched", {
+            "event": "tenant_claim", "tenant": tenant,
+            "field_id": field.field_id, "claim_id": claim.claim_id,
+            "mode": search_mode.value, "base": field.base,
+        })
     log.info(
         "New Claim: mode=%s strategy=%s field=%d claim=%d",
         search_mode,
@@ -681,11 +724,12 @@ def handle_claim_block(
     )
     lease_secs = _claim_lease_secs(untrusted)
     tier = _trust_tier(ctx, client_token)
+    tenant, base_min, base_max = _parse_tenant_args(payload)
 
     def op():
         fields = _claim_fields(
             ctx, search_mode, claim_strategy, max_check_level, max_range_size,
-            count,
+            count, base_min=base_min, base_max=base_max,
         )
         if not fields:
             raise ApiError(
@@ -696,9 +740,11 @@ def handle_claim_block(
         block_id = secrets.token_hex(12)
         claims = ctx.db.insert_claims_block(
             [f.field_id for f in fields], search_mode, user_ip, block_id,
-            client_token=client_token, lease_secs=lease_secs,
+            client_token=client_token, lease_secs=lease_secs, tenant=tenant,
         )
         extra = {}
+        if tenant is not None:
+            extra["tenant"] = tenant
         wait = writer_mod.current_op_wait_secs()
         if wait is not None:
             extra["writer_wait"] = round(wait, 6)
@@ -715,6 +761,12 @@ def handle_claim_block(
         return block_id, fields, claims
 
     block_id, fields, claims = ctx.write(op)
+    if tenant is not None:
+        ctx.stream.publish("sched", {
+            "event": "tenant_block_claim", "tenant": tenant,
+            "block_id": block_id, "fields": len(fields),
+            "mode": search_mode.value,
+        })
     SERVER_BLOCK_LEASE_SIZE.observe(len(fields))
     log.info(
         "New Block Claim: mode=%s strategy=%s block=%s fields=%d",
@@ -1720,8 +1772,16 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
             client_token = trust_mod.resolve_token(
                 {}, request.headers, "", user_ip, store=ctx.trust
             )
+            qs = parse_qs(parsed.query)
+            tenant, base_min, base_max = _parse_tenant_args(
+                {k: v[0] for k, v in qs.items() if v}
+            )
             return _json_response(
-                200, claim_helper(ctx, mode, user_ip, client_token).to_json()
+                200,
+                claim_helper(
+                    ctx, mode, user_ip, client_token,
+                    tenant=tenant, base_min=base_min, base_max=base_max,
+                ).to_json(),
             )
         if method == "GET" and path == "/claim/validate":
             qs = parse_qs(parsed.query)
@@ -1748,6 +1808,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "fleet": ctx.cached_fleet_block(),
                     "slo": ctx.slo.last(),
                     "anomalies": ctx.anomaly.last(),
+                    "tenants": ctx.db.tenant_rollup(),
                 },
             )
         if method == "GET" and path == "/history":

@@ -281,6 +281,17 @@ class Db:
                     "CREATE INDEX IF NOT EXISTS idx_claims_user_ip"
                     " ON claims(user_ip) WHERE lease_expiry IS NOT NULL"
                 )
+                # Multi-tenant scheduler routing: claims carry the tenant
+                # name they were issued for (NULL on single-workload claims);
+                # submissions inherit it through their claim at query time.
+                if "tenant" not in claim_cols:
+                    self._conn.execute(
+                        "ALTER TABLE claims ADD COLUMN tenant TEXT"
+                    )
+                self._conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_claims_tenant"
+                    " ON claims(tenant) WHERE tenant IS NOT NULL"
+                )
 
     def close(self) -> None:
         with self._lock, self._pool_lock:
@@ -520,10 +531,13 @@ class Db:
         maximum_timestamp: datetime,
         maximum_check_level: int,
         maximum_size: int,
+        base_min: Optional[int] = None,
+        base_max: Optional[int] = None,
     ) -> Optional[FieldRecord]:
         """Claim one field (reference db_util/fields.rs:204-484)."""
         got = self._claim_batch(
-            claim_strategy, maximum_timestamp, maximum_check_level, maximum_size, 1
+            claim_strategy, maximum_timestamp, maximum_check_level, maximum_size, 1,
+            base_min=base_min, base_max=base_max,
         )
         return got[0] if got else None
 
@@ -535,6 +549,8 @@ class Db:
         maximum_size: int,
         count: int,
         order_by: str = "id ASC",
+        base_min: Optional[int] = None,
+        base_max: Optional[int] = None,
     ) -> list[FieldRecord]:
         now = now_utc()
         cl_sql, cl_params = self._cl_predicate(maximum_check_level)
@@ -542,6 +558,15 @@ class Db:
             f"COALESCE(last_claim_time, '') <= ? AND {cl_sql} AND range_size <= ?"
         )
         base_params = [ts(maximum_timestamp), *cl_params, pad(maximum_size)]
+        # Tenant base predicates (multi-tenant claim routing): restrict the
+        # claim to the tenant's base window so e.g. a bases>510 sweep tenant
+        # never drains low-base inventory.
+        if base_min is not None:
+            base_where += " AND base_id >= ?"
+            base_params.append(base_min)
+        if base_max is not None:
+            base_where += " AND base_id <= ?"
+            base_params.append(base_max)
 
         if claim_strategy == FieldClaimStrategy.NEXT:
             return self._claim_rows(
@@ -852,6 +877,7 @@ class Db:
         user_ip: str,
         client_token: Optional[str] = None,
         lease_secs: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> ClaimRecord:
         when = now_utc()
         mode = "detailed" if search_mode == SearchMode.DETAILED else "niceonly"
@@ -861,11 +887,11 @@ class Db:
         with self._lock, self._txn():
             cur = self._conn.execute(
                 "INSERT INTO claims (field_id, search_mode, claim_time,"
-                " user_ip, client_token, lease_expiry, lease_secs)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+                " user_ip, client_token, lease_expiry, lease_secs, tenant)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     field_id, mode, ts(when), user_ip, client_token,
-                    None if expiry is None else ts(expiry), lease_secs,
+                    None if expiry is None else ts(expiry), lease_secs, tenant,
                 ),
             )
             claim_id = cur.lastrowid
@@ -878,6 +904,7 @@ class Db:
             client_token=client_token,
             lease_expiry=expiry,
             lease_secs=lease_secs,
+            tenant=tenant,
         )
 
     # -- block claim leases (one lease covering N fields; /claim_block) -----
@@ -890,6 +917,7 @@ class Db:
         block_id: str,
         client_token: Optional[str] = None,
         lease_secs: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> list[ClaimRecord]:
         """Mint one claim row per field, all stamped with block_id, in one
         transaction. The per-field last_claim_time was already stamped by the
@@ -907,10 +935,11 @@ class Db:
                 cur = self._conn.execute(
                     "INSERT INTO claims (field_id, search_mode, claim_time,"
                     " user_ip, block_id, client_token, lease_expiry,"
-                    " lease_secs) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                    " lease_secs, tenant) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (
                         fid, mode, ts(when), user_ip, block_id, client_token,
                         None if expiry is None else ts(expiry), lease_secs,
+                        tenant,
                     ),
                 )
                 out.append(
@@ -923,6 +952,7 @@ class Db:
                         client_token=client_token,
                         lease_expiry=expiry,
                         lease_secs=lease_secs,
+                        tenant=tenant,
                     )
                 )
         return out
@@ -942,6 +972,7 @@ class Db:
             if "lease_expiry" in keys
             else None,
             lease_secs=row["lease_secs"] if "lease_secs" in keys else None,
+            tenant=row["tenant"] if "tenant" in keys else None,
         )
 
     def get_block_claims(self, block_id: str) -> list[ClaimRecord]:
@@ -984,6 +1015,49 @@ class Db:
         if row is None:
             raise KeyError(f"no claim {claim_id}")
         return self._row_to_claim(row)
+
+    def tenant_rollup(self) -> list[dict]:
+        """Per-(tenant, mode, base) claim/submission counts for /status and
+        the fleet dashboard's tenant-occupancy strip. Submissions attribute
+        through their claim; only rows minted under a named tenant appear.
+        Grouping includes base so interleaved tenant submissions never
+        conflate into one progress line (search_progress relies on this)."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT c.tenant AS tenant, c.search_mode AS mode,"
+                " f.base_id AS base,"
+                " COUNT(DISTINCT c.id) AS claims,"
+                " COUNT(DISTINCT s.id) AS submissions"
+                " FROM claims c"
+                " JOIN fields f ON c.field_id = f.id"
+                " LEFT JOIN submissions s ON s.claim_id = c.id"
+                " WHERE c.tenant IS NOT NULL"
+                " GROUP BY c.tenant, c.search_mode, f.base_id"
+                " ORDER BY c.tenant ASC, f.base_id ASC",
+            ).fetchall()
+        return [
+            {
+                "tenant": r["tenant"],
+                "mode": r["mode"],
+                "base": r["base"],
+                "claims": r["claims"],
+                "submissions": r["submissions"],
+            }
+            for r in rows
+        ]
+
+    def get_submissions_by_tenant(self, tenant: str) -> list[SubmissionRecord]:
+        """Every submission made under a tenant's claims, in field order —
+        the per-tenant ledger sched_smoke diffs against its single-tenant
+        oracle."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT s.*, c.tenant AS tenant FROM submissions s"
+                " JOIN claims c ON s.claim_id = c.id WHERE c.tenant = ?"
+                " ORDER BY s.field_id ASC, s.id ASC",
+                (tenant,),
+            ).fetchall()
+        return [self._row_to_submission(r) for r in rows]
 
     # -- submissions -------------------------------------------------------
 
@@ -1039,6 +1113,7 @@ class Db:
         return None if row is None else self._row_to_submission(row)
 
     def _row_to_submission(self, row: sqlite3.Row) -> SubmissionRecord:
+        keys = row.keys()
         return SubmissionRecord(
             submission_id=row["id"],
             claim_id=row["claim_id"],
@@ -1055,8 +1130,11 @@ class Db:
             distribution=_dist_from_json(row["distribution"]),
             numbers=_numbers_from_json(row["numbers"]),
             client_token=row["client_token"]
-            if "client_token" in row.keys()
+            if "client_token" in keys
             else None,
+            # Populated only by queries that join claims and alias
+            # c.tenant AS tenant; plain SELECT * rows leave it None.
+            tenant=row["tenant"] if "tenant" in keys else None,
         )
 
     def get_submission_by_id(self, submission_id: int) -> SubmissionRecord:
@@ -1071,8 +1149,10 @@ class Db:
     def get_detailed_submissions_by_field(self, field_id: int) -> list[SubmissionRecord]:
         with self._read_conn() as conn:
             rows = conn.execute(
-                "SELECT * FROM submissions WHERE field_id = ? AND"
-                " search_mode = 'detailed' AND disqualified = 0 ORDER BY id ASC",
+                "SELECT s.*, c.tenant AS tenant FROM submissions s"
+                " LEFT JOIN claims c ON s.claim_id = c.id"
+                " WHERE s.field_id = ? AND s.search_mode = 'detailed'"
+                " AND s.disqualified = 0 ORDER BY s.id ASC",
                 (field_id,),
             ).fetchall()
         return [self._row_to_submission(r) for r in rows]

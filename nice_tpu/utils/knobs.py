@@ -735,6 +735,56 @@ SCHEDEX_TIMEOUT_SECS = _k(
     owner="analysis/schedex.py", group="analysis",
 )
 
+# --- Multi-tenant scheduler (nice_tpu/sched/) ------------------------------
+
+TENANTS = _k(
+    "NICE_TPU_TENANTS", "str", None,
+    "Tenant spec list for the multi-tenant scheduler: semicolon-separated"
+    " `name:mode:base[:opt...]` entries where mode is detailed, niceonly,"
+    " near-miss, or hi-base and opts are prio=N, slo=SECS, bases=LO-HI,"
+    " batch=N, backend=NAME (see README 'Multi-tenant scheduling'). Unset"
+    " means the client runs single-workload as before.",
+    owner="sched/tenants.py", group="sched",
+    default_doc="single-workload mode",
+)
+SCHED_PAGE_BATCHES = _k(
+    "NICE_TPU_SCHED_PAGE_BATCHES", "int", 4,
+    "Page size in megaloop segments: one device page spans this many"
+    " batch-aligned segments of the owning tenant's tuned"
+    " batch_size*megaloop quantum, so every page boundary is an elastic"
+    " interruption point.",
+    owner="sched/pagetable.py", group="sched",
+)
+SCHED_QUANTUM_SECS = _k(
+    "NICE_TPU_SCHED_QUANTUM_SECS", "float", 5.0,
+    "Time-slice per tenant turn; the scheduler preempts at the next page"
+    " boundary after this many seconds and rotates per policy. <=0"
+    " disables time-based preemption (tenants drain a whole field per"
+    " turn).",
+    owner="sched/scheduler.py", group="sched",
+)
+SCHED_POLICY = _k(
+    "NICE_TPU_SCHED_POLICY", "str", "deficit",
+    "Tenant selection policy: deficit (priority-weighted deficit"
+    " round-robin, default), priority (strict highest-priority-first),"
+    " or rr (plain round-robin ignoring priorities).",
+    owner="sched/scheduler.py", group="sched",
+)
+SCHED_STARVATION_ROUNDS = _k(
+    "NICE_TPU_SCHED_STARVATION_ROUNDS", "int", 8,
+    "Anti-starvation bound: a runnable tenant skipped this many"
+    " consecutive scheduling rounds is force-scheduled next (emitting a"
+    " tenant_starved flight event). <=0 disables the bound.",
+    owner="sched/scheduler.py", group="sched",
+)
+SCHED_SLO_BOOST = _k(
+    "NICE_TPU_SCHED_SLO_BOOST", "int", 2,
+    "Priority points temporarily added to a tenant whose page-latency SLO"
+    " is burning (warn state adds this once, page state twice), letting"
+    " burn rates from obs/slo.py pull a lagging tenant forward.",
+    owner="sched/scheduler.py", group="sched",
+)
+
 
 # ---------------------------------------------------------------------------
 # Documentation rendering (docs/KNOBS.md + README tables). nicelint's K1
@@ -750,6 +800,7 @@ _GROUP_TITLES = {
     "faults": "Chaos / fault injection",
     "lockdep": "Lock diagnostics",
     "analysis": "Static analysis",
+    "sched": "Multi-tenant scheduler",
     "general": "General",
 }
 

@@ -1,0 +1,291 @@
+"""Multi-tenant scheduler smoke check against a real server.
+
+Boots the real API server IN PROCESS (app.serve on a thread — same
+router/writer/journal stack the subprocess smokes exercise), seeds a
+detailed base and a niceonly base, then drives a three-tenant
+MultiTenantScheduler over ServerSource:
+
+  1. tenants: a priority-3 detailed canon tenant (base 10), a priority-1
+     niceonly tenant (base 12), and the standing near-miss mining tenant
+     (priority 0, detailed re-scans of the base-10 inventory) — claims
+     carry the tenant name + base window through the public API;
+  2. after a fixed number of interleaved rounds, flip the mining tenant's
+     priority to 5 mid-run (TenantRegistry.replace) and drain: mining's
+     share of scheduled pages must SHIFT UP vs the pre-flip phase;
+  3. the post-flip phase must run with ZERO new stepprof compile seconds
+     and zero compile-cache executable misses — tenant page switches
+     re-enter warm executables, never recompile;
+  4. after the drain, every ledger row under every tenant
+     (db.get_submissions_by_tenant) must match the scalar single-tenant
+     oracle for its field byte-for-byte, and /status must carry the
+     per-(tenant, mode, base) rollup with a claim+submission count for
+     all three tenants.
+
+Artifact: SCHED_r01.json in the workdir. Prints ONE JSON line. Usage:
+
+    python scripts/sched_smoke.py [workdir]
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DETAILED_BASE = 10  # [47, 100) -> 11 fields at field_size=5
+NICEONLY_BASE = 12  # [144, 330)
+FIELD_SIZE_DETAILED = 5
+FIELD_SIZE_NICEONLY = 20
+PRE_FLIP_ROUNDS = 8
+BATCH = 512
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_server(db_path: str, port: int):
+    """In-process server on a daemon thread (threadspec: sched-smoke-httpd).
+    Returns (server, thread); server.shutdown() stops it."""
+    from nice_tpu.server import app
+
+    server = app.serve(db_path, "127.0.0.1", port)
+    thread = threading.Thread(
+        target=server.serve_forever, name="sched-smoke-httpd", daemon=True
+    )
+    thread.start()
+    return server, thread
+
+
+def _wait_listening(port: int, timeout: float = 30) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _compile_secs() -> float:
+    """Total stepprof-attributed compile seconds across all plan keys."""
+    from nice_tpu.obs import stepprof
+
+    return sum(
+        v.get("compile", 0.0) for v in stepprof.cumulative().values()
+    )
+
+
+def _page_shares(stats: dict) -> dict:
+    pages = {t: s["pages"] for t, s in stats["tenants"].items()}
+    total = sum(pages.values()) or 1
+    return {t: p / total for t, p in pages.items()}
+
+
+def _check_ledger(db, failures: list) -> dict:
+    """Every tenant submission vs the scalar single-tenant oracle."""
+    from nice_tpu.core import distribution_stats, number_stats
+    from nice_tpu.core.types import FieldSize
+    from nice_tpu.ops import scalar
+
+    field_map = {}
+    for base in (DETAILED_BASE, NICEONLY_BASE):
+        for f in db.get_fields_in_base(base):
+            field_map[f.field_id] = (base, f.range_start, f.range_end)
+    checked = {}
+    for tenant in ("canon", "nice", "mining"):
+        subs = db.get_submissions_by_tenant(tenant)
+        if not subs:
+            failures.append(f"tenant {tenant}: no ledger submissions")
+            continue
+        ok = 0
+        for sub in subs:
+            base, start, end = field_map[sub.field_id]
+            rng = FieldSize(start, end)
+            if sub.distribution is not None:
+                want = scalar.process_range_detailed(rng, base)
+                got_dist = distribution_stats.shrink_distribution(
+                    sub.distribution
+                )
+                if got_dist != list(want.distribution):
+                    failures.append(
+                        f"tenant {tenant} field {sub.field_id}: distribution"
+                        " diverges from the scalar oracle"
+                    )
+                    continue
+            else:
+                want = scalar.process_range_niceonly(rng, base, None)
+            got_nums = number_stats.shrink_numbers(sub.numbers)
+            if got_nums != list(want.nice_numbers):
+                failures.append(
+                    f"tenant {tenant} field {sub.field_id}: numbers diverge"
+                    " from the scalar oracle"
+                )
+                continue
+            ok += 1
+        checked[tenant] = {"submissions": len(subs), "oracle_matches": ok}
+    return checked
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    if len(sys.argv) > 1:
+        workdir = sys.argv[1]
+        os.makedirs(workdir, exist_ok=True)
+        cleanup = False
+    else:
+        workdir = tempfile.mkdtemp(prefix="sched-smoke-")
+        cleanup = True
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("NICE_TPU_HOST_NICEONLY_MAX", "0")
+    # Small pages (batch x 2-segment megaloop) + compile attribution on.
+    os.environ.setdefault("NICE_TPU_MEGALOOP_SEGMENT", "2")
+    os.environ["NICE_TPU_STEPPROF"] = "1"
+
+    from nice_tpu.ops import compile_cache
+    from nice_tpu.sched import (
+        MultiTenantScheduler,
+        ServerSource,
+        TenantRegistry,
+        TenantSpec,
+        near_miss_tenant,
+    )
+    from nice_tpu.server.db import Db
+
+    failures = []
+    db_path = os.path.join(workdir, "sched.db")
+    db = Db(db_path)
+    db.seed_base(DETAILED_BASE, field_size=FIELD_SIZE_DETAILED)
+    db.seed_base(NICEONLY_BASE, field_size=FIELD_SIZE_NICEONLY)
+    db.close()
+
+    port = _pick_port()
+    server, server_thread = _start_server(db_path, port)
+    api_base = f"http://127.0.0.1:{port}"
+    line = {"ok": False, "workdir": workdir}
+    try:
+        if not _wait_listening(port):
+            failures.append("server never listened")
+            raise RuntimeError("boot")
+
+        mining = dataclasses.replace(
+            near_miss_tenant(DETAILED_BASE, name="mining"),
+            backend="jnp", batch_size=BATCH,
+        )
+        registry = TenantRegistry([
+            TenantSpec(
+                name="canon", mode="detailed", base=DETAILED_BASE,
+                priority=3, slo_page_secs=5.0, backend="jnp",
+                batch_size=BATCH,
+            ),
+            TenantSpec(
+                name="nice", mode="niceonly", base=NICEONLY_BASE,
+                priority=1, backend="jnp", batch_size=BATCH,
+            ),
+            mining,
+        ])
+        source = ServerSource(api_base, "sched-smoke")
+        sched = MultiTenantScheduler(
+            registry, source, policy="deficit", page_batches=1,
+            quantum_secs=1e-9,
+        )
+
+        # Phase 1: interleave under the seeded priorities (compiles land
+        # here, via warm() and any first-dispatch stragglers).
+        stats1 = sched.run(max_rounds=PRE_FLIP_ROUNDS)
+        shares1 = _page_shares(stats1)
+        compile_secs1 = _compile_secs()
+        cc1 = compile_cache.counts()
+
+        # Phase 2: flip mining 0 -> 5 mid-run and drain.
+        registry.replace(dataclasses.replace(mining, priority=5))
+        stats2 = sched.run()
+        shares2 = _page_shares(stats2)
+        compile_secs2 = _compile_secs()
+        cc2 = compile_cache.counts()
+
+        # Occupancy shifted: mining's page share rose after the flip.
+        phase2_pages = {
+            t: stats2["tenants"][t]["pages"] - stats1["tenants"][t]["pages"]
+            for t in stats2["tenants"]
+        }
+        phase2_total = sum(phase2_pages.values()) or 1
+        mining_share_2 = phase2_pages["mining"] / phase2_total
+        if mining_share_2 <= shares1.get("mining", 0.0):
+            failures.append(
+                f"priority flip did not shift occupancy: mining share"
+                f" {shares1.get('mining', 0.0):.3f} -> {mining_share_2:.3f}"
+            )
+
+        # Zero recompile stalls across post-flip tenant switches.
+        compile_delta = compile_secs2 - compile_secs1
+        miss_delta = cc2["executable_misses"] - cc1["executable_misses"]
+        if compile_delta > 0 or miss_delta > 0:
+            failures.append(
+                f"post-flip phase recompiled: {compile_delta:.3f}s stepprof"
+                f" compile, {miss_delta} executable misses"
+            )
+
+        status = _get(f"{api_base}/status")
+        rollup = status.get("tenants") or []
+        seen = {r["tenant"] for r in rollup}
+        for want in ("canon", "nice", "mining"):
+            if want not in seen:
+                failures.append(f"/status tenants rollup missing {want!r}")
+
+        line.update({
+            "rounds": stats2["rounds"],
+            "occupancy": round(stats2["occupancy"], 4),
+            "page_shares_pre_flip": {
+                t: round(v, 4) for t, v in shares1.items()
+            },
+            "page_shares_final": {t: round(v, 4) for t, v in shares2.items()},
+            "mining_share_post_flip": round(mining_share_2, 4),
+            "post_flip_compile_secs": round(compile_delta, 4),
+            "post_flip_executable_misses": miss_delta,
+            "status_rollup": rollup,
+        })
+    except RuntimeError:
+        pass
+    finally:
+        server.shutdown()
+        server_thread.join(timeout=10)
+
+    if not failures:
+        db = Db(db_path)
+        try:
+            line["ledger"] = _check_ledger(db, failures)
+        finally:
+            db.close()
+
+    line["ok"] = not failures
+    line["failures"] = failures
+    line["elapsed_secs"] = round(time.monotonic() - t_start, 1)
+    artifact = os.path.join(workdir, "SCHED_r01.json")
+    with open(artifact, "w") as fh:
+        json.dump(line, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    line["artifact"] = artifact
+    print(json.dumps(line, sort_keys=True))
+    if cleanup and not failures:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

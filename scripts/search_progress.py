@@ -1,6 +1,9 @@
 #!/usr/bin/env python
 """Overall search progress per base from the coordination ledger (reference
-scripts/search_progress.rs): fraction of fields at each check level.
+scripts/search_progress.rs): fraction of fields at each check level, plus a
+per-(tenant, base) rollup when multi-tenant claims exist — interleaved tenant
+submissions group under their own line instead of blending into the base
+totals.
 
 Usage: python scripts/search_progress.py --db nice.db
 """
@@ -13,6 +16,17 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from nice_tpu.server.db import Db  # noqa: E402
+
+
+def tenant_lines(db: Db) -> list[str]:
+    """One line per (tenant, mode, base) from the claims ledger."""
+    out = []
+    for row in db.tenant_rollup():
+        out.append(
+            f"tenant {row['tenant']} [{row['mode']} base {row['base']}]: "
+            f"{row['claims']} claims, {row['submissions']} submissions"
+        )
+    return out
 
 
 def main() -> int:
@@ -34,6 +48,11 @@ def main() -> int:
                 f"{100 * size_detailed / size_total:.1f}% detailed; "
                 f"check levels {dict(sorted(by_cl.items()))}"
             )
+        lines = tenant_lines(db)
+        if lines:
+            print("-- tenants --")
+            for line in lines:
+                print(line)
     finally:
         db.close()
     return 0

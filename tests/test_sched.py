@@ -1,0 +1,333 @@
+"""Multi-tenant scheduler tests (nice_tpu/sched/).
+
+Covers the acceptance contract from the subsystem's design: a two-tenant
+interleaved run assembles byte-identical field results vs each tenant run
+alone; a preemption at a page boundary exports the engine's checkpoint
+contract and resumes byte-identically; the anti-starvation bound holds
+against a greedy high-priority tenant; PageTable packing invariants (one
+limb plan per page, segment-quantum alignment); SLO-burn priority boosts;
+and an elastic downshift landing mid-multi-tenant-run.
+"""
+
+import jax
+import pytest
+
+from nice_tpu import faults
+from nice_tpu.core.types import FieldSize
+from nice_tpu.obs.history import HistoryStore
+from nice_tpu.ops import engine
+from nice_tpu.parallel import mesh as pmesh
+from nice_tpu.sched import (
+    MultiTenantScheduler,
+    PageTable,
+    StaticSource,
+    TenantRegistry,
+    TenantSpec,
+)
+
+BASE = 17
+# Two disjoint sub-ranges of base 17's valid range (base_range lower bound
+# 5541): one per tenant, small enough for fast jnp-backend CPU runs but
+# several pages long at the pinned 512-number quantum.
+RANGE_A = FieldSize(5541, 9541)
+RANGE_B = FieldSize(9541, 13541)
+
+
+@pytest.fixture(autouse=True)
+def _small_pages(monkeypatch):
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
+    # Pin the segment quantum: batch 256 x megaloop 2 = 512 numbers, so a
+    # page_batches=1 table cuts 512-number pages and a 4000-number field is
+    # 8 pages. warm() is patched out — it AOT-compiles the jax backend,
+    # which is the slow path these jnp-backend tests do not dispatch.
+    monkeypatch.setenv("NICE_TPU_MEGALOOP_SEGMENT", "2")
+    monkeypatch.setattr(MultiTenantScheduler, "warm", lambda self: None)
+    yield
+    faults.reset()
+    pmesh.heal_devices()
+
+
+def _spec(name, mode, priority=1, slo=0.0):
+    return TenantSpec(
+        name=name, mode=mode, base=BASE, priority=priority,
+        slo_page_secs=slo, backend="jnp", batch_size=256,
+    )
+
+
+def _sched(registry, source, **kw):
+    kw.setdefault("policy", "deficit")
+    kw.setdefault("page_batches", 1)
+    # An always-elapsed quantum preempts at EVERY page boundary — maximal
+    # interleaving, deterministic without a fake clock.
+    kw.setdefault("quantum_secs", 1e-9)
+    return MultiTenantScheduler(registry, source, **kw)
+
+
+# -- two-tenant byte-equivalence ---------------------------------------------
+
+
+def test_two_tenant_interleaved_byte_identical_to_solo_runs():
+    """A detailed and a niceonly tenant interleaved page-by-page on one
+    mesh assemble exactly the results each would produce running alone."""
+    reg = TenantRegistry([
+        _spec("det", "detailed", priority=2),
+        _spec("nice", "niceonly", priority=1),
+    ])
+    source = StaticSource({
+        "det": [("det/f0", BASE, RANGE_A.start(), RANGE_A.end())],
+        "nice": [("nice/f0", BASE, RANGE_B.start(), RANGE_B.end())],
+    })
+    sched = _sched(reg, source)
+    stats = sched.run()
+
+    want_det = engine.process_range_detailed(
+        RANGE_A, BASE, backend="jnp", batch_size=256
+    )
+    want_nice = engine.process_range_niceonly(
+        RANGE_B, BASE, backend="jnp", batch_size=256
+    )
+    got_det = source.results["det"]["det/f0"]
+    got_nice = source.results["nice"]["nice/f0"]
+    assert got_det.distribution == want_det.distribution
+    assert got_det.nice_numbers == want_det.nice_numbers
+    assert got_nice.distribution == ()
+    assert got_nice.nice_numbers == want_nice.nice_numbers
+    # The run really interleaved: both tenants were preempted at page
+    # boundaries mid-field, and the table's packing held throughout.
+    assert stats["tenants"]["det"]["preemptions"] > 0
+    assert stats["tenants"]["nice"]["preemptions"] > 0
+    assert sched.table.check_invariants() == []
+
+
+def test_round_robin_policy_also_byte_identical():
+    reg = TenantRegistry([
+        _spec("a", "detailed"), _spec("b", "detailed"),
+    ])
+    source = StaticSource({
+        "a": [("a/f0", BASE, RANGE_A.start(), RANGE_A.end())],
+        "b": [("b/f0", BASE, RANGE_B.start(), RANGE_B.end())],
+    })
+    _sched(reg, source, policy="rr").run()
+    for name, rng in (("a", RANGE_A), ("b", RANGE_B)):
+        want = engine.process_range_detailed(
+            rng, BASE, backend="jnp", batch_size=256
+        )
+        got = source.results[name][f"{name}/f0"]
+        assert got.distribution == want.distribution
+        assert got.nice_numbers == want.nice_numbers
+
+
+# -- preemption resume via the checkpoint contract ----------------------------
+
+
+def test_preempted_field_resumes_byte_identical_via_ckpt_contract():
+    """Fold a strict prefix of a field's pages, export resume_state(), and
+    finish through the engine's standing resume= path: the stitched result
+    must equal the uninterrupted run."""
+    spec = _spec("det", "detailed")
+    table = PageTable(page_batches=1)
+    work = table.add_field(
+        spec, "det/f0", BASE, RANGE_A.start(), RANGE_A.end()
+    )
+    assert len(work.pages) > 2
+    for page in work.pages[:3]:  # run + fold a prefix, then "preempt"
+        res = engine.process_range_detailed(
+            FieldSize(page.start, page.end), BASE,
+            backend="jnp", batch_size=256,
+        )
+        work.fold(page, res)
+    state = work.resume_state()
+    assert state["cursor"] == work.pages[2].end
+    assert state["remaining"] == [[work.pages[2].end, RANGE_A.end()]]
+    got = engine.process_range_detailed(
+        RANGE_A, BASE, backend="jnp", batch_size=256, resume=state
+    )
+    want = engine.process_range_detailed(
+        RANGE_A, BASE, backend="jnp", batch_size=256
+    )
+    assert got.distribution == want.distribution
+    assert got.nice_numbers == want.nice_numbers
+
+
+def test_preempted_niceonly_resume():
+    spec = _spec("nice", "niceonly")
+    table = PageTable(page_batches=1)
+    work = table.add_field(
+        spec, "nice/f0", BASE, RANGE_B.start(), RANGE_B.end()
+    )
+    page = work.pages[0]
+    work.fold(page, engine.process_range_niceonly(
+        FieldSize(page.start, page.end), BASE, backend="jnp", batch_size=256,
+    ))
+    got = engine.process_range_niceonly(
+        RANGE_B, BASE, backend="jnp", batch_size=256,
+        resume=work.resume_state(),
+    )
+    want = engine.process_range_niceonly(
+        RANGE_B, BASE, backend="jnp", batch_size=256
+    )
+    assert got.nice_numbers == want.nice_numbers
+
+
+# -- starvation bound ---------------------------------------------------------
+
+
+def test_starvation_bound_under_greedy_high_priority_tenant():
+    """Pure priority policy + a priority-5 tenant with a deep field queue:
+    the priority-0 tenant still finishes its field because the skipped-
+    rounds bound forces it onto the mesh."""
+    reg = TenantRegistry([
+        _spec("greedy", "detailed", priority=5),
+        _spec("meek", "niceonly", priority=0),
+    ])
+    step = 1024
+    greedy_fields = [
+        (f"greedy/f{i}", BASE, RANGE_A.start() + i * step,
+         RANGE_A.start() + (i + 1) * step)
+        for i in range(6)
+    ]
+    source = StaticSource({
+        "greedy": greedy_fields,
+        "meek": [("meek/f0", BASE, RANGE_B.start(), RANGE_B.start() + 1024)],
+    })
+    sched = _sched(reg, source, policy="priority", starvation_rounds=2)
+    stats = sched.run()
+    assert stats["tenants"]["meek"]["fields"] == 1
+    assert stats["tenants"]["meek"]["starved"] > 0
+    assert stats["tenants"]["greedy"]["fields"] == len(greedy_fields)
+
+
+def test_starvation_bound_disabled_priority_runs_greedy_first():
+    """With the bound off, pure priority drains the high-priority tenant
+    completely before the low one runs at all — the behavior the bound
+    exists to cap."""
+    reg = TenantRegistry([
+        _spec("greedy", "detailed", priority=5),
+        _spec("meek", "niceonly", priority=0),
+    ])
+    source = StaticSource({
+        "greedy": [("greedy/f0", BASE, RANGE_A.start(), RANGE_A.start() + 2048)],
+        "meek": [("meek/f0", BASE, RANGE_B.start(), RANGE_B.start() + 1024)],
+    })
+    sched = _sched(reg, source, policy="priority", starvation_rounds=0)
+    stats = sched.run()
+    assert stats["tenants"]["meek"]["starved"] == 0
+    assert stats["tenants"]["meek"]["fields"] == 1  # still drains at the end
+
+
+# -- page-table packing invariants -------------------------------------------
+
+
+def test_pagetable_packing_invariants():
+    """Pages align to each tenant's own segment quantum, cover fields
+    exactly, and never mix limb plans — two tenants with different bases
+    and batch shapes pack side by side."""
+    table = PageTable(page_batches=2)
+    lo = TenantSpec(name="lo", mode="detailed", base=10,
+                    backend="jnp", batch_size=256)
+    hi = TenantSpec(name="hi", mode="detailed", base=40,
+                    backend="jnp", batch_size=128)
+    w1 = table.add_field(lo, "lo/f0", 10, 1000, 6000)
+    w2 = table.add_field(hi, "hi/f0", 40, 7000, 8000)
+    assert table.check_invariants() == []
+    # quantum = page_batches * batch * megaloop (2 * 256 * 2 / 2 * 128 * 2).
+    assert table.quantum_for(lo) == 1024
+    assert table.quantum_for(hi) == 512
+    assert all(p.size == 1024 for p in w1.pages[:-1])
+    assert all(p.tenant == "lo" and p.base == 10 for p in w1.pages)
+    assert all(p.tenant == "hi" and p.base == 40 for p in w2.pages)
+    assert w1.pages[0].start == 1000 and w1.pages[-1].end == 6000
+    # A field never pages twice, and folds never run out of order.
+    with pytest.raises(ValueError, match="already paged"):
+        table.add_field(lo, "lo/f0", 10, 1000, 6000)
+    with pytest.raises(ValueError, match="out of order"):
+        from nice_tpu.core.types import FieldResults
+        w1.fold(w1.pages[1], FieldResults(
+            distribution=(), nice_numbers=(), backend_downgrades=(),
+        ))
+
+
+def test_pagetable_rejects_empty_field():
+    table = PageTable(page_batches=1)
+    with pytest.raises(ValueError, match="empty field"):
+        table.add_field(_spec("t", "detailed"), "t/f0", BASE, 100, 100)
+
+
+# -- SLO-burn priority boost --------------------------------------------------
+
+
+def test_slo_burn_boosts_priority_and_preempts():
+    """A tenant blowing its page budget earns a warn-level boost that (a)
+    raises its effective priority above an idle incumbent and (b) surfaces
+    as a slo_boost preemption reason at the incumbent's next boundary."""
+    now = 1_000_000.0
+    slow = _spec("slow", "detailed", priority=0, slo=0.01)
+    calm = _spec("calm", "detailed", priority=1)
+    reg = TenantRegistry([slow, calm])
+    source = StaticSource({
+        "slow": [("slow/f0", BASE, RANGE_A.start(), RANGE_A.start() + 1024)],
+        "calm": [("calm/f0", BASE, RANGE_B.start(), RANGE_B.start() + 1024)],
+    })
+    hist = HistoryStore()
+    # quantum_secs=0 disables the time quantum so the slo_boost preemption
+    # reason is the one that fires.
+    sched = _sched(
+        reg, source, slo_boost=2, history=hist, wall=lambda: now,
+        quantum_secs=0.0,
+    )
+    # Every recent page blew the 10ms budget: bad_fraction 1.0 against a
+    # 0.25 objective burns at 4x on both windows -> warn -> boost 1 * 2.
+    for i in range(10):
+        hist.add('nice_sched_page_seconds{tenant="slow"}', 1.0, ts=now - i)
+    sched._slo_tick(now=now)
+    assert sched.effective_priority(slow) == 0 + 2
+    assert sched.effective_priority(calm) == 1
+    # The burning tenant now outranks the incumbent: the incumbent's next
+    # page boundary reports a slo_boost preemption (it has queued pages).
+    assert sched._ensure_work(slow)
+    assert sched._preempt_reason(calm, turn_started=0.0) == "slo_boost"
+
+
+def test_no_budget_no_boost():
+    spec = _spec("free", "detailed")  # slo_page_secs=0: no SLO spec at all
+    reg = TenantRegistry([spec])
+    sched = _sched(reg, StaticSource({"free": []}), slo_boost=2)
+    sched._slo_tick(now=123.0)
+    assert sched.effective_priority(spec) == spec.priority
+
+
+# -- elastic downshift mid-multi-tenant run -----------------------------------
+
+
+def test_elastic_downshift_mid_multi_tenant_run(monkeypatch):
+    """Kill a mesh device during an interleaved two-tenant run: the elastic
+    layer reshards under the scheduler's feet and every assembled field is
+    still byte-identical to the fault-free oracle, with no whole-field
+    backend downgrade recorded."""
+    monkeypatch.setenv("NICE_TPU_MEGALOOP", "0")  # per-batch dispatch
+    reg = TenantRegistry([
+        _spec("det", "detailed", priority=2),
+        _spec("nice", "niceonly", priority=1),
+    ])
+    source = StaticSource({
+        "det": [("det/f0", BASE, RANGE_A.start(), RANGE_A.end())],
+        "nice": [("nice/f0", BASE, RANGE_B.start(), RANGE_B.end())],
+    })
+    faults.configure("mesh.dispatch:dead@3")
+    sched = _sched(reg, source, page_batches=4)
+    sched.run()
+    faults.reset()
+    pmesh.heal_devices()
+    want_det = engine.process_range_detailed(
+        RANGE_A, BASE, backend="jnp", batch_size=256
+    )
+    want_nice = engine.process_range_niceonly(
+        RANGE_B, BASE, backend="jnp", batch_size=256
+    )
+    got_det = source.results["det"]["det/f0"]
+    got_nice = source.results["nice"]["nice/f0"]
+    assert got_det.distribution == want_det.distribution
+    assert got_det.nice_numbers == want_det.nice_numbers
+    assert got_nice.nice_numbers == want_nice.nice_numbers
+    assert got_det.backend_downgrades == ()
+    assert got_nice.backend_downgrades == ()

@@ -333,6 +333,177 @@ class SpoolReplayVsClaimExpiry(Scenario):
 
 
 # ---------------------------------------------------------------------------
+# replication: promotion (epoch bump + fence) vs. an in-flight write
+# (threads crossed: async-workers write handler vs. the promotion path)
+
+
+class _PromoteBase(Scenario):
+    """Models the epoch fence on a deposed primary: ReplState keeps the
+    role/epoch/fence cache under one lock, and the write path's
+    fence-check must be atomic with stamping the op into the log — a
+    check in one lock block and an append in another lets a promotion
+    land between them and a dead-epoch write slip past the fence."""
+
+    def _wire(self, sched):
+        self.lock = schedex.Lock(sched, "server.repl.ReplState._lock")
+        self.state = {"epoch": 1, "fenced": False}
+        self.log: list[dict] = []
+        self.rejected: list[int] = []
+
+    def _promote(self):
+        # A resurrected client stamps X-Nice-Epoch from the promoted
+        # standby: the deposed primary fences itself and the cluster
+        # epoch moves on — one atomic step, like ReplState.note_client_epoch.
+        with self.lock:
+            self.state["fenced"] = True
+            self.state["epoch"] += 1
+
+    def check(self) -> None:
+        fence_seq = next(
+            (n for n, op in enumerate(self.log) if op["post_fence"]), None)
+        assert fence_seq is None, (
+            f"write landed on the deposed primary after the fence: "
+            f"{self.log} (rejected={self.rejected})")
+
+
+class PromoteVsInflightWrite(_PromoteBase):
+    """Disciplined write path: fence-check and op-append are one atomic
+    step under the ReplState lock, so the 410 answer and the op log can
+    never disagree about which side of the promotion a write landed on."""
+
+    scenario_name = "promote_vs_inflight_write"
+    expect = "pass"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def write():
+            with self.lock:
+                if self.state["fenced"]:
+                    self.rejected.append(410)
+                    return
+                self.log.append({
+                    "epoch": self.state["epoch"],
+                    "post_fence": self.state["fenced"],
+                })
+
+        return [("write-handler", write), ("promoter", self._promote)]
+
+
+class PromoteVsInflightWritePreFix(_PromoteBase):
+    """The split shape: fence checked in one lock block, op appended in
+    another.  A promotion in the window fences the primary *after* it
+    decided to accept — the double-canonicalization split-brain."""
+
+    scenario_name = "promote_vs_inflight_write_prefix"
+    expect = "race"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def write():
+            with self.lock:
+                fenced = self.state["fenced"]
+            if fenced:
+                self.rejected.append(410)
+                return
+            with self.lock:
+                self.log.append({
+                    "epoch": self.state["epoch"],
+                    "post_fence": self.state["fenced"],
+                })
+
+        return [("write-handler", write), ("promoter", self._promote)]
+
+
+# ---------------------------------------------------------------------------
+# client failover cursor: success store vs. concurrent rotation
+# (threads crossed: worker request threads vs. telemetry reporter — the
+# regression the ``nicelint: allow R5`` in client/api_client.py points at)
+
+
+class _FailoverCursorBase(Scenario):
+    """Models api_client._failover_idx: a request reads the cursor under
+    the lock, runs its HTTP call outside it, then stores the index that
+    worked.  A concurrent thread that rotated away from a now-dead
+    server must not have its rotation clobbered by the older success."""
+
+    def _wire(self, sched):
+        self._sched = sched
+        self.lock = schedex.Lock(sched, "client.api_client._failover_lock")
+        self.idx = {"k": 0}
+        self.gen = {"k": 0}
+        # Source of truth the modeled HTTP call reads: which server answers.
+        self.alive = {1: True, 2: True}
+
+    def _pick(self) -> int:
+        # The request itself: the first live server answers.
+        return 1 if self.alive[1] else 2
+
+    def _rotator(self):
+        # Another thread's request just failed over: server 1 is dead,
+        # server 2 answered.  Newer knowledge stores atomically and bumps
+        # the generation (the invalidate_status_cache role in this pair).
+        self.alive[1] = False
+        self._sched.yield_point("rotator:dead")
+        with self.lock:
+            self.idx["k"] = 2
+            self.gen["k"] += 1
+
+    def check(self) -> None:
+        assert self.idx["k"] == 2, (
+            f"rotation away from the dead server was lost: cursor points "
+            f"at {self.idx['k']} (gen={self.gen['k']})")
+
+
+class FailoverCursorRotateVsStore(_FailoverCursorBase):
+    """Generation-checked store: the stale success (server 1, observed
+    before it died) can never overwrite the newer rotation to server 2."""
+
+    scenario_name = "failover_cursor_rotate_vs_store"
+    expect = "pass"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def requester():
+            # Success observed on whichever server was live at call time;
+            # the gen check decides whether that (possibly stale) success
+            # may stick once the request returns to store it.
+            with self.lock:
+                g = self.gen["k"]
+            sched.yield_point("requester:pick")
+            target = self._pick()
+            sched.yield_point("requester:http")
+            with self.lock:
+                if self.gen["k"] == g:
+                    self.idx["k"], self.gen["k"] = target, g + 1
+
+        return [("requester", requester), ("rotator", self._rotator)]
+
+
+class FailoverCursorPreFix(_FailoverCursorBase):
+    """Unconditional store: a preemption between the requester's read
+    and its store lets the stale success bury the rotation."""
+
+    scenario_name = "failover_cursor_prefix"
+    expect = "race"
+
+    def build(self, sched):
+        self._wire(sched)
+
+        def requester():
+            with self.lock:
+                self.idx["k"] % 3
+            target = self._pick()
+            sched.yield_point("requester:http")
+            with self.lock:
+                self.idx["k"] = target
+
+        return [("requester", requester), ("rotator", self._rotator)]
+
+
+# ---------------------------------------------------------------------------
 # calibration: a permanently-racy lost-update counter
 
 
@@ -369,6 +540,10 @@ SCENARIOS: dict[str, type[Scenario]] = {
         LeaseSweepVsSubmit,
         LeaseSweepPreFix,
         SpoolReplayVsClaimExpiry,
+        PromoteVsInflightWrite,
+        PromoteVsInflightWritePreFix,
+        FailoverCursorRotateVsStore,
+        FailoverCursorPreFix,
         RacyCounter,
     )
 }

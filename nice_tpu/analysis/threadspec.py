@@ -113,10 +113,22 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
     ThreadRoot(
         name="field-queue-refill",
         path="nice_tpu/server/field_queue.py",
-        spawn_scope="FieldQueue.__init__",
+        spawn_scope="FieldQueue.start",
         entries=("FieldQueue._refill_loop",),
         role="producer",
         locks=("server.field_queue.FieldQueue._lock", "server.db.Db._lock"),
+        notes="started from __init__ on a primary; a standby defers start() "
+              "until promotion (refills would mutate the replicated ledger)",
+    ),
+    ThreadRoot(
+        name="repl-applier",
+        path="nice_tpu/server/repl.py",
+        spawn_scope="ReplApplier.__init__",
+        entries=("ReplApplier._run",),
+        role="collector",
+        locks=("server.repl.ReplState._lock",),
+        notes="standby op-log puller; every replica mutation goes through "
+              "writer.call so the DB writer stays the single mutator",
     ),
     ThreadRoot(
         name="async-workers",
@@ -395,6 +407,14 @@ LOCK_SPECS: Tuple[LockSpec, ...] = (
              "snapshot cache + bottleneck-shift state"),
     LockSpec("server.app.ApiContext._stream_stage_lock",
              "journal rows staged for post-commit stream publish"),
+    LockSpec("server.repl.ReplState._lock",
+             "role/epoch/fence cache + standby registry + applied-seq gauges"),
+    LockSpec("client.api_client._epoch_lock",
+             "last-seen replication epoch stamped on outgoing writes"),
+    LockSpec("client.api_client._dead_hosts_lock",
+             "dead-endpoint marks used to evict pooled keep-alive sockets"),
+    LockSpec("client.api_client._failover_lock",
+             "sticky per-server-list failover cursor"),
 )
 
 
@@ -430,6 +450,26 @@ SHARED_STATE: Tuple[SharedState, ...] = (
     SharedState("nice_tpu/server/field_queue.py", "FieldQueue",
                 "_detailed_thin",
                 "lock:server.field_queue.FieldQueue._lock"),
+    # server/repl.py — cached repl_meta mirror: HTTP workers read role/epoch
+    # on every request; the applier and promotion path write.
+    SharedState("nice_tpu/server/repl.py", "ReplState", "_role",
+                "lock:server.repl.ReplState._lock"),
+    SharedState("nice_tpu/server/repl.py", "ReplState", "_epoch",
+                "lock:server.repl.ReplState._lock"),
+    SharedState("nice_tpu/server/repl.py", "ReplState", "_fenced",
+                "lock:server.repl.ReplState._lock",
+                notes="sticky: once a newer client epoch is seen the deposed "
+                      "primary rejects every later write with 410"),
+    SharedState("nice_tpu/server/repl.py", "ReplState", "_standbys",
+                "lock:server.repl.ReplState._lock"),
+    # client/api_client.py — module-level failover state shared by the main
+    # thread and the telemetry reporter.
+    SharedState("nice_tpu/client/api_client.py", "<module>", "_last_epoch",
+                "lock:client.api_client._epoch_lock"),
+    SharedState("nice_tpu/client/api_client.py", "<module>", "_dead_hosts",
+                "lock:client.api_client._dead_hosts_lock"),
+    SharedState("nice_tpu/client/api_client.py", "<module>", "_failover_idx",
+                "lock:client.api_client._failover_lock"),
     # ops/engine.py — the mesh cache rebuilt on elastic downshift.
     SharedState("nice_tpu/ops/engine.py", "<module>", "_MESH_CACHE",
                 "lock:ops.engine._mesh_cache_lock"),

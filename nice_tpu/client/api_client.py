@@ -37,7 +37,12 @@ from urllib.parse import urlsplit
 from nice_tpu import faults, obs
 from nice_tpu.core.constants import CLIENT_REQUEST_TIMEOUT_SECS
 from nice_tpu.core.types import DataToClient, DataToServer, SearchMode, ValidationData
-from nice_tpu.obs.series import CLIENT_REQUEST_SECONDS, CLIENT_RETRIES
+from nice_tpu.utils import lockdep
+from nice_tpu.obs.series import (
+    CLIENT_FAILOVERS,
+    CLIENT_REQUEST_SECONDS,
+    CLIENT_RETRIES,
+)
 
 log = logging.getLogger(__name__)
 
@@ -46,6 +51,36 @@ MAX_BACKOFF_SECS = 512
 
 # Backoff jitter source; module-level so tests can reseed for determinism.
 _backoff_rng = random.Random()
+
+# Replication fencing: the highest epoch this process has seen in any
+# server response. Stamped on every request as X-Nice-Epoch so a deposed
+# primary learns it has been fenced the moment a post-failover client
+# talks to it (claim GETs mutate server state too, so ALL requests stamp).
+_epoch_lock = lockdep.make_lock("client.api_client._epoch_lock")
+_last_epoch = 0
+
+
+def _note_epoch(parsed: Any) -> None:
+    """Learn the fencing epoch from a response body: top-level "epoch"
+    (write replies, /status) or the nested /status repl block."""
+    global _last_epoch
+    if not isinstance(parsed, dict):
+        return
+    epoch = parsed.get("epoch")
+    if epoch is None and isinstance(parsed.get("repl"), dict):
+        epoch = parsed["repl"].get("epoch")
+    try:
+        epoch = int(epoch)
+    except (TypeError, ValueError):
+        return
+    with _epoch_lock:
+        if epoch > _last_epoch:
+            _last_epoch = epoch
+
+
+def last_seen_epoch() -> int:
+    with _epoch_lock:
+        return _last_epoch
 
 
 class ApiError(Exception):
@@ -121,6 +156,20 @@ _STALE_ERRORS = (
 )
 
 
+# Dead-endpoint registry, shared across ALL threads' pools: when one thread
+# hits a connection error against an endpoint, every pooled keep-alive
+# socket to that endpoint born BEFORE the failure is evicted on next use
+# instead of each thread re-probing its own stale socket and eating its own
+# timeout. Keyed like the pools: (scheme, host:port) -> monotonic mark.
+_dead_hosts_lock = lockdep.make_lock("client.api_client._dead_hosts_lock")
+_dead_hosts: dict = {}
+
+
+def _mark_host_dead(key) -> None:
+    with _dead_hosts_lock:
+        _dead_hosts[key] = time.monotonic()
+
+
 def _conn_pool() -> dict:
     pool = getattr(_conn_local, "pool", None)
     if pool is None:
@@ -135,13 +184,17 @@ def _drop_connection(key) -> None:
             conn.close()
 
 
-def close_connections() -> None:
-    """Close this thread's pooled connections (tests / clean shutdown)."""
+def close_connections(netloc: Optional[str] = None) -> None:
+    """Close this thread's pooled connections — all of them (tests / clean
+    shutdown), or only those to one host:port when netloc is given (a dead
+    endpoint's sockets go without disturbing live servers' keep-alives)."""
     pool = _conn_pool()
-    for conn in pool.values():
+    for key in list(pool):
+        if netloc is not None and key[1] != netloc:
+            continue
+        conn = pool.pop(key)
         with contextlib.suppress(Exception):
             conn.close()
-    pool.clear()
 
 
 def _request_json(
@@ -165,11 +218,26 @@ def _request_json(
     traceparent = obs.current_traceparent()
     if traceparent:
         headers["traceparent"] = traceparent
+    epoch = last_seen_epoch()
+    if epoch > 0:
+        headers["X-Nice-Epoch"] = str(epoch)
     method = "GET" if body is None else "POST"
     key = (parts.scheme, parts.netloc)
     pool = _conn_pool()
     for fresh_retry in (False, True):
         conn = pool.get(key)
+        if conn is not None:
+            # Cross-thread dead-host eviction: a socket born before another
+            # thread marked this endpoint dead is stale by fiat — drop it
+            # rather than re-probe it through its own timeout.
+            with _dead_hosts_lock:
+                dead_mark = _dead_hosts.get(key)
+            if (
+                dead_mark is not None
+                and getattr(conn, "_nice_born", 0.0) <= dead_mark
+            ):
+                _drop_connection(key)
+                conn = None
         reused = conn is not None
         if conn is None:
             cls = (
@@ -178,6 +246,7 @@ def _request_json(
                 else http.client.HTTPConnection
             )
             conn = cls(parts.netloc, timeout=timeout)
+            conn._nice_born = time.monotonic()
             pool[key] = conn
         conn.timeout = timeout
         if conn.sock is not None:
@@ -190,10 +259,14 @@ def _request_json(
             _drop_connection(key)
             if reused and not fresh_retry:
                 continue
+            # A FRESH connection failing the same way means the endpoint
+            # itself is down, not just an idle keep-alive reaped.
+            _mark_host_dead(key)
             raise urllib.error.URLError(f"{e.__class__.__name__}: {e}") from e
         except OSError:
             # Connect/socket failure: state unknown, never silently resend.
             _drop_connection(key)
+            _mark_host_dead(key)
             raise
         if resp.will_close:
             _drop_connection(key)
@@ -201,7 +274,9 @@ def _request_json(
             raise urllib.error.HTTPError(
                 url, resp.status, resp.reason, resp.headers, io.BytesIO(payload)
             )
-        return json.loads(payload) if payload else None
+        parsed = json.loads(payload) if payload else None
+        _note_epoch(parsed)
+        return parsed
 
 
 def retry_request(
@@ -281,6 +356,103 @@ def retry_request(
         attempt += 1
 
 
+# Multi-server failover (--servers / NICE_TPU_SERVERS): api_base may be a
+# comma-separated endpoint list. Sticky per-list cursor: all threads start
+# from the last server that worked, so one failover reroutes the whole
+# process instead of every thread rediscovering the dead primary.
+_failover_lock = lockdep.make_lock("client.api_client._failover_lock")
+_failover_idx: dict = {}
+# Generation per server-list key, bumped on every cursor store: a store
+# computed before a concurrent rotation must not clobber it (same
+# discipline as the status cache's _status_cache_gen).
+_failover_gen: dict = {}
+
+# Statuses that rotate to the next server (on top of None = transport
+# failure and 5xx): timeouts and rate/overload shed clear elsewhere, and
+# 410/421 are the epoch fence saying "not me — ask the promoted server".
+_ROTATE_STATUSES = frozenset({408, 410, 421, 429})
+
+
+def split_servers(api_base: str) -> list:
+    """Endpoint list from an api_base that may be comma-separated."""
+    return [s.strip().rstrip("/") for s in api_base.split(",") if s.strip()]
+
+
+def failover_request(
+    api_base: str,
+    path: str,
+    body: Optional[dict] = None,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    timeout: float = CLIENT_REQUEST_TIMEOUT_SECS,
+    endpoint: str = "other",
+) -> Any:
+    """retry_request over one OR many servers.
+
+    Single server: byte-identical to retry_request (same backoff budget).
+    Multiple: each cycle tries every server once (no per-server backoff),
+    rotating on transport errors, 5xx, and _ROTATE_STATUSES; other 4xx
+    raise immediately — a definite answer from a live primary. A full
+    failed cycle sleeps the usual full-jitter backoff; cycles are capped at
+    max_retries + 1 and the last ApiError re-raises (status=None preserved
+    so the submission spool still distinguishes dead transport)."""
+    servers = split_servers(api_base)
+    if len(servers) <= 1:
+        base = servers[0] if servers else api_base.rstrip("/")
+        return retry_request(
+            base + path, body, max_retries=max_retries, timeout=timeout,
+            endpoint=endpoint,
+        )
+    key = ",".join(servers)
+    with _failover_lock:
+        start = _failover_idx.get(key, 0) % len(servers)
+        gen = _failover_gen.get(key, 0)
+    last_err: Optional[ApiError] = None
+    for cycle in range(max_retries + 1):
+        for off in range(len(servers)):
+            i = (start + off) % len(servers)
+            try:
+                result = retry_request(
+                    servers[i] + path, body, max_retries=0,
+                    timeout=timeout, endpoint=endpoint,
+                )
+            except ApiError as e:
+                last_err = e
+                if (
+                    e.status is not None
+                    and e.status < 500
+                    and e.status not in _ROTATE_STATUSES
+                ):
+                    raise
+                CLIENT_FAILOVERS.labels(endpoint).inc()
+                obs.flight.record(
+                    "failover", endpoint=endpoint, server=servers[i],
+                    status=e.status, cycle=cycle,
+                )
+                log.warning(
+                    "server %s failed %s (%s); rotating to next endpoint",
+                    servers[i], path,
+                    e.status if e.status is not None else f"transport: {e}",
+                )
+                continue
+            with _failover_lock:
+                # Store only if no other thread moved the cursor while this
+                # request ran outside the lock — a concurrent rotation away
+                # from a dead server must win over our older success.
+                if _failover_gen.get(key, 0) == gen:  # nicelint: allow R5 (generation-checked store; schedex scenario failover_cursor_rotate_vs_store replays the window)
+                    _failover_idx[key], _failover_gen[key] = i, gen + 1
+            return result
+        if cycle >= max_retries:
+            break
+        delay = _backoff_rng.uniform(0, min(2 ** cycle, MAX_BACKOFF_SECS))
+        log.warning(
+            "all %d servers failed %s; cycle %d backoff %.2fs",
+            len(servers), path, cycle + 1, delay,
+        )
+        time.sleep(delay)
+    assert last_err is not None
+    raise last_err
+
+
 def get_field_from_server(
     mode: SearchMode, api_base: str, username: str,
     max_retries: int = DEFAULT_MAX_RETRIES,
@@ -295,16 +467,17 @@ def get_field_from_server(
     drawn from the tenant's base window. Pre-sched servers ignore the extra
     query params, so the scheduler degrades to unrouted claims."""
     endpoint = "detailed" if mode == SearchMode.DETAILED else "niceonly"
-    url = f"{api_base}/claim/{endpoint}?username={urllib.request.quote(username)}"
+    path = f"/claim/{endpoint}?username={urllib.request.quote(username)}"
     if tenant is not None:
-        url += f"&tenant={urllib.request.quote(tenant)}"
+        path += f"&tenant={urllib.request.quote(tenant)}"
     if base_min is not None:
-        url += f"&base_min={int(base_min)}"
+        path += f"&base_min={int(base_min)}"
     if base_max is not None:
-        url += f"&base_max={int(base_max)}"
+        path += f"&base_max={int(base_max)}"
     t0 = time.monotonic()
     data = DataToClient.from_json(
-        retry_request(url, max_retries=max_retries, endpoint="claim")
+        failover_request(api_base, path, max_retries=max_retries,
+                         endpoint="claim")
     )
     # Critical-path stamp: the claim round-trip as the CLIENT experienced it
     # (retries and backoff included — that wait is real end-to-end latency).
@@ -330,8 +503,8 @@ def submit_field_to_server(
     with obs.trace_context(trace_id), obs.span(
         "client.submit", claim=submit_data.claim_id
     ):
-        resp = retry_request(
-            f"{api_base}/submit", submit_data.to_json(),
+        resp = failover_request(
+            api_base, "/submit", submit_data.to_json(),
             max_retries=max_retries, endpoint="submit",
         )
     # Critical-path stamp (see get_field_from_server): delivered by the
@@ -361,8 +534,8 @@ def renew_claim(
     # The renewer runs on its own thread, so re-derive the field's trace
     # context from the claim id rather than relying on an ambient one.
     with obs.trace_context(obs.claim_trace_id(claim_id)):
-        retry_request(
-            f"{api_base}/renew_claim", {"claim_id": claim_id},
+        failover_request(
+            api_base, "/renew_claim", {"claim_id": claim_id},
             max_retries=max_retries, endpoint="renew",
         )
 
@@ -391,11 +564,9 @@ def claim_block_from_server(
         payload["base_min"] = int(base_min)
     if base_max is not None:
         payload["base_max"] = int(base_max)
-    resp = retry_request(
-        f"{api_base}/claim_block",
-        payload,
-        max_retries=max_retries,
-        endpoint="claim_block",
+    resp = failover_request(
+        api_base, "/claim_block", payload,
+        max_retries=max_retries, endpoint="claim_block",
     )
     return resp["block_id"], [
         DataToClient.from_json(f) for f in resp["fields"]
@@ -419,8 +590,8 @@ def submit_block_to_server(
     if telemetry is not None:
         body["telemetry"] = telemetry
     with obs.span("client.submit_block", block=block_id, n=len(submissions)):
-        resp = retry_request(
-            f"{api_base}/submit_block", body,
+        resp = failover_request(
+            api_base, "/submit_block", body,
             max_retries=max_retries, endpoint="submit_block",
         )
     if isinstance(resp, dict) and resp.get("duplicates"):
@@ -435,8 +606,8 @@ def submit_block_to_server(
 def renew_block(api_base: str, block_id: str, max_retries: int = 1) -> None:
     """POST /renew_claim {block_id} — one heartbeat re-arms every member of
     the block lease (same low retry budget rationale as renew_claim)."""
-    retry_request(
-        f"{api_base}/renew_claim", {"block_id": block_id},
+    failover_request(
+        api_base, "/renew_claim", {"block_id": block_id},
         max_retries=max_retries, endpoint="renew",
     )
 
@@ -449,8 +620,8 @@ def post_telemetry(
     Best-effort by design (low retry budget, like renew_claim): a dropped
     heartbeat only delays the fleet dashboard by one period, and the
     reporter thread must never back off for minutes while the scan runs."""
-    retry_request(
-        f"{api_base}/telemetry", snap, max_retries=max_retries,
+    failover_request(
+        api_base, "/telemetry", snap, max_retries=max_retries,
         endpoint="telemetry",
     )
 
@@ -460,11 +631,12 @@ def get_validation_data_from_server(
     max_retries: int = DEFAULT_MAX_RETRIES,
 ) -> ValidationData:
     """GET /claim/validate (reference client_api_sync.rs:188-206)."""
-    url = f"{api_base}/claim/validate?username={urllib.request.quote(username)}"
+    path = f"/claim/validate?username={urllib.request.quote(username)}"
     if base is not None:
-        url += f"&base={base}"
+        path += f"&base={base}"
     return ValidationData.from_json(
-        retry_request(url, max_retries=max_retries, endpoint="validate")
+        failover_request(api_base, path, max_retries=max_retries,
+                         endpoint="validate")
     )
 
 

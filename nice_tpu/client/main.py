@@ -43,7 +43,7 @@ from nice_tpu.core.types import (
 )
 from nice_tpu.ops import engine
 from nice_tpu.ops.stride_filter import get_stride_table
-from nice_tpu.utils import knobs, lockdep
+from nice_tpu.utils import fsio, knobs, lockdep
 
 log = logging.getLogger("nice_tpu.client")
 
@@ -69,7 +69,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--api-base",
         default=_env("API_BASE", "https://api.nicenumbers.net"),
-        help="API base URL (env NICE_API_BASE)",
+        help="API base URL; may be a comma-separated list for failover "
+        "(env NICE_API_BASE)",
+    )
+    p.add_argument(
+        "--servers",
+        default=knobs.SERVERS.get(),
+        help="additional comma-separated server endpoints merged into "
+        "--api-base for multi-server failover (env NICE_TPU_SERVERS)",
     )
     p.add_argument(
         "--username",
@@ -471,6 +478,40 @@ def run_validate(args) -> int:
     return 1
 
 
+def _known_servers_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, "servers.json")
+
+
+def _load_known_servers(checkpoint_dir: Optional[str]) -> list[str]:
+    """Server endpoints learned from /status by a previous run — merged
+    into the failover list at startup so a restarted client can still fail
+    over when its CONFIGURED primary is the server that died."""
+    if not checkpoint_dir:
+        return []
+    try:
+        with open(_known_servers_path(checkpoint_dir)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(data, list):
+        return []
+    return [s.rstrip("/") for s in data if isinstance(s, str) and s.strip()]
+
+
+def _save_known_servers(checkpoint_dir: Optional[str],
+                        servers: list[str]) -> None:
+    if not checkpoint_dir or not servers:
+        return
+    try:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        fsio.atomic_write_json(
+            _known_servers_path(checkpoint_dir),
+            list(dict.fromkeys(s.rstrip("/") for s in servers)),
+        )
+    except OSError as e:
+        log.debug("failed to persist known servers: %s", e)
+
+
 def _fleet_snapshot(args, spool) -> dict:
     """This client's current obs.telemetry snapshot, spool depth included."""
     depth = 0
@@ -508,6 +549,23 @@ class _TelemetryReporter:
             )
         except Exception as e:
             log.debug("telemetry heartbeat failed: %s", e)
+        self._learn_servers()
+
+    def _learn_servers(self) -> None:
+        """Persist the server list /status advertises (primary + live
+        standbys) beside the checkpoints, so the NEXT run's failover list
+        covers servers this run only learned about at runtime."""
+        if not self.args.checkpoint_dir:
+            return
+        try:
+            status = api_client.failover_request(
+                self.args.api_base, "/status", max_retries=0,
+                endpoint="telemetry",
+            )
+            servers = (status.get("repl") or {}).get("servers") or []
+            _save_known_servers(self.args.checkpoint_dir, servers)
+        except Exception as e:
+            log.debug("server-list learn failed: %s", e)
 
     def _run(self) -> None:
         self._report_once()
@@ -996,6 +1054,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             "checkpointing disabled"
         )
         args.checkpoint_dir = None
+    # Multi-server failover list: --api-base (may itself be a comma list)
+    # + --servers/NICE_TPU_SERVERS + endpoints a previous run learned from
+    # /status. The joined list IS the api_base from here on — every
+    # api_client call (spool replay included) rotates across it.
+    server_list = api_client.split_servers(args.api_base)
+    if args.servers:
+        server_list += api_client.split_servers(args.servers)
+    server_list += _load_known_servers(args.checkpoint_dir)
+    args.api_base = ",".join(dict.fromkeys(server_list))
     if args.benchmark:
         return run_benchmark(args)
     if args.validate:

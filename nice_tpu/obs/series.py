@@ -164,6 +164,12 @@ CLIENT_RETRIES = metrics.counter(
     "Failed API attempts that triggered a backoff retry, by endpoint.",
     labelnames=("endpoint",),
 )
+CLIENT_FAILOVERS = metrics.counter(
+    "nice_client_failovers_total",
+    "Multi-server rotations: an endpoint attempt failed (conn_error/5xx/"
+    "fence) and the client moved to the next configured server.",
+    labelnames=("endpoint",),
+)
 CLIENT_FIELDS = metrics.counter(
     "nice_client_fields_total",
     "Fields fully processed by this client, by mode.",
@@ -541,6 +547,41 @@ DAEMON_RESTART_BACKOFF = metrics.gauge(
     "healthy run).",
 )
 
+# --- replication & failover (server/repl.py) -----------------------------
+REPL_SEQ = metrics.gauge(
+    "nice_repl_seq",
+    "Primary: op-log high-water mark (last committed repl_ops seq).",
+)
+REPL_APPLIED_SEQ = metrics.gauge(
+    "nice_repl_applied_seq",
+    "Standby: last op seq applied to the local replica.",
+)
+REPL_LAG = metrics.gauge(
+    "nice_repl_lag_ops",
+    "Standby: upstream max seq minus locally applied seq (0 = caught up).",
+)
+REPL_EPOCH = metrics.gauge(
+    "nice_repl_epoch",
+    "Fencing epoch this replica believes is current (promotion bumps it).",
+)
+REPL_OPS_APPLIED = metrics.counter(
+    "nice_repl_ops_applied_total",
+    "Standby: op-log entries applied to the local replica.",
+)
+REPL_STREAM_ERRORS = metrics.counter(
+    "nice_repl_stream_errors_total",
+    "Standby: failed op-log fetch/apply rounds against the upstream.",
+)
+REPL_FENCED_WRITES = metrics.counter(
+    "nice_repl_fenced_writes_total",
+    "Writes rejected by the epoch fence (410 deposed-primary or 421"
+    " standby misdirect).",
+)
+REPL_STANDBYS = metrics.gauge(
+    "nice_repl_standbys",
+    "Primary: standbys seen polling /repl/ops within the liveness window.",
+)
+
 # Pre-seed the label combinations every layer emits, so a scrape of a fresh
 # process (or of the jax-free server) still shows each series at zero.
 for _path in ("detailed", "dense", "strided"):
@@ -572,6 +613,7 @@ for _phase in ("import-jax", "configure", "devices"):
 for _endpoint in ("claim", "submit", "validate", "renew", "telemetry"):
     CLIENT_REQUEST_SECONDS.labels(_endpoint)
     CLIENT_RETRIES.labels(_endpoint)
+    CLIENT_FAILOVERS.labels(_endpoint)
 for _mode in ("detailed", "niceonly"):
     FLEET_FIELDS.labels(_mode)
     SERVER_FIELD_ELAPSED.labels(_mode)

@@ -45,6 +45,7 @@ from nice_tpu.core.types import (
     SearchMode,
 )
 from nice_tpu.obs.series import (
+    REPL_FENCED_WRITES,
     FLEET_CLIENTS,
     FLEET_DOWNGRADES,
     FLEET_FAULTS,
@@ -73,6 +74,7 @@ from nice_tpu.obs.series import (
     SERVER_TRUST_SLASHES,
 )
 from nice_tpu.ops import scalar
+from nice_tpu.server import repl as repl_mod
 from nice_tpu.server import trust as trust_mod
 from nice_tpu.server.async_core import (
     AsyncHTTPServer,
@@ -152,8 +154,14 @@ class Metrics:
 
 
 class ApiContext:
-    def __init__(self, db: Db):
+    def __init__(self, db: Db, role: str = "primary",
+                 upstream: str | None = None, advertise: str | None = None):
         self.db = db
+        # Replication role. A "standby" context serves only the read
+        # surface from its replica, runs no ledger-mutating background
+        # work (refills, sweeps, history persistence — their rows arrive
+        # via the op log), and answers writes 421 until promoted.
+        self.role = role
         # Single-writer DB actor: every mutation (claims, submits, renewals,
         # telemetry upserts) is enqueued here and coalesced into batched
         # transactions. NICE_TPU_WRITER=0 falls back to direct per-call
@@ -175,17 +183,31 @@ class ApiContext:
             "server.app.ApiContext._stream_stage_lock"
         )
         self.writer.on_batch_end = self._flush_stream_staged
-        # Crash counterpart of FieldQueue.close(): a SIGKILLed server's
-        # in-memory inventory left lease stamps with no claims rows; release
-        # them before this process's queue starts bulk-claiming.
-        # nicelint: allow W1 (sanctioned init: crash recovery runs before the writer accepts work)
-        orphaned = db.release_orphaned_inventory()
-        if orphaned:
-            log.info(
-                "released %d orphaned pre-claimed fields from a dead"
-                " server's queue inventory", orphaned,
-            )
-        self.queue = FieldQueue(db, writer=self.writer, journal=self.journal)
+        # Replication state: epoch fencing + standby registry (primary) or
+        # upstream identity (standby). Wired before the FieldQueue so the
+        # op-log high-water gauge covers its bulk pre-claims too.
+        self.repl = repl_mod.ReplState(
+            db, self.writer, role=role, upstream=upstream,
+            advertise=advertise, hub=self.stream,
+        )
+        self.repl.attach_writer_listener()
+        self.repl_applier = None
+        if role == "primary":
+            # Crash counterpart of FieldQueue.close(): a SIGKILLed server's
+            # in-memory inventory left lease stamps with no claims rows;
+            # release them before this process's queue starts bulk-claiming.
+            # nicelint: allow W1 (sanctioned init: crash recovery runs before the writer accepts work)
+            orphaned = db.release_orphaned_inventory()
+            if orphaned:
+                log.info(
+                    "released %d orphaned pre-claimed fields from a dead"
+                    " server's queue inventory", orphaned,
+                )
+            self.writer.add_periodic(self.repl.prune_tick, 30.0)
+        self.queue = FieldQueue(
+            db, writer=self.writer, journal=self.journal,
+            start_thread=(role == "primary"),
+        )
         self.metrics = Metrics()
         # Untrusted-client hardening: the trust ledger cache (spot-check
         # sampling rates, claim profiles) and the per-client token-bucket
@@ -205,7 +227,7 @@ class ApiContext:
         # the writer thread so re-issue never waits out the global claim
         # expiry cutoff. NICE_TPU_LEASE_SWEEP_SECS=0 disables.
         sweep_secs = knobs.LEASE_SWEEP_SECS.get()
-        if sweep_secs > 0:
+        if sweep_secs > 0 and role == "primary":
             self.writer.add_periodic(self._sweep_leases, sweep_secs)
         # Overload shed: when more than max_inflight requests are being
         # handled at once, new ones (except /metrics) get 503 + Retry-After
@@ -255,8 +277,16 @@ class ApiContext:
         self._last_slo_states: dict = {}
         self._last_anomaly_states: dict = {}
         history_secs = obs.history.sample_interval_secs()
-        if history_secs > 0:
+        if history_secs > 0 and role == "primary":
+            # Standbys skip the observatory beat: metric_history rows
+            # replicate in from the primary, and locally-minted rowids
+            # would collide with them.
             self.writer.add_periodic(self.history_tick, history_secs)
+        if role == "standby" and upstream:
+            self.repl_applier = repl_mod.ReplApplier(
+                db, self.writer, self.repl, hub=self.stream
+            )
+            self.repl_applier.start()
 
     def history_tick(self) -> None:
         """One observatory beat. Runs on the writer thread between batches
@@ -420,7 +450,41 @@ class ApiContext:
         with self._inflight_lock:
             self._inflight -= 1
 
+    def promote_to_primary(self) -> int:
+        """Standby → primary (POST /repl/promote, or restart without
+        --standby-of). Stops the applier, epoch-bumps the ledger (fencing
+        the old primary's lineage), then re-arms every primary duty the
+        standby context skipped: orphan release, queue refills, lease
+        sweep, observatory beat, op-log retention. Idempotent."""
+        if self.repl.role == "primary":
+            return self.repl.epoch
+        if self.repl_applier is not None:
+            self.repl_applier.stop()
+            self.repl_applier = None
+        epoch = self.repl.promote()
+        self.role = "primary"
+        orphaned = self.write(self.db.release_orphaned_inventory)
+        if orphaned:
+            log.info(
+                "promotion released %d orphaned pre-claimed fields from"
+                " the dead primary's queue inventory", orphaned,
+            )
+        self.queue.start()
+        self.queue.refill_niceonly()
+        self.queue.refill_detailed_thin()
+        sweep_secs = knobs.LEASE_SWEEP_SECS.get()
+        if sweep_secs > 0:
+            self.writer.add_periodic(self._sweep_leases, sweep_secs)
+        history_secs = obs.history.sample_interval_secs()
+        if history_secs > 0:
+            self.writer.add_periodic(self.history_tick, history_secs)
+        self.writer.add_periodic(self.repl.prune_tick, 30.0)
+        self.invalidate_status_cache()
+        return epoch
+
     def close(self) -> None:
+        if self.repl_applier is not None:
+            self.repl_applier.stop()
         self.queue.close()
         self.writer.close()
 
@@ -1582,8 +1646,29 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root", "token", "history", "fields", "events", "critpath"}
+     "root", "token", "history", "fields", "events", "critpath", "repl"}
 )
+
+
+def _check_repl_key(request: Request) -> None:
+    """Optional shared-secret gate for the replication surface: op rows
+    carry raw user_ip (which public_query redacts), so NICE_TPU_REPL_KEY
+    should be set before exposing /repl/* beyond a trusted network."""
+    key = knobs.REPL_KEY.get()
+    if key and request.headers.get("X-Repl-Key") != key:
+        raise ApiError(403, "replication surface requires X-Repl-Key")
+
+
+def _is_write(method: str, path: str) -> bool:
+    """Requests the epoch fence applies to: everything that mutates the
+    ledger. /query POST is read-only SQL; /claim/validate hands out a
+    shared validation field without claiming; /repl/* is the replication
+    control surface itself (promotion must work on a standby)."""
+    if method == "POST":
+        return path != "/query" and not path.startswith("/repl/")
+    if method == "GET":
+        return path.startswith("/claim/") and path != "/claim/validate"
+    return False
 
 _CORS_HEADERS = {
     # CORS fairing parity (reference helpers.rs:95-126)
@@ -1602,6 +1687,15 @@ def _json_response(
     if extra_headers:
         headers.update(extra_headers)
     return Response(status=status, headers=headers, body=raw)
+
+
+def _stamp_epoch(ctx: ApiContext, body: dict) -> dict:
+    """Write responses carry the server's fencing epoch so clients learn a
+    promotion from their very next successful write (from_json parsers read
+    keys by name — the extra key is inert for old clients)."""
+    if isinstance(body, dict):
+        body.setdefault("epoch", ctx.repl.epoch)
+    return body
 
 
 def _error_response(status: int, message: str, extra_headers=None) -> Response:
@@ -1763,6 +1857,18 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
         user_ip = request.client_ip
         if method == "OPTIONS":
             return Response(204, headers=dict(_CORS_HEADERS))
+        # Epoch fence: clients stamp the highest epoch they have seen on
+        # every request; a stamp NEWER than ours proves a promotion
+        # happened elsewhere and permanently fences this replica. Writes to
+        # a standby get 421, writes to a fenced deposed primary 410 — both
+        # rotate the client's multi-server failover, and submit_id
+        # exactly-once makes the replayed write safe on the new primary.
+        ctx.repl.note_client_epoch(request.headers.get("X-Nice-Epoch"))
+        if _is_write(method, path):
+            rejected = ctx.repl.check_write()
+            if rejected is not None:
+                REPL_FENCED_WRITES.inc()
+                raise ApiError(rejected[0], rejected[1])
         if method == "GET" and path in ("/claim/detailed", "/claim/niceonly"):
             mode = (
                 SearchMode.DETAILED
@@ -1776,13 +1882,12 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
             tenant, base_min, base_max = _parse_tenant_args(
                 {k: v[0] for k, v in qs.items() if v}
             )
-            return _json_response(
-                200,
-                claim_helper(
-                    ctx, mode, user_ip, client_token,
-                    tenant=tenant, base_min=base_min, base_max=base_max,
-                ).to_json(),
-            )
+            claim_body = claim_helper(
+                ctx, mode, user_ip, client_token,
+                tenant=tenant, base_min=base_min, base_max=base_max,
+            ).to_json()
+            claim_body.setdefault("epoch", ctx.repl.epoch)
+            return _json_response(200, claim_body)
         if method == "GET" and path == "/claim/validate":
             qs = parse_qs(parsed.query)
             base_arg = qs.get("base", [None])[0]
@@ -1801,6 +1906,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                 200,
                 {
                     "status": "ok",
+                    "epoch": ctx.repl.epoch,
                     "niceonly_queue_size": ctx.queue.niceonly_queue_size(),
                     "detailed_thin_queue_size":
                         ctx.queue.detailed_thin_queue_size(),
@@ -1809,6 +1915,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "slo": ctx.slo.last(),
                     "anomalies": ctx.anomaly.last(),
                     "tenants": ctx.db.tenant_rollup(),
+                    "repl": ctx.repl.status_block(),
                 },
             )
         if method == "GET" and path == "/history":
@@ -1962,23 +2069,23 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
         if method == "POST" and path == "/submit":
             return _json_response(
                 200,
-                handle_submit(
+                _stamp_epoch(ctx, handle_submit(
                     ctx, _parse_json_body(request), user_ip, request.headers
-                ),
+                )),
             )
         if method == "POST" and path == "/claim_block":
             return _json_response(
                 200,
-                handle_claim_block(
+                _stamp_epoch(ctx, handle_claim_block(
                     ctx, _parse_json_body(request), user_ip, request.headers
-                ),
+                )),
             )
         if method == "POST" and path == "/submit_block":
             return _json_response(
                 200,
-                handle_submit_block(
+                _stamp_epoch(ctx, handle_submit_block(
                     ctx, _parse_json_body(request), user_ip, request.headers
-                ),
+                )),
             )
         if method == "POST" and path == "/token":
             # Anonymous trust identity for browser/WASM clients with no
@@ -1998,7 +2105,43 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
             )
         if method == "POST" and path == "/renew_claim":
             return _json_response(
-                200, handle_renew_claim(ctx, _parse_json_body(request))
+                200,
+                _stamp_epoch(
+                    ctx, handle_renew_claim(ctx, _parse_json_body(request))
+                ),
+            )
+        if method == "GET" and path == "/repl/ops":
+            # Standby pull feed: one page of the durable op log, seq >
+            # ?since ascending — the /events?since= cursor contract over
+            # repl_ops. Standbys advertise themselves (+ applied seq) so
+            # /status can serve the failover server list.
+            _check_repl_key(request)
+            qs = parse_qs(parsed.query)
+            try:
+                r_since = int(qs.get("since", ["0"])[0])
+                r_limit = int(
+                    qs.get("limit", [str(knobs.REPL_BATCH_OPS.get())])[0]
+                )
+            except ValueError:
+                raise ApiError(400, "since and limit must be integers")
+            r_limit = max(1, min(r_limit, 5000))
+            ctx.repl.record_standby_poll(
+                qs.get("standby", [None])[0], qs.get("applied", ["0"])[0]
+            )
+            return _json_response(
+                200,
+                {
+                    "ops": ctx.db.get_repl_ops_since(r_since, r_limit),
+                    "epoch": ctx.repl.epoch,
+                    "max_seq": ctx.db.repl_max_seq(),
+                    "role": ctx.repl.role,
+                },
+            )
+        if method == "POST" and path == "/repl/promote":
+            _check_repl_key(request)
+            new_epoch = ctx.promote_to_primary()
+            return _json_response(
+                200, {"status": "OK", "role": "primary", "epoch": new_epoch}
             )
         if method == "POST" and path == "/admin/disqualify":
             return _json_response(
@@ -2105,13 +2248,34 @@ def make_handler(ctx: ApiContext):
     return Handler
 
 
-def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True):
+def serve(db_path: str, host: str = "0.0.0.0", port: int = 8127, prefill=True,
+          standby_of: str | None = None, advertise: str | None = None):
     """Build the server (async core by default; NICE_TPU_SERVER_CORE=thread
     selects the legacy ThreadingHTTPServer). The returned object exposes
-    serve_forever() / shutdown() / server_address either way."""
+    serve_forever() / shutdown() / server_address either way.
+
+    standby_of: primary URL — serve as a read-only hot standby replicating
+    from it. advertise: this server's client-reachable URL (published in
+    /status server lists and to the upstream's standby registry)."""
     db = Db(db_path)
-    ctx = ApiContext(db)
-    if prefill:
+    if standby_of:
+        role = "standby"
+        # nicelint: allow W1 (sanctioned init: role flips before the writer exists)
+        db.repl_set_standby()
+    else:
+        role = "primary"
+        if db.repl_role() == "standby":
+            # Restarting a standby-marked replica WITHOUT --standby-of is
+            # an explicit promotion: bump the epoch so the old lineage is
+            # fenced rather than silently forked.
+            # nicelint: allow W1 (sanctioned init: promotion runs before the writer exists)
+            epoch = db.repl_promote()
+            log.warning(
+                "standby-marked db restarted as primary: promoted to"
+                " epoch %d", epoch,
+            )
+    ctx = ApiContext(db, role=role, upstream=standby_of, advertise=advertise)
+    if prefill and role == "primary":
         ctx.queue.refill_niceonly()
         ctx.queue.refill_detailed_thin()
     core = (knobs.SERVER_CORE.get() or "async").lower()
@@ -2158,6 +2322,20 @@ def main(argv=None) -> int:
         default=1_000_000_000,
         help="field width when seeding bases",
     )
+    p.add_argument(
+        "--standby-of",
+        default=None,
+        metavar="URL",
+        help="serve as a read-only hot standby replicating from this"
+        " primary URL (promote via POST /repl/promote)",
+    )
+    p.add_argument(
+        "--advertise",
+        default=None,
+        metavar="URL",
+        help="client-reachable URL of THIS server, published in /status"
+        " server lists for client failover",
+    )
     p.add_argument("--log-level", default="info")
     args = p.parse_args(argv)
     # Unified JSON log sink (trace_id-stamped lines; NICE_TPU_LOG_LEVEL /
@@ -2173,7 +2351,10 @@ def main(argv=None) -> int:
             n = db.seed_base(base, args.field_size)
             log.info("seeded base %d with %d fields", base, n)
         db.close()
-    server = serve(args.db, args.host, args.port)
+    server = serve(
+        args.db, args.host, args.port,
+        standby_of=args.standby_of, advertise=args.advertise,
+    )
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -292,6 +292,11 @@ class Db:
                     "CREATE INDEX IF NOT EXISTS idx_claims_tenant"
                     " ON claims(tenant) WHERE tenant IS NOT NULL"
                 )
+                # Replication capture last: the triggers are regenerated
+                # from PRAGMA table_info AFTER every migration above, so a
+                # column added by a newer server version is captured from
+                # its first write.
+                self._init_repl()
 
     def close(self) -> None:
         with self._lock, self._pool_lock:
@@ -2083,3 +2088,236 @@ class Db:
             else:
                 tiers["trusted"] += 1
         return tiers
+
+    # -- replication (nice_tpu/server/repl.py) -----------------------------
+    # Physical row-level replication: AFTER INSERT/UPDATE/DELETE triggers on
+    # every replicated table append (seq, epoch, tbl, op, rowid, row-JSON)
+    # to repl_ops INSIDE the mutating transaction — the op log commits
+    # atomically with the change, so a crash-consistent snapshot is always
+    # gap-free. Standbys pull ops over HTTP (?since=seq resume) and apply
+    # them with capture OFF so replays are not re-logged.
+
+    # Tables whose rows replicate. repl_meta / repl_ops themselves never do
+    # (each replica owns its identity and log); sqlite_sequence is derived.
+    REPL_TABLES = (
+        "bases",
+        "chunks",
+        "fields",
+        "claims",
+        "submissions",
+        "cache_search_rate_daily",
+        "cache_search_leaderboard",
+        "client_telemetry",
+        "metric_history",
+        "field_events",
+        "client_trust",
+    )
+
+    def _init_repl(self) -> None:
+        """Seed repl_meta defaults and (re)generate the capture triggers.
+        Runs with self._lock held, at the tail of init_schema — AFTER the
+        Python column migrations, so the json_object() row image always
+        covers the live column set. INSERT OR IGNORE keeps a promoted
+        standby's persisted role/epoch across restarts."""
+        conn = self._conn
+        conn.executemany(
+            "INSERT OR IGNORE INTO repl_meta (key, value) VALUES (?, ?)",
+            [
+                ("epoch", "1"),
+                ("role", "primary"),
+                ("capture", "1"),
+                ("fenced", "0"),
+                ("last_applied_seq", "0"),
+            ],
+        )
+        for tbl in self.REPL_TABLES:
+            cols = [
+                r["name"]
+                for r in conn.execute(f"PRAGMA table_info({tbl})").fetchall()
+            ]
+            if not cols:
+                continue
+            for suffix, verb, ref in (
+                ("i", "INSERT", "NEW"),
+                ("u", "UPDATE", "NEW"),
+                ("d", "DELETE", "OLD"),
+            ):
+                name = f"repl_{tbl}_{suffix}"
+                conn.execute(f"DROP TRIGGER IF EXISTS {name}")
+                if suffix == "d":
+                    row_expr = "NULL"
+                else:
+                    pairs = ", ".join(f"'{c}', {ref}.{c}" for c in cols)
+                    row_expr = f"json_object({pairs})"
+                conn.execute(
+                    f"CREATE TRIGGER {name} AFTER {verb} ON {tbl}"
+                    " WHEN (SELECT value FROM repl_meta WHERE key='capture')"
+                    "      = '1'"
+                    " BEGIN"
+                    "   INSERT INTO repl_ops (epoch, tbl, op, rid, row)"
+                    "   VALUES ((SELECT CAST(value AS INTEGER) FROM repl_meta"
+                    "            WHERE key='epoch'),"
+                    f"          '{tbl}', '{suffix.upper()}', {ref}.rowid,"
+                    f"          {row_expr});"
+                    " END"
+                )
+
+    def repl_meta_get(self, key: str, default: str = "") -> str:
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT value FROM repl_meta WHERE key = ?", (key,)
+            ).fetchone()
+        return default if row is None else str(row[0])
+
+    def repl_meta_set(self, key: str, value: str) -> None:
+        with self._lock, self._txn():
+            self._conn.execute(
+                "INSERT INTO repl_meta (key, value) VALUES (?, ?)"
+                " ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                (key, str(value)),
+            )
+
+    def repl_epoch(self) -> int:
+        return int(self.repl_meta_get("epoch", "1"))
+
+    def repl_role(self) -> str:
+        return self.repl_meta_get("role", "primary")
+
+    def repl_fenced(self) -> bool:
+        return self.repl_meta_get("fenced", "0") == "1"
+
+    def repl_last_applied_seq(self) -> int:
+        return int(self.repl_meta_get("last_applied_seq", "0"))
+
+    def repl_max_seq(self) -> int:
+        with self._read_conn() as conn:
+            row = conn.execute(
+                "SELECT COALESCE(MAX(seq), 0) FROM repl_ops"
+            ).fetchone()
+        return int(row[0])
+
+    def get_repl_ops_since(self, since: int, limit: int = 500) -> list[dict]:
+        """One page of the op log: ops with seq > since, ascending (the
+        standby passes the last applied seq back — the /events?since=
+        cursor contract, over the durable log)."""
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT seq, epoch, tbl, op, rid, row FROM repl_ops"
+                " WHERE seq > ? ORDER BY seq ASC LIMIT ?",
+                (int(since), max(1, int(limit))),
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def repl_set_standby(self) -> None:
+        """Flip this replica to standby: capture OFF (applying streamed ops
+        must not re-log them) and the role persisted for restart."""
+        with self._lock, self._txn():
+            self._conn.execute(
+                "UPDATE repl_meta SET value = 'standby' WHERE key = 'role'"
+            )
+            self._conn.execute(
+                "UPDATE repl_meta SET value = '0' WHERE key = 'capture'"
+            )
+
+    def apply_repl_ops(self, ops: list[dict]) -> int:
+        """Apply one page of streamed ops to this standby replica in ONE
+        transaction, advancing last_applied_seq and the locally-known epoch
+        with them — a torn page can never be half-applied. Must run with
+        capture off (repl_set_standby); unknown tables are skipped so a
+        newer primary's tables degrade gracefully."""
+        if not ops:
+            return 0
+        applied = 0
+        with self._lock, self._txn():
+            for op in ops:
+                tbl = op["tbl"]
+                if tbl not in self.REPL_TABLES:
+                    continue
+                if op["op"] == "D":
+                    self._conn.execute(
+                        f"DELETE FROM {tbl} WHERE rowid = ?",
+                        (int(op["rid"]),),
+                    )
+                else:
+                    row = json.loads(op["row"])
+                    cols = list(row.keys())
+                    marks = ", ".join("?" for _ in cols)
+                    self._conn.execute(
+                        f"INSERT OR REPLACE INTO {tbl}"
+                        f" (rowid, {', '.join(cols)})"
+                        f" VALUES (?, {marks})",
+                        [int(op["rid"]), *row.values()],
+                    )
+                applied += 1
+            last = max(int(op["seq"]) for op in ops)
+            self._conn.execute(
+                "UPDATE repl_meta SET value = ?"
+                " WHERE key = 'last_applied_seq'"
+                " AND CAST(value AS INTEGER) < ?",
+                (str(last), last),
+            )
+            epoch = max(int(op["epoch"]) for op in ops)
+            self._conn.execute(
+                "UPDATE repl_meta SET value = ? WHERE key = 'epoch'"
+                " AND CAST(value AS INTEGER) < ?",
+                (str(epoch), epoch),
+            )
+        return applied
+
+    def repl_promote(self) -> int:
+        """Epoch-fenced promotion: bump the monotonic epoch, become primary
+        with capture on, clear any fence, and seed the op-log AUTOINCREMENT
+        so the new lineage's seq continues from the applied watermark — a
+        rejoining replica's ?since= cursor stays meaningful across the
+        promotion. Returns the new epoch. One transaction: a crash mid-
+        promote leaves the replica either fully standby or fully primary."""
+        with self._lock, self._txn():
+            epoch = int(
+                self._conn.execute(
+                    "SELECT value FROM repl_meta WHERE key = 'epoch'"
+                ).fetchone()[0]
+            ) + 1
+            for key, value in (
+                ("epoch", str(epoch)),
+                ("role", "primary"),
+                ("capture", "1"),
+                ("fenced", "0"),
+            ):
+                self._conn.execute(
+                    "INSERT INTO repl_meta (key, value) VALUES (?, ?)"
+                    " ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                    (key, value),
+                )
+            applied = int(
+                self._conn.execute(
+                    "SELECT value FROM repl_meta"
+                    " WHERE key = 'last_applied_seq'"
+                ).fetchone()[0]
+            )
+            cur_max = int(
+                self._conn.execute(
+                    "SELECT COALESCE(MAX(seq), 0) FROM repl_ops"
+                ).fetchone()[0]
+            )
+            base = max(applied, cur_max)
+            self._conn.execute(
+                "INSERT OR IGNORE INTO sqlite_sequence (name, seq)"
+                " VALUES ('repl_ops', 0)"
+            )
+            self._conn.execute(
+                "UPDATE sqlite_sequence SET seq = ?"
+                " WHERE name = 'repl_ops' AND seq < ?",
+                (base, base),
+            )
+        return epoch
+
+    def prune_repl_ops(self, keep: int) -> int:
+        """Retention: keep the newest `keep` ops (a standby further behind
+        than that must re-seed from a snapshot). Returns rows dropped."""
+        with self._lock, self._txn():
+            cur = self._conn.execute(
+                "DELETE FROM repl_ops WHERE seq <="
+                " (SELECT COALESCE(MAX(seq), 0) FROM repl_ops) - ?",
+                (max(0, int(keep)),),
+            )
+            return cur.rowcount

@@ -66,10 +66,18 @@ class FieldQueue:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if start_thread:
-            self._thread = threading.Thread(
-                target=self._refill_loop, name="field-queue-refill", daemon=True
-            )
-            self._thread.start()
+            self.start()
+
+    def start(self) -> None:
+        """Start the refill thread. A standby replica builds its queue with
+        start_thread=False (refills would mutate the replicated ledger) and
+        calls this when it is promoted to primary."""
+        if self._thread is not None or self._stop.is_set():
+            return
+        self._thread = threading.Thread(
+            target=self._refill_loop, name="field-queue-refill", daemon=True
+        )
+        self._thread.start()
 
     def close(self) -> None:
         self._stop.set()

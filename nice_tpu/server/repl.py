@@ -1,0 +1,395 @@
+"""Replication & epoch-fenced failover for the coordination plane.
+
+One primary, N hot standbys, one SQLite file each. The primary's capture
+triggers (db.py:_init_repl) append every committed row change to a durable
+sequence-numbered op log *inside the mutating transaction*, so the log is
+crash-consistent with the ledger by construction. Standbys pull pages of
+that log over HTTP (``GET /repl/ops?since=SEQ`` — the same cursor-resume
+contract the SSE journal feed uses) and apply them to their own replica,
+serving the whole read-only surface locally while advertising applied-seq
+lag.
+
+Fencing: a monotonic **epoch** lives in the ledger (repl_meta). Promotion
+bumps it. Clients stamp the highest epoch they have seen on every request
+(``X-Nice-Epoch``); a server that sees a *higher* epoch than its own knows
+it has been deposed and fences itself — persistently — so every later
+write, stamped or not, is answered ``410 Gone``. Writes reaching a standby
+get ``421 Misdirected Request``. Both are non-retryable at that endpoint
+but rotate the client's multi-server failover, and the submit_id
+exactly-once machinery makes the replayed write safe on the new primary.
+
+Threading: ``repl-applier`` (standby only) is the single thread touching
+the upstream socket; all replica mutations go through the writer actor so
+the single-writer discipline holds on standbys too.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from nice_tpu import faults
+from nice_tpu.obs.series import (
+    REPL_APPLIED_SEQ,
+    REPL_EPOCH,
+    REPL_LAG,
+    REPL_OPS_APPLIED,
+    REPL_SEQ,
+    REPL_STANDBYS,
+    REPL_STREAM_ERRORS,
+)
+from nice_tpu.server.db import Db
+from nice_tpu.utils import knobs, lockdep
+
+log = logging.getLogger("nice.repl")
+
+# A standby that hasn't polled for this many poll intervals is considered
+# gone (dropped from /status's server list and the standby gauge).
+STANDBY_LIVENESS_POLLS = 10
+
+
+class ReplState:
+    """Per-server replication identity: role, epoch, fence, standby registry.
+
+    Epoch and fence are cached in memory for the per-request hot path and
+    persisted through the writer so they survive restart; the fence is
+    STICKY — once a request proves a newer epoch exists, this replica never
+    accepts another write until an explicit promotion clears it.
+    """
+
+    def __init__(
+        self,
+        db: Db,
+        writer,
+        role: str = "primary",
+        upstream: Optional[str] = None,
+        advertise: Optional[str] = None,
+        hub=None,
+    ):
+        self._lock = lockdep.make_lock("server.repl.ReplState._lock")
+        self.db = db
+        self.writer = writer
+        self.hub = hub
+        self.upstream = upstream.rstrip("/") if upstream else None
+        self.advertise = advertise.rstrip("/") if advertise else None
+        self._role = role
+        self._epoch = db.repl_epoch()
+        self._fenced = db.repl_fenced()
+        self._last_seq = db.repl_max_seq()
+        # url -> (applied_seq, monotonic ts of last poll)
+        self._standbys: dict[str, tuple[int, float]] = {}
+        REPL_EPOCH.set(self._epoch)
+        if role == "primary":
+            REPL_SEQ.set(self._last_seq)
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def role(self) -> str:
+        with self._lock:
+            return self._role
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced
+
+    # -- fencing -----------------------------------------------------------
+
+    def note_client_epoch(self, header: Optional[str]) -> None:
+        """A request carried ``X-Nice-Epoch``. Seeing a higher epoch than
+        our own is proof a promotion happened elsewhere: fence permanently.
+        The persist goes through the writer fire-and-forget — the in-memory
+        fence already rejects this request and every one after it."""
+        if not header:
+            return
+        try:
+            seen = int(header)
+        except ValueError:
+            return
+        with self._lock:
+            if seen <= self._epoch or self._fenced:
+                return
+            self._fenced = True
+        log.warning(
+            "epoch fence: client presented epoch %d > local %d; "
+            "refusing all writes until explicit promotion", seen, self._epoch
+        )
+        try:
+            self.writer.submit(self.db.repl_meta_set, "fenced", "1")
+        except Exception:  # noqa: BLE001 — the in-memory fence holds anyway
+            log.exception("failed to persist fence flag")
+
+    def check_write(self) -> Optional[tuple[int, str]]:
+        """(status, message) to reject this write with, or None to allow.
+        Called for every mutating request before any handler runs."""
+        with self._lock:
+            if self._role == "standby":
+                return (
+                    421,
+                    "standby replica: writes must go to the primary",
+                )
+            if self._fenced:
+                return (
+                    410,
+                    "fenced deposed primary: a newer epoch exists;"
+                    " retry against the promoted server",
+                )
+        return None
+
+    # -- promotion ---------------------------------------------------------
+
+    def promote(self) -> int:
+        """Become primary: bump the epoch (fencing the old primary's
+        lineage), re-enable capture, clear any fence. The ledger flip is
+        one transaction; callers re-arm primary duties afterwards."""
+        epoch = self.writer.call(self.db.repl_promote)
+        with self._lock:
+            self._role = "primary"
+            self._epoch = epoch
+            self._fenced = False
+            self._last_seq = self.db.repl_max_seq()
+        REPL_EPOCH.set(epoch)
+        REPL_SEQ.set(self._last_seq)
+        log.warning("promoted to primary at epoch %d", epoch)
+        if self.hub is not None:
+            self.hub.publish(
+                "repl", {"event": "promoted", "epoch": epoch,
+                         "seq": self._last_seq}
+            )
+        return epoch
+
+    def note_applied(self, applied_seq: int, upstream_epoch: int,
+                     upstream_max: int) -> None:
+        """Standby applier progress (gauges + epoch cache)."""
+        with self._lock:
+            if upstream_epoch > self._epoch:
+                self._epoch = upstream_epoch
+        REPL_APPLIED_SEQ.set(applied_seq)
+        REPL_LAG.set(max(0, upstream_max - applied_seq))
+        REPL_EPOCH.set(self.epoch)
+
+    # -- primary-side bookkeeping ------------------------------------------
+
+    def attach_writer_listener(self) -> None:
+        """Publish the op-log high-water mark after every committed batch
+        (post-commit, same guarantee as the journal stream flush)."""
+        self.writer.add_batch_end_listener(self._on_batch_end)
+
+    def _on_batch_end(self, committed: bool) -> None:
+        if not committed or self.role != "primary":
+            return
+        seq = self.db.repl_max_seq()
+        with self._lock:
+            if seq == self._last_seq:
+                return
+            self._last_seq = seq
+        REPL_SEQ.set(seq)
+        if self.hub is not None:
+            self.hub.publish(
+                "repl", {"event": "commit", "seq": seq, "epoch": self.epoch}
+            )
+
+    def prune_tick(self) -> None:
+        """Writer periodic on the primary: bound op-log retention."""
+        if self.role != "primary":
+            return
+        keep = knobs.REPL_RETENTION_OPS.get()
+        if keep and keep > 0:
+            # nicelint: allow W1 (writer periodic: already runs on the writer thread between batches)
+            self.db.prune_repl_ops(keep)
+
+    # -- standby registry (primary side) -----------------------------------
+
+    def record_standby_poll(self, url: Optional[str],
+                            applied: Optional[int]) -> None:
+        if not url:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._standbys[url.rstrip("/")] = (int(applied or 0), now)
+        REPL_STANDBYS.set(len(self.live_standbys()))
+
+    def live_standbys(self) -> dict[str, int]:
+        """url -> applied_seq for standbys seen within the liveness window."""
+        window = STANDBY_LIVENESS_POLLS * max(
+            0.05, knobs.REPL_POLL_SECS.get()
+        )
+        cutoff = time.monotonic() - window
+        with self._lock:
+            return {
+                url: applied
+                for url, (applied, ts) in self._standbys.items()
+                if ts >= cutoff
+            }
+
+    def known_servers(self) -> list[str]:
+        """Every endpoint a client could fail over to, primary first —
+        served in /status so clients can persist the list (satellite:
+        learned-server failover survives a dead configured primary)."""
+        servers: list[str] = []
+        if self.role == "primary":
+            if self.advertise:
+                servers.append(self.advertise)
+            servers.extend(self.live_standbys())
+        else:
+            if self.upstream:
+                servers.append(self.upstream)
+            if self.advertise:
+                servers.append(self.advertise)
+        return list(dict.fromkeys(servers))
+
+    def status_block(self) -> dict:
+        with self._lock:
+            role, epoch, fenced = self._role, self._epoch, self._fenced
+        block = {
+            "role": role,
+            "epoch": epoch,
+            "fenced": fenced,
+            "servers": self.known_servers(),
+        }
+        if role == "primary":
+            block["seq"] = self.db.repl_max_seq()
+            block["standbys"] = self.live_standbys()
+        else:
+            applied = self.db.repl_last_applied_seq()
+            block["applied_seq"] = applied
+        return block
+
+
+class ReplApplier:
+    """Standby-side op-log puller: one thread, plain urllib (the server
+    package must not depend on the client transport), all DB mutation via
+    the writer actor. Fault sites: ``repl.stream`` fires before each fetch
+    (conn_error/raise → injected URLError; numeric → sleep), ``repl.apply``
+    before each apply transaction."""
+
+    def __init__(self, db: Db, writer, state: ReplState, hub=None):
+        self.db = db
+        self.writer = writer
+        self.state = state
+        self.hub = hub
+        self._stop = threading.Event()
+        self._rng = random.Random()
+        self._thread = threading.Thread(
+            target=self._run, name="repl-applier", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    # -- loop --------------------------------------------------------------
+
+    def _run(self) -> None:
+        errors = 0
+        while not self._stop.is_set():
+            try:
+                full_page = self._poll_once()
+                errors = 0
+            except Exception:  # noqa: BLE001 — the applier must survive
+                REPL_STREAM_ERRORS.inc()
+                errors += 1
+                if errors <= 3 or errors % 50 == 0:
+                    log.exception("repl stream poll failed (x%d)", errors)
+                # Full-jitter backoff, bounded: the upstream being down is
+                # the NORMAL state right before a promotion.
+                self._stop.wait(
+                    self._rng.uniform(0, min(2.0, 0.1 * (2 ** min(errors, 5))))
+                )
+                continue
+            if not full_page:
+                self._stop.wait(max(0.05, knobs.REPL_POLL_SECS.get()))
+
+    def _poll_once(self) -> bool:
+        """One fetch+apply round. Returns True when the page was full
+        (more ops are likely waiting — re-poll immediately)."""
+        act = faults.fire("repl.stream")
+        if act is not None:
+            if act in ("conn_error", "raise"):
+                raise urllib.error.URLError("injected repl.stream fault")
+            try:
+                time.sleep(float(act))
+            except (TypeError, ValueError):
+                pass
+
+        since = self.db.repl_last_applied_seq()
+        limit = max(1, knobs.REPL_BATCH_OPS.get())
+        page = self._fetch(since, limit)
+        ops = page.get("ops") or []
+
+        if ops:
+            act = faults.fire("repl.apply")
+            if act is not None:
+                if act in ("conn_error", "raise"):
+                    raise RuntimeError("injected repl.apply fault")
+                try:
+                    time.sleep(float(act))
+                except (TypeError, ValueError):
+                    pass
+            applied = self.writer.call(self.db.apply_repl_ops, ops)
+            REPL_OPS_APPLIED.inc(applied)
+            self._publish_journal(ops)
+            since = int(ops[-1]["seq"])
+
+        self.state.note_applied(
+            since,
+            int(page.get("epoch") or 0),
+            int(page.get("max_seq") or since),
+        )
+        return len(ops) >= limit
+
+    def _fetch(self, since: int, limit: int) -> dict:
+        params = {"since": str(since), "limit": str(limit)}
+        if self.state.advertise:
+            params["standby"] = self.state.advertise
+            params["applied"] = str(since)
+        url = (
+            f"{self.state.upstream}/repl/ops?"
+            + urllib.parse.urlencode(params)
+        )
+        req = urllib.request.Request(url)
+        key = knobs.REPL_KEY.get()
+        if key:
+            req.add_header("X-Repl-Key", key)
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _publish_journal(self, ops: list[dict]) -> None:
+        """Mirror replicated field_events inserts into the local SSE hub so
+        a standby's /events/stream consumers see the same live feed (resume
+        replay comes from the replica's own field_events table)."""
+        if self.hub is None:
+            return
+        rows = []
+        for op in ops:
+            if op.get("tbl") != "field_events" or op.get("op") != "I":
+                continue
+            try:
+                row = json.loads(op["row"])
+            except (TypeError, ValueError):
+                continue
+            try:
+                row["detail"] = json.loads(row.get("detail") or "{}")
+            except (TypeError, ValueError):
+                row["detail"] = {}
+            row.setdefault("id", int(op["rid"]))
+            rows.append(row)
+        if rows:
+            self.hub.publish_journal_rows(rows)

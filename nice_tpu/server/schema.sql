@@ -212,3 +212,28 @@ CREATE TABLE IF NOT EXISTS client_trust (
     first_seen      TEXT NOT NULL,                 -- ISO-8601 UTC
     last_seen       TEXT NOT NULL                  -- ISO-8601 UTC
 );
+
+-- Replication plane (nice_tpu/server/repl.py). repl_meta holds the
+-- replication identity of THIS database file: monotonic promotion epoch,
+-- role (primary/standby), whether the capture triggers log mutations
+-- (primary yes, standby no — applying streamed ops must not re-log them),
+-- the sticky write fence, and the standby's applied-seq watermark.
+-- repl_ops is the sequence-numbered durable op log: AFTER INSERT/UPDATE/
+-- DELETE triggers (generated in Db._init_repl from PRAGMA table_info so
+-- later column migrations are picked up automatically) append one
+-- physical-row op per mutation, inside the mutating transaction — the log
+-- commits atomically with the change it describes, so seq is gap-free on
+-- any crash-consistent snapshot.
+CREATE TABLE IF NOT EXISTS repl_meta (
+    key             TEXT PRIMARY KEY,
+    value           TEXT NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS repl_ops (
+    seq             INTEGER PRIMARY KEY AUTOINCREMENT,
+    epoch           INTEGER NOT NULL,              -- ledger epoch at capture
+    tbl             TEXT NOT NULL,                 -- replicated table name
+    op              TEXT NOT NULL,                 -- 'I' | 'U' | 'D'
+    rid             INTEGER NOT NULL,              -- source rowid
+    row             TEXT                           -- JSON row image (NULL on D)
+);

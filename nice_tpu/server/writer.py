@@ -91,6 +91,9 @@ class WriteActor:
         # stream plane uses it to publish journal events only once they are
         # durable. Exceptions are contained — never fatal to the writer.
         self.on_batch_end: Callable[[bool], None] | None = None
+        # Additional post-batch listeners (replication publishes the new
+        # op-log high-water mark here). Same contract as on_batch_end.
+        self._batch_end_listeners: list[Callable[[bool], None]] = []
         # USE rollup inputs: cumulative wall time this actor spent executing
         # batches, against its uptime (busy fraction = how saturated the
         # single-writer resource is).
@@ -257,14 +260,18 @@ class WriteActor:
             else:
                 fut.set_exception(err)
 
+    def add_batch_end_listener(self, fn: Callable[[bool], None]) -> None:
+        """Register an extra post-batch hook (fires after on_batch_end)."""
+        self._batch_end_listeners.append(fn)
+
     def _notify_batch_end(self, committed: bool) -> None:
-        hook = self.on_batch_end
-        if hook is None:
-            return
-        try:
-            hook(committed)
-        except Exception:  # noqa: BLE001 — observability must not kill the writer
-            log.exception("writer on_batch_end hook failed")
+        for hook in [self.on_batch_end, *self._batch_end_listeners]:
+            if hook is None:
+                continue
+            try:
+                hook(committed)
+            except Exception:  # noqa: BLE001 — observability must not kill the writer
+                log.exception("writer on_batch_end hook failed")
 
 
 class DirectWriter:
@@ -275,17 +282,21 @@ class DirectWriter:
     def __init__(self, db: Db):
         self.db = db
         self.on_batch_end: Callable[[bool], None] | None = None
+        self._batch_end_listeners: list[Callable[[bool], None]] = []
+
+    def add_batch_end_listener(self, fn: Callable[[bool], None]) -> None:
+        self._batch_end_listeners.append(fn)
 
     def _notify(self, committed: bool) -> None:
         # Each call is its own "batch": the stream plane's post-commit
         # publish hook fires symmetrically with the actor path.
-        hook = self.on_batch_end
-        if hook is None:
-            return
-        try:
-            hook(committed)
-        except Exception:  # noqa: BLE001 — same containment as the actor
-            log.exception("direct-writer on_batch_end hook failed")
+        for hook in [self.on_batch_end, *self._batch_end_listeners]:
+            if hook is None:
+                continue
+            try:
+                hook(committed)
+            except Exception:  # noqa: BLE001 — same containment as the actor
+                log.exception("direct-writer on_batch_end hook failed")
 
     def submit(self, fn: Callable, *args, **kwargs) -> Future:
         fut: Future = Future()

@@ -785,6 +785,42 @@ SCHED_SLO_BOOST = _k(
     owner="sched/scheduler.py", group="sched",
 )
 
+# -- replication & failover ------------------------------------------------
+SERVERS = _k(
+    "NICE_TPU_SERVERS", "str", None,
+    "Comma-separated server endpoints for client failover"
+    ' ("http://a:8000,http://b:8000"). Folded into --api-base; on'
+    " conn_error/timeout/fence the client rotates to the next endpoint"
+    " with the existing full-jitter backoff. Unset = single-server.",
+    owner="client/api_client.py", group="repl",
+)
+REPL_POLL_SECS = _k(
+    "NICE_TPU_REPL_POLL_SECS", "float", 0.5,
+    "Standby op-log poll cadence against the upstream primary's"
+    " /repl/ops. A full page triggers an immediate re-poll regardless.",
+    owner="server/repl.py", group="repl",
+)
+REPL_BATCH_OPS = _k(
+    "NICE_TPU_REPL_BATCH_OPS", "int", 500,
+    "Max ops per /repl/ops page (one standby apply transaction).",
+    owner="server/repl.py", group="repl",
+)
+REPL_RETENTION_OPS = _k(
+    "NICE_TPU_REPL_RETENTION_OPS", "int", 200000,
+    "Op-log retention: the primary periodically prunes repl_ops to the"
+    " newest N rows; a standby further behind must re-seed from a"
+    " snapshot of the primary's DB file. <=0 disables pruning.",
+    owner="server/repl.py", group="repl",
+)
+REPL_KEY = _k(
+    "NICE_TPU_REPL_KEY", "str", None,
+    "Shared secret for the replication surface: when set, /repl/ops and"
+    " /repl/promote require a matching X-Repl-Key header (op rows carry"
+    " raw user_ip, which public_query redacts — gate before exposing"
+    " beyond a trusted network). Unset = open (dev/smoke).",
+    owner="server/repl.py", group="repl",
+)
+
 
 # ---------------------------------------------------------------------------
 # Documentation rendering (docs/KNOBS.md + README tables). nicelint's K1
@@ -801,6 +837,7 @@ _GROUP_TITLES = {
     "lockdep": "Lock diagnostics",
     "analysis": "Static analysis",
     "sched": "Multi-tenant scheduler",
+    "repl": "Replication & failover",
     "general": "General",
 }
 

@@ -1,0 +1,413 @@
+"""Replication & epoch-fenced failover tests.
+
+Three layers:
+  * Db-level: trigger capture, op apply, sequence continuity across
+    promotion, retention pruning — no HTTP involved.
+  * Server-pair: a real primary + hot standby replicating over HTTP,
+    fencing (421 standby / 410 deposed), promotion, /status server lists.
+  * Client-side: multi-server failover rotation, the spool replay across a
+    promotion answering {"duplicate": true} exactly once, per-host
+    connection eviction, and the persisted known-server list.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nice_tpu.client import api_client
+from nice_tpu.client.main import (
+    _load_known_servers,
+    _save_known_servers,
+    compile_results,
+    process_field,
+)
+from nice_tpu.core.types import SearchMode
+from nice_tpu.faults import spool as spool_mod
+from nice_tpu.server import app as server_app
+from nice_tpu.server.db import Db
+
+
+@pytest.fixture(autouse=True)
+def _reset_client_state():
+    """The transport's module state (learned epoch, failover cursor, dead
+    hosts, pooled sockets) must not leak across tests — a stale epoch 2
+    stamped at a fresh epoch-1 server would fence it."""
+
+    def _reset():
+        with api_client._epoch_lock:
+            api_client._last_epoch = 0
+        with api_client._failover_lock:
+            api_client._failover_idx.clear()
+            api_client._failover_gen.clear()
+        with api_client._dead_hosts_lock:
+            api_client._dead_hosts.clear()
+        api_client.close_connections()
+
+    _reset()
+    yield
+    _reset()
+
+
+# ---------------------------------------------------------------------------
+# Db-level: capture triggers + apply
+
+
+def _seeded_db(tmp_path, name="primary.db"):
+    path = str(tmp_path / name)
+    db = Db(path)
+    db.seed_base(10, field_size=20)  # [47,100) -> 3 fields
+    return path, db
+
+
+def test_oplog_captures_committed_writes(tmp_path):
+    _, db = _seeded_db(tmp_path)
+    ops = db.get_repl_ops_since(0, limit=10_000)
+    assert ops, "seeding produced no replication ops"
+    seqs = [op["seq"] for op in ops]
+    assert seqs == list(range(1, len(seqs) + 1)), "op log has gaps"
+    assert {op["epoch"] for op in ops} == {1}
+    assert db.repl_max_seq() == seqs[-1]
+    tables = {op["tbl"] for op in ops}
+    assert "bases" in tables and "fields" in tables
+    db.close()
+
+
+def test_apply_roundtrip_and_standby_capture_off(tmp_path):
+    _, primary = _seeded_db(tmp_path)
+    ops = primary.get_repl_ops_since(0, limit=10_000)
+
+    standby = Db(str(tmp_path / "standby.db"))
+    standby.repl_set_standby()
+    applied = standby.apply_repl_ops(ops)
+    assert applied == len(ops)
+    assert standby.repl_last_applied_seq() == ops[-1]["seq"]
+
+    for tbl in ("bases", "fields"):
+        want = primary._read().execute(f"SELECT COUNT(*) FROM {tbl}").fetchone()[0]
+        got = standby._read().execute(f"SELECT COUNT(*) FROM {tbl}").fetchone()[0]
+        assert got == want, f"{tbl}: replica has {got} rows, primary {want}"
+    # Applying replicated rows must NOT be re-captured into the standby's
+    # own op log (capture is off for the standby role).
+    assert standby.get_repl_ops_since(0) == []
+    primary.close()
+    standby.close()
+
+
+def test_promote_bumps_epoch_and_continues_sequence(tmp_path):
+    _, primary = _seeded_db(tmp_path)
+    ops = primary.get_repl_ops_since(0, limit=10_000)
+    top = ops[-1]["seq"]
+
+    standby = Db(str(tmp_path / "standby.db"))
+    standby.repl_set_standby()
+    standby.apply_repl_ops(ops)
+
+    epoch = standby.repl_promote()
+    assert epoch == 2
+    assert standby.repl_role() == "primary"
+    assert not standby.repl_fenced()
+
+    # The first write after promotion continues the global sequence: no
+    # seq reuse means a resumed standby of the OLD primary can never
+    # silently interleave two lineages.
+    standby.seed_base(17, field_size=30_000)
+    new_ops = standby.get_repl_ops_since(top)
+    assert new_ops, "post-promotion write captured no ops"
+    assert new_ops[0]["seq"] == top + 1
+    assert {op["epoch"] for op in new_ops} == {2}
+    primary.close()
+    standby.close()
+
+
+def test_prune_keeps_recent_ops(tmp_path):
+    _, db = _seeded_db(tmp_path)
+    top = db.repl_max_seq()
+    assert top > 2
+    removed = db.prune_repl_ops(keep=2)
+    assert removed == top - 2
+    remaining = db.get_repl_ops_since(0)
+    assert [op["seq"] for op in remaining] == [top - 1, top]
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# Server pair: live replication, fencing, promotion
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start(db_path, port, standby_of=None, advertise=None):
+    srv = server_app.serve(
+        db_path, host="127.0.0.1", port=port,
+        prefill=(standby_of is None),
+        standby_of=standby_of, advertise=advertise,
+    )
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body=None):
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture()
+def pair(tmp_path, monkeypatch):
+    """A live primary + hot standby, both with advertise URLs so the
+    primary's /status server list names them both."""
+    monkeypatch.setenv("NICE_TPU_REPL_POLL_SECS", "0.05")
+    p_port, s_port = _free_port(), _free_port()
+    purl = f"http://127.0.0.1:{p_port}"
+    surl = f"http://127.0.0.1:{s_port}"
+
+    p_path, db = _seeded_db(tmp_path)
+    db.close()
+    s_path = str(tmp_path / "standby.db")
+
+    primary = _start(p_path, p_port, advertise=purl)
+    standby = _start(s_path, s_port, standby_of=purl, advertise=surl)
+    yield {
+        "primary": primary, "standby": standby,
+        "purl": purl, "surl": surl,
+        "p_path": p_path, "s_path": s_path,
+    }
+    for srv in (primary, standby):
+        srv.shutdown()
+        srv.context.close()
+
+
+def _applied_seq(surl) -> int:
+    return int(_get(f"{surl}/status")["repl"].get("applied_seq") or 0)
+
+
+def test_standby_replicates_and_rejects_writes(pair):
+    purl, surl = pair["purl"], pair["surl"]
+    p_status = _get(f"{purl}/status")
+    assert p_status["repl"]["role"] == "primary"
+    assert p_status["epoch"] == 1
+    target = p_status["repl"]["seq"]
+    assert target > 0
+
+    assert _wait(lambda: _applied_seq(surl) >= target), (
+        f"standby never caught up to seq {target}: at {_applied_seq(surl)}"
+    )
+    s_status = _get(f"{surl}/status")
+    assert s_status["repl"]["role"] == "standby"
+    assert s_status["status"] == "ok"
+
+    # Read surface served from the replica.
+    assert _get(f"{surl}/stats/bases")
+
+    # Writes are misdirected: 421 rotates a failover client to the primary.
+    with pytest.raises(api_client.ApiError) as exc:
+        api_client.retry_request(
+            f"{surl}/claim/detailed?username=tester", max_retries=0
+        )
+    assert exc.value.status == 421
+
+    # The primary registers the polling standby and advertises both
+    # endpoints for clients to learn.
+    assert _wait(
+        lambda: surl in _get(f"{purl}/status")["repl"]["servers"]
+    ), "primary never registered the standby"
+    assert purl in _get(f"{purl}/status")["repl"]["servers"]
+
+
+def test_promotion_fences_deposed_primary(pair):
+    purl, surl = pair["purl"], pair["surl"]
+    target = _get(f"{purl}/status")["repl"]["seq"]
+    assert _wait(lambda: _applied_seq(surl) >= target)
+
+    # Client learns epoch 1 from the primary before the failover.
+    api_client.retry_request(f"{purl}/status", max_retries=0)
+    assert api_client.last_seen_epoch() == 1
+
+    resp = _post(f"{surl}/repl/promote")
+    assert resp["status"] == "OK" and resp["epoch"] == 2
+    s_status = _get(f"{surl}/status")
+    assert s_status["repl"]["role"] == "primary"
+    assert s_status["epoch"] == 2
+
+    # Talking to the promoted server teaches the client epoch 2 ...
+    api_client.retry_request(f"{surl}/status", max_retries=0)
+    assert api_client.last_seen_epoch() == 2
+
+    # ... and the stamped epoch fences the old primary: first write 410s,
+    # and the fence is sticky — an UNSTAMPED write afterwards 410s too.
+    with pytest.raises(api_client.ApiError) as exc:
+        api_client.retry_request(
+            f"{purl}/claim/detailed?username=tester", max_retries=0
+        )
+    assert exc.value.status == 410
+    req = urllib.request.Request(
+        f"{purl}/claim/niceonly?username=bare", method="GET"
+    )
+    with pytest.raises(urllib.error.HTTPError) as bare:
+        urllib.request.urlopen(req, timeout=10)
+    assert bare.value.code == 410
+    assert _get(f"{purl}/status")["repl"]["fenced"] is True
+
+    # The promoted primary serves writes: a claim comes off its replica.
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, surl, "tester", max_retries=0
+    )
+    assert data.claim_id > 0
+
+
+def test_spool_replay_across_promotion_is_exactly_once(pair, tmp_path):
+    """Satellite: a submission accepted by the old primary, journaled to
+    the spool (client saw a dropped response), replayed after failover
+    against the promoted standby must answer {"duplicate": true} exactly
+    once — the replicated submissions table + submit_id carries
+    exactly-once across the promotion."""
+    purl, surl = pair["purl"], pair["surl"]
+
+    data = api_client.get_field_from_server(
+        SearchMode.DETAILED, purl, "tester", max_retries=0
+    )
+    results, _ = process_field(data, SearchMode.DETAILED, "scalar", 1024)
+    submission = compile_results(data, results, SearchMode.DETAILED, "tester")
+    first = api_client.submit_field_to_server(purl, submission, max_retries=0)
+    assert first["status"] == "OK" and not first.get("duplicate")
+
+    # The client never saw that 200: the submission sits in the spool.
+    spool = spool_mod.SubmissionSpool(str(tmp_path / "spool"))
+    spool.add(submission)
+
+    target = _get(f"{purl}/status")["repl"]["seq"]
+    assert _wait(lambda: _applied_seq(surl) >= target), "standby lagged"
+
+    # Primary dies; the standby is promoted.
+    pair["primary"].shutdown()
+    assert _post(f"{surl}/repl/promote")["epoch"] == 2
+
+    # Replay against the configured server list: the dead primary rotates
+    # to the promoted standby, which recognizes the submit_id.
+    counts = spool.replay(f"{purl},{surl}", max_retries=0)
+    assert counts == {"delivered": 1, "rejected": 0, "deferred": 0}
+    assert spool.pending() == []
+
+    # Exactly once: a direct replay answers duplicate, and the promoted
+    # ledger holds a single row for that submit_id.
+    again = api_client.submit_field_to_server(surl, submission, max_retries=0)
+    assert again.get("duplicate") is True
+    db = Db(pair["s_path"])
+    n = db._read().execute(
+        "SELECT COUNT(*) FROM submissions WHERE submit_id = ?",
+        (submission.submit_id,),
+    ).fetchone()[0]
+    db.close()
+    assert n == 1
+
+
+# ---------------------------------------------------------------------------
+# Client transport: failover rotation + per-host socket hygiene
+
+
+def test_failover_request_rotates_past_dead_server(pair):
+    purl = pair["purl"]
+    dead = f"http://127.0.0.1:{_free_port()}"
+    api_base = f"{dead},{purl}"
+
+    status = api_client.failover_request(api_base, "/status", max_retries=0)
+    assert status["status"] == "ok"
+    # The cursor sticks to the server that answered: the next request goes
+    # straight to the live endpoint instead of re-probing the dead one.
+    servers = api_client.split_servers(api_base)
+    key = ",".join(servers)
+    with api_client._failover_lock:
+        assert servers[api_client._failover_idx[key]] == purl.rstrip("/")
+
+
+def test_failover_request_single_server_is_plain_retry(pair):
+    status = api_client.failover_request(
+        pair["purl"], "/status", max_retries=0
+    )
+    assert status["status"] == "ok"
+    with api_client._failover_lock:
+        assert api_client._failover_idx == {}
+
+
+def test_split_servers():
+    assert api_client.split_servers(" http://a:1/ ,http://b:2,, ") == [
+        "http://a:1", "http://b:2",
+    ]
+    assert api_client.split_servers("http://a:1") == ["http://a:1"]
+
+
+def test_dead_host_mark_evicts_pooled_socket(pair):
+    purl = pair["purl"]
+    api_client.retry_request(f"{purl}/status", max_retries=0)
+    key = ("http", purl.split("//", 1)[1])
+    pool = api_client._conn_pool()
+    assert key in pool
+    stale = pool[key]
+
+    api_client._mark_host_dead(key)
+    api_client.retry_request(f"{purl}/status", max_retries=0)
+    fresh = api_client._conn_pool()[key]
+    assert fresh is not stale, "socket born before the dead-mark survived"
+    assert fresh._nice_born > api_client._dead_hosts[key]
+
+
+def test_close_connections_per_host():
+    class FakeConn:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    pool = api_client._conn_pool()
+    a, b = FakeConn(), FakeConn()
+    pool[("http", "a:1")] = a
+    pool[("http", "b:2")] = b
+    api_client.close_connections(netloc="a:1")
+    assert a.closed and not b.closed
+    assert ("http", "a:1") not in pool and ("http", "b:2") in pool
+    api_client.close_connections()
+    assert b.closed and pool == {}
+
+
+# ---------------------------------------------------------------------------
+# Known-server persistence beside the checkpoint dir
+
+
+def test_known_servers_round_trip(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    assert _load_known_servers(ckpt) == []
+    _save_known_servers(ckpt, ["http://a:1/", "http://b:2", "http://a:1"])
+    assert _load_known_servers(ckpt) == ["http://a:1", "http://b:2"]
+    # Corrupt file degrades to "no learned servers", never an exception.
+    with open(tmp_path / "ckpt" / "servers.json", "w") as f:
+        f.write("{not json")
+    assert _load_known_servers(ckpt) == []
+    assert _load_known_servers(None) == []

@@ -302,6 +302,36 @@ def _stepprof_delta(before: dict, after: dict) -> dict:
     return out
 
 
+def _mem_snapshot() -> dict:
+    """Host RSS / peak RSS (utils/resources backend ladder) plus the max
+    per-device peak bytes when the runtime reports memory_stats — the
+    bench record's memory axis."""
+    from nice_tpu.obs import memwatch
+    from nice_tpu.utils import resources
+
+    out = {
+        "rss_bytes": resources.rss_bytes() or 0,
+        "peak_rss_bytes": resources.peak_rss_bytes() or 0,
+    }
+    dev = memwatch._device_memory()
+    peaks = [e["peak"] for e in dev["devices"].values() if "peak" in e]
+    if peaks:
+        out["device_peak_bytes"] = max(peaks)
+    return out
+
+
+def _mem_delta(before: dict, after: dict) -> dict:
+    """Per-window memory summary: the absolute peaks reached by the end of
+    the window plus how much resident set the window itself added."""
+    out = {
+        "peak_rss_bytes": after["peak_rss_bytes"],
+        "rss_delta_bytes": after["rss_bytes"] - before["rss_bytes"],
+    }
+    if "device_peak_bytes" in after:
+        out["device_peak_bytes"] = after["device_peak_bytes"]
+    return out
+
+
 def _critpath_summary(prof_delta: dict) -> dict | None:
     """Dominant-segment summary of a stepprof delta window (obs/critpath.py's
     phase fold): where a mode's device wall actually went, in the same
@@ -840,6 +870,7 @@ def main() -> int:
     wedged = False
     suite_spans0 = _span_sums()
     suite_prof0 = _stepprof_sums()
+    suite_mem0 = _mem_snapshot()
     _phase("suite", "begin", modes=[f"{k}/{m}" for m, k in suite],
            n_chips=n_chips, backend=jax.default_backend())
     for idx, (mode, kind) in enumerate(suite):
@@ -886,7 +917,9 @@ def main() -> int:
                    cap_secs=round(cap, 1), reserved_secs=round(reserve, 1))
             spans_before = _span_sums()
             prof_before = _stepprof_sums()
+            mem_before = _mem_snapshot()
             line, wedged = _run_mode_capped(mode, kind, batch, n_chips, cap)
+            line["peak_mem"] = _mem_delta(mem_before, _mem_snapshot())
             mode_spans = _span_delta(spans_before, _span_sums())
             if mode_spans:
                 line["spans"] = mode_spans
@@ -928,12 +961,15 @@ def main() -> int:
             if k
             in ("value", "vs_baseline", "elapsed_secs", "error", "hits",
                 "skipped", "case_elapsed_secs", "case_budget_secs",
-                "over_budget")
+                "over_budget", "peak_mem")
         }
         for (mode, kind), r in results.items()
     }
     headline["budget_secs"] = budget
     headline["budget_used_secs"] = round(budget - remaining(), 1)
+    # Suite-wide memory watermark (overwrites the headline case's own window
+    # on purpose: the committed record carries the whole run's peak).
+    headline["peak_mem"] = _mem_delta(suite_mem0, _mem_snapshot())
     # Per-phase wall-time across the whole suite (engine dispatch/collect/
     # stats spans + any server/client spans that ran in-process): the driver
     # artifact carries not just the throughput but where the wall went.

@@ -211,6 +211,27 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
         locks=("obs.history._sampler_lock", "obs.history.HistoryStore._lock"),
     ),
     ThreadRoot(
+        name="nice-memwatch",
+        path="nice_tpu/obs/memwatch.py",
+        spawn_scope="maybe_start_sampler",
+        entries=("maybe_start_sampler.<locals>._run",),
+        role="periodic",
+        locks=("obs.memwatch._sampler_lock", "obs.memwatch._lock"),
+        notes="client/daemon resource sampler (NICE_TPU_MEMWATCH_SECS=0 "
+              "means the thread is never created); the server samples on "
+              "the writer periodic instead",
+    ),
+    ThreadRoot(
+        name="nice-pyprof",
+        path="nice_tpu/obs/pyprof.py",
+        spawn_scope="maybe_start",
+        entries=("maybe_start.<locals>._run",),
+        role="periodic",
+        locks=("obs.pyprof._started_lock", "obs.pyprof._lock"),
+        notes="statistical wall-clock sampler over sys._current_frames() "
+              "(NICE_TPU_PYPROF_HZ=0 means the thread is never created)",
+    ),
+    ThreadRoot(
         name="nice-metrics-httpd",
         path="nice_tpu/obs/serve.py",
         spawn_scope="serve_metrics",
@@ -327,6 +348,15 @@ THREAD_ROOTS: Tuple[ThreadRoot, ...] = (
         notes="observatory server thread (stdlib serve_forever)",
     ),
     ThreadRoot(
+        name="memprof-smoke-httpd",
+        path="scripts/memprof_smoke.py",
+        spawn_scope="main",
+        entries=(),
+        role="helper",
+        notes="smoke-test server thread (stdlib serve_forever); named so "
+              "the pyprof attribution check can account for it",
+    ),
+    ThreadRoot(
         name="sched-smoke-httpd",
         path="scripts/sched_smoke.py",
         spawn_scope="_start_server",
@@ -386,6 +416,11 @@ LOCK_SPECS: Tuple[LockSpec, ...] = (
     LockSpec("obs.flight.FlightRecorder._lock", "flight ring"),
     LockSpec("obs.flight._install_lock", "recorder install slot"),
     LockSpec("obs.anomaly.AnomalyEngine._lock", "anomaly windows"),
+    LockSpec("obs.memwatch._lock", "watched-path table + last sample",
+             may_block_under=True),
+    LockSpec("obs.memwatch._sampler_lock", "memwatch sampler once-guard"),
+    LockSpec("obs.pyprof._lock", "folded-stack tables + sample counters"),
+    LockSpec("obs.pyprof._started_lock", "pyprof sampler once-guard"),
     LockSpec("obs.serve._started_lock", "metrics-server once-guard"),
     LockSpec("obs.journal._client_lock", "journal client slot",
              may_block_under=True),
@@ -483,6 +518,26 @@ SHARED_STATE: Tuple[SharedState, ...] = (
     # obs/history.py
     SharedState("nice_tpu/obs/history.py", "<module>", "_sampler_started",
                 "lock:obs.history._sampler_lock"),
+    # obs/memwatch.py — watched paths registered by wiring code, read by
+    # whichever host drives sampling (thread or writer periodic).
+    SharedState("nice_tpu/obs/memwatch.py", "<module>", "_watched",
+                "lock:obs.memwatch._lock"),
+    SharedState("nice_tpu/obs/memwatch.py", "<module>", "_last_summary",
+                "lock:obs.memwatch._lock"),
+    SharedState("nice_tpu/obs/memwatch.py", "<module>", "_sampler_started",
+                "lock:obs.memwatch._sampler_lock"),
+    # obs/pyprof.py — the sampler writes the tables; HTTP handlers and the
+    # telemetry reporter read them.
+    SharedState("nice_tpu/obs/pyprof.py", "<module>", "_tables",
+                "lock:obs.pyprof._lock"),
+    SharedState("nice_tpu/obs/pyprof.py", "<module>", "_root_samples",
+                "lock:obs.pyprof._lock"),
+    SharedState("nice_tpu/obs/pyprof.py", "<module>", "_total_samples",
+                "lock:obs.pyprof._lock"),
+    SharedState("nice_tpu/obs/pyprof.py", "<module>", "_distinct_stacks",
+                "lock:obs.pyprof._lock"),
+    SharedState("nice_tpu/obs/pyprof.py", "<module>", "_started",
+                "lock:obs.pyprof._started_lock"),
     # sched/scheduler.py — the run loop mutates these while the sched-slo
     # periodic and stats() readers look on.
     SharedState("nice_tpu/sched/scheduler.py", "MultiTenantScheduler",

@@ -1033,6 +1033,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     # Time-series history sampler behind the same local port's GET /history
     # (NICE_TPU_HISTORY_SECS; 0 disables).
     obs.history.maybe_start_sampler()
+    # Resource observatory: memory/footprint sampler (NICE_TPU_MEMWATCH_SECS)
+    # and the statistical wall-clock profiler (NICE_TPU_PYPROF_HZ). Either
+    # knob at 0 means no thread is created at all.
+    obs.memwatch.maybe_start_sampler()
+    obs.pyprof.maybe_start()
     if args.threads > 0:
         # The native backend sizes its pools from NICE_THREADS (engine
         # _native_threads); the flag is the CLI face of the same knob
@@ -1072,6 +1077,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     mode = SearchMode.DETAILED if args.mode == "detailed" else SearchMode.NICEONLY
     api = api_client.AsyncApi(args.api_base, args.username, args.max_retries)
     spool = spool_mod.maybe_spool(args.spool_dir, args.checkpoint_dir)
+    # Register on-disk footprints with the resource sampler so leak-trend /
+    # exhaustion forecasting covers what this client writes.
+    if spool is not None:
+        obs.memwatch.watch_path("spool", spool.dir)
+    obs.memwatch.watch_path("ckpt", args.checkpoint_dir)
+    trace_sink = knobs.TRACE.raw() or ""
+    if trace_sink and trace_sink not in ("1", "stderr"):
+        obs.memwatch.watch_path("trace", trace_sink)
     if spool is not None:
         # Startup replay: deliver anything journaled by a previous run (the
         # kill-during-outage case) before claiming new work.

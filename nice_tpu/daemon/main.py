@@ -8,7 +8,9 @@ split (daemon/src/main.rs:39-215).
 CPU sampling is portable: /proc/stat jiffy deltas where available (Linux,
 no deps), then psutil.cpu_percent if psutil is importable (macOS/Windows),
 then a 1-minute loadavg estimate (any POSIX), then a constant-idle stub —
-the daemon must run on a dev laptop, not only on the TPU host image.
+the daemon must run on a dev laptop, not only on the TPU host image. The
+sampler itself lives in utils/resources.py (memwatch shares it); the names
+are re-exported here unchanged.
 """
 
 from __future__ import annotations
@@ -29,76 +31,25 @@ from nice_tpu.obs.series import (
     DAEMON_RESTART_BACKOFF,
     DAEMON_RESTARTS,
 )
+from nice_tpu.utils import resources
 
 log = logging.getLogger("nice_tpu.daemon")
 
-
-def read_cpu_times() -> tuple[int, int]:
-    """(idle, total) jiffies from /proc/stat (Linux backend)."""
-    with open("/proc/stat") as f:
-        parts = f.readline().split()
-    values = [int(v) for v in parts[1:]]
-    idle = values[3] + (values[4] if len(values) > 4 else 0)  # idle + iowait
-    return idle, sum(values)
+# Re-exported from the shared home so existing imports (and the tests that
+# monkeypatch ``daemon.read_cpu_times``) keep working.
+read_cpu_times = resources.read_cpu_times
+pick_cpu_backend = resources.pick_cpu_backend
 
 
-def pick_cpu_backend() -> str:
-    """Best available whole-machine CPU sampler for this platform.
-
-    Deliberately does NOT call read_cpu_times() (only stats the path) so
-    tests can stub the reader with a finite sequence of readings.
-    """
-    if os.path.exists("/proc/stat"):
-        return "proc"
-    try:
-        import psutil  # noqa: F401
-
-        return "psutil"
-    except ImportError:
-        pass
-    return "loadavg" if hasattr(os, "getloadavg") else "none"
-
-
-class CpuMonitor:
-    """Rolling CPU utilization sampler (reference daemon/src/main.rs:39-122).
-
-    backend: "proc" (jiffy deltas), "psutil" (cpu_percent), "loadavg"
-    (1-min load / cores, clipped to 1.0), or "none" (always idle — the
-    daemon degrades to an unconditional supervisor rather than refusing to
-    run). Default: pick_cpu_backend().
-    """
+class CpuMonitor(resources.CpuMonitor):
+    """resources.CpuMonitor with "proc" reads routed through THIS module's
+    ``read_cpu_times`` global, so tests can stub the reader on the daemon
+    module exactly as before the shared-sampler refactor."""
 
     def __init__(self, interval_secs: float = 5.0, backend: str | None = None):
-        self.interval = interval_secs
-        self.backend = backend or pick_cpu_backend()
-        if self.backend == "proc":
-            self._last = read_cpu_times()
-        elif self.backend == "psutil":
-            import psutil
-
-            self._psutil = psutil
-            psutil.cpu_percent(interval=None)  # prime the rolling window
-
-    def sample(self) -> float:
-        """Blocking sample: CPU usage fraction over the interval."""
-        time.sleep(self.interval)
-        if self.backend == "proc":
-            idle, total = read_cpu_times()
-            last_idle, last_total = self._last
-            self._last = (idle, total)
-            d_total = total - last_total
-            if d_total <= 0:
-                return 0.0
-            return 1.0 - (idle - last_idle) / d_total
-        if self.backend == "psutil":
-            return self._psutil.cpu_percent(interval=None) / 100.0
-        if self.backend == "loadavg":
-            try:
-                load1 = os.getloadavg()[0]
-            except OSError:
-                return 0.0
-            return min(1.0, load1 / (os.cpu_count() or 1))
-        return 0.0  # "none": report idle; spawning is the safe default
+        super().__init__(
+            interval_secs, backend, reader=lambda: read_cpu_times()
+        )
 
 
 # Crash-loop protection defaults (ProcessManager): a client that keeps dying
@@ -232,6 +183,10 @@ def main(argv=None) -> int:
     obs.maybe_serve_metrics()
     # Crash/SIGUSR2 flight-recorder dumps (NICE_TPU_FLIGHT_DIR).
     obs.flight.install()
+    # Resource observatory: RSS/disk watermarks + the statistical wall-clock
+    # profiler (both no-ops — zero threads — when their knobs are 0).
+    obs.memwatch.maybe_start_sampler()
+    obs.pyprof.maybe_start()
     monitor = CpuMonitor(args.sample_interval)
     log.info("cpu sampler backend: %s", monitor.backend)
     client_args = list(args.client_args or ["--repeat"])

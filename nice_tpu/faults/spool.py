@@ -32,8 +32,12 @@ from typing import Optional
 from nice_tpu.client import api_client
 from nice_tpu.core.types import DataToServer
 from nice_tpu.obs import flight, journal
-from nice_tpu.obs.series import SPOOL_JOURNALED, SPOOL_REPLAYS
-from nice_tpu.utils import fsio
+from nice_tpu.obs.series import (
+    SPOOL_JOURNALED,
+    SPOOL_QUARANTINE_PRUNED,
+    SPOOL_REPLAYS,
+)
+from nice_tpu.utils import fsio, knobs
 
 log = logging.getLogger(__name__)
 
@@ -85,6 +89,10 @@ class SubmissionSpool:
         mechanism, so each replay pass should fail fast and yield to the
         caller's main loop rather than sit in a deep backoff."""
         counts = {"delivered": 0, "rejected": 0, "deferred": 0}
+        # Age-based quarantine retention keeps sweeping even when nothing
+        # new gets rejected (long-lived clients would otherwise only prune
+        # on the next quarantine).
+        self.prune_quarantine()
         for path in self.pending():
             outcome = self._replay_one(path, api_base, max_retries)
             counts[outcome] += 1
@@ -153,8 +161,7 @@ class SubmissionSpool:
         except FileNotFoundError:
             pass
 
-    @staticmethod
-    def _quarantine(path: str) -> None:
+    def _quarantine(self, path: str) -> None:
         try:
             os.replace(path, path + ".rejected")
         except OSError:
@@ -163,6 +170,73 @@ class SubmissionSpool:
         # event history matters: dump the flight ring next to the wreckage.
         flight.record("quarantine", path=path + ".rejected")
         flight.dump(reason="quarantine")
+        self.prune_quarantine()
+
+    def prune_quarantine(self) -> dict:
+        """Retention sweep over quarantined (.rejected) entries, which
+        would otherwise accumulate forever: delete entries older than
+        NICE_TPU_SPOOL_QUARANTINE_MAX_AGE_SECS, then oldest-first until the
+        survivors fit NICE_TPU_SPOOL_QUARANTINE_MAX_BYTES (either knob at 0
+        disables that bound). Returns {"entries": n, "bytes": n} pruned."""
+        try:
+            max_bytes = int(knobs.SPOOL_QUARANTINE_MAX_BYTES.get())
+        except (TypeError, ValueError):
+            max_bytes = 0
+        try:
+            max_age = float(knobs.SPOOL_QUARANTINE_MAX_AGE_SECS.get())
+        except (TypeError, ValueError):
+            max_age = 0.0
+        if max_bytes <= 0 and max_age <= 0:
+            return {"entries": 0, "bytes": 0}
+        try:
+            names = [
+                n for n in os.listdir(self.dir) if n.endswith(".rejected")
+            ]
+        except OSError:
+            return {"entries": 0, "bytes": 0}
+        entries = []  # (mtime, path, size), oldest first
+        for name in names:
+            path = os.path.join(self.dir, name)
+            try:
+                st = os.lstat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, path, st.st_size))
+        entries.sort()
+        now = time.time()
+        doomed = []
+        kept = []
+        for mtime, path, size in entries:
+            if max_age > 0 and now - mtime > max_age:
+                doomed.append((path, size))
+            else:
+                kept.append((path, size))
+        if max_bytes > 0:
+            total = sum(size for _p, size in kept)
+            while kept and total > max_bytes:
+                path, size = kept.pop(0)  # oldest survivor goes first
+                doomed.append((path, size))
+                total -= size
+        pruned_entries = 0
+        pruned_bytes = 0
+        for path, size in doomed:
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            pruned_entries += 1
+            pruned_bytes += size
+        if pruned_entries:
+            SPOOL_QUARANTINE_PRUNED.inc(pruned_bytes)
+            flight.record(
+                "quarantine_pruned", dir=self.dir,
+                entries=pruned_entries, bytes=pruned_bytes,
+            )
+            log.info(
+                "pruned %d quarantined spool entries (%d bytes) under the"
+                " retention bounds", pruned_entries, pruned_bytes,
+            )
+        return {"entries": pruned_entries, "bytes": pruned_bytes}
 
 
 def maybe_spool(

@@ -38,6 +38,15 @@ Five pieces, all stdlib-only at import time:
 - ``stream``: the push-based SSE hub behind ``GET /events/stream`` —
   bounded per-subscriber queues with drop accounting, heartbeats, and
   ``Last-Event-ID`` resume over the journal cursor.
+- ``memwatch``: periodic resource sampler — device memory, host RSS
+  (utils/resources), compile-cache executable footprint, and watched
+  on-disk paths — feeding the nice_mem_* / nice_disk_* series plus the
+  leak-trend / time-to-exhaustion anomaly detectors
+  (NICE_TPU_MEMWATCH_SECS; 0 = off, zero threads).
+- ``pyprof``: always-on statistical wall-clock profiler over
+  ``sys._current_frames()``, folded stacks attributed per threadspec
+  root, served at ``GET /debug/profile`` and rolled up fleet-wide at
+  ``GET /profile/fleet`` (NICE_TPU_PYPROF_HZ; 0 = off, zero threads).
 - ``logsink``: the unified JSON-line logging formatter/installer with
   trace_id injection (NICE_TPU_LOG_LEVEL / NICE_TPU_LOG_FILE).
 
@@ -55,6 +64,8 @@ from . import (  # noqa: F401 — importing pre-seeds
     history,
     journal,
     logsink,
+    memwatch,
+    pyprof,
     series,
     slo,
     stepprof,
@@ -106,6 +117,8 @@ __all__ = [
     "anomaly",
     "critpath",
     "stream",
+    "memwatch",
+    "pyprof",
     "logsink",
     "serve_metrics",
     "maybe_serve_metrics",

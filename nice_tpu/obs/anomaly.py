@@ -27,7 +27,7 @@ from __future__ import annotations
 from datetime import datetime, timezone
 from typing import Callable, Dict, List, Optional
 
-from . import flight
+from . import flight, memwatch
 from nice_tpu.utils import knobs, lockdep
 
 __all__ = ["AnomalyDetector", "AnomalyEngine", "default_detectors",
@@ -191,6 +191,30 @@ def _throughput_cliff(engine, now, since_unix, since_iso):
     return max(0.0, 1.0 - current / median)
 
 
+def _mem_leak_trend(engine, now, since_unix, since_iso):
+    """Steepest positive growth slope (bytes/sec) across the resident-set
+    and watched-disk history series — sustained growth over the window is a
+    leak long before anything OOMs. Slope/fit math lives in
+    obs/memwatch.trend so the memprof smoke can cross-check it against an
+    injected leak rate."""
+    slopes = memwatch.trend(engine.store, since_unix)
+    if not slopes:
+        return None
+    worst = max(slopes.values())
+    return max(0.0, worst)
+
+
+def _resource_exhaustion(engine, now, since_unix, since_iso):
+    """Time-to-exhaustion forecast: for each of HBM / RSS / disk, the
+    fraction of remaining headroom the observed growth slope would consume
+    within NICE_TPU_MEMWATCH_HORIZON_SECS. Value 1.0 = some resource runs
+    out inside the horizon (page); 0.5 = halfway there (warn)."""
+    fc = memwatch.forecast(engine.store, since_unix)
+    if not fc:
+        return None
+    return max(entry["ratio"] for entry in fc.values())
+
+
 def default_detectors() -> List[AnomalyDetector]:
     return [
         AnomalyDetector(
@@ -214,6 +238,19 @@ def default_detectors() -> List[AnomalyDetector]:
             "throughput_cliff", _throughput_cliff,
             warn_at=0.5, page_at=0.8,
             description="fleet throughput drop vs its own window median",
+        ),
+        AnomalyDetector(
+            "mem_leak_trend", _mem_leak_trend,
+            warn_at=256 * 1024.0, page_at=2 * 1024 * 1024.0,
+            description="steepest RSS/disk growth slope (bytes/sec) over"
+                        " the window",
+        ),
+        AnomalyDetector(
+            "resource_exhaustion", _resource_exhaustion,
+            warn_at=0.5, page_at=1.0,
+            description="worst forecast headroom fraction consumed within"
+                        " NICE_TPU_MEMWATCH_HORIZON_SECS (1 = exhaustion"
+                        " inside the horizon)",
         ),
     ]
 

@@ -1,0 +1,424 @@
+"""Resource watch: device memory, host RSS, and on-disk footprints.
+
+The observability stack's memory/footprint axis (the wall-time axis is
+stepprof + critpath). A periodic sample reads
+
+* **device memory** — ``jax.live_arrays()`` population/nbytes plus
+  ``device.memory_stats()`` bytes-in-use / peak / limit per device. Only
+  when jax is *already imported*: the sampler never forces a backend init,
+  so the jax-free server and a cold client pay nothing;
+* **host RSS** — the shared ``utils/resources.py`` backend ladder
+  (/proc -> psutil -> rusage peak);
+* **compile-cache footprint** — executable count + best-effort per-
+  (mode, base) AOT code size from ``ops/compile_cache.footprint()``;
+* **disk** — recursive footprints of every path registered with
+  :func:`watch_path` (spool, quarantined spool entries, checkpoint dir,
+  trace sink, the SQLite ledger + its repl_ops journal) and the free bytes
+  of the filesystem holding them.
+
+Samples land in the ``nice_mem_*`` / ``nice_disk_*`` series, so they flow
+into the history store on the next sampler beat and feed the
+``mem_leak_trend`` / ``resource_exhaustion`` anomaly detectors
+(obs/anomaly.py), whose slope/forecast math lives HERE (:func:`trend`,
+:func:`forecast`) so the memprof smoke can cross-check it against an
+injected leak rate.
+
+Cadence: ``NICE_TPU_MEMWATCH_SECS`` (0 = off: zero threads, zero samples —
+``nice_mem_samples_total`` staying 0 is the proof, stepprof-style). The
+client and daemon run a "nice-memwatch" daemon thread via
+:func:`maybe_start_sampler`; the server calls :func:`maybe_sample` on its
+writer-actor observatory beat instead (no extra thread), throttled to the
+same knob.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .series import (
+    DISK_FREE_BYTES,
+    DISK_USAGE_BYTES,
+    MEM_CACHED_EXECUTABLES,
+    MEM_DEVICE_BYTES,
+    MEM_DEVICE_LIMIT_BYTES,
+    MEM_DEVICE_PEAK_BYTES,
+    MEM_EXECUTABLE_BYTES,
+    MEM_LIVE_ARRAY_BYTES,
+    MEM_LIVE_ARRAYS,
+    MEM_RSS_BYTES,
+    MEM_RSS_PEAK_BYTES,
+    MEM_SAMPLES,
+)
+from nice_tpu.utils import knobs, lockdep, resources
+
+log = logging.getLogger("nice_tpu.obs")
+
+__all__ = [
+    "interval_secs",
+    "watch_path",
+    "watched",
+    "sample",
+    "maybe_sample",
+    "summary",
+    "maybe_start_sampler",
+    "slope_per_sec",
+    "trend",
+    "forecast",
+    "reset_for_tests",
+]
+
+_lock = lockdep.make_lock("obs.memwatch._lock")
+_watched: Dict[str, str] = {}
+_last_summary: Dict[str, object] = {}
+_last_sample_mono: List[float] = [0.0]
+
+_sampler_lock = lockdep.make_lock("obs.memwatch._sampler_lock")
+_sampler_started = False
+
+
+def interval_secs() -> float:
+    """The sampling cadence; <= 0 means memwatch is off everywhere."""
+    try:
+        return float(knobs.MEMWATCH_SECS.get())
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def watch_path(what: str, path: Optional[str]) -> None:
+    """Register a directory/file under a stable label ("spool", "ckpt",
+    "trace", "ledger", ...). None/empty paths are ignored so call sites can
+    pass their maybe-configured dirs unconditionally."""
+    if not path:
+        return
+    with _lock:
+        _watched[what] = path
+
+
+def watched() -> Dict[str, str]:
+    with _lock:
+        return dict(_watched)
+
+
+# --- one sample -----------------------------------------------------------
+
+
+def _device_memory() -> dict:
+    """Device-memory view, strictly opportunistic: if jax is not already in
+    sys.modules (jax-free server, pre-init client) this reports nothing and
+    imports nothing."""
+    out: dict = {"devices": {}, "live_arrays": None, "live_array_bytes": None}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return out
+    try:
+        arrays = jax.live_arrays()
+        out["live_arrays"] = len(arrays)
+        out["live_array_bytes"] = int(
+            sum(getattr(a, "nbytes", 0) or 0 for a in arrays)
+        )
+    except Exception:  # noqa: BLE001 — backend not initialized yet
+        return out
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001
+        return out
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backends often lack stats
+            stats = None
+        entry = {}
+        if stats:
+            for src, dst in (("bytes_in_use", "in_use"),
+                             ("peak_bytes_in_use", "peak"),
+                             ("bytes_limit", "limit")):
+                if src in stats:
+                    entry[dst] = int(stats[src])
+        out["devices"][str(getattr(d, "id", len(out["devices"])))] = entry
+    return out
+
+
+def _executable_footprint() -> dict:
+    from nice_tpu.ops import compile_cache
+
+    try:
+        return compile_cache.footprint()
+    except Exception:  # noqa: BLE001 — footprint is best-effort
+        return {"count": 0, "groups": {}}
+
+
+def _quarantine_bytes(spool_dir: str) -> Optional[int]:
+    """Footprint of .rejected entries inside the spool dir (they are
+    excluded from the spool's own pending() listing, so they get their own
+    watermark)."""
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return None
+    total = 0
+    for n in names:
+        if not n.endswith(".rejected"):
+            continue
+        try:
+            total += os.lstat(os.path.join(spool_dir, n)).st_size
+        except OSError:
+            continue
+    return total
+
+
+def sample() -> dict:
+    """Take one resource sample: refresh every nice_mem_* / nice_disk_*
+    gauge and return (and retain, see summary()) a compact dict."""
+    now = time.time()
+    out: dict = {"ts": now}
+
+    rss = resources.rss_bytes()
+    if rss is not None:
+        MEM_RSS_BYTES.set(rss)
+        out["rss_bytes"] = rss
+    peak = resources.peak_rss_bytes()
+    if peak is not None:
+        MEM_RSS_PEAK_BYTES.set(peak)
+        out["rss_peak_bytes"] = peak
+
+    dev = _device_memory()
+    if dev["live_arrays"] is not None:
+        MEM_LIVE_ARRAYS.set(dev["live_arrays"])
+        MEM_LIVE_ARRAY_BYTES.set(dev["live_array_bytes"])
+        out["live_arrays"] = dev["live_arrays"]
+        out["live_array_bytes"] = dev["live_array_bytes"]
+    if dev["devices"]:
+        out["devices"] = dev["devices"]
+        for dev_id, entry in dev["devices"].items():
+            # Backends without memory_stats still show their live-array
+            # bytes in the aggregate gauges above; per-device gauges only
+            # carry what the runtime actually reports.
+            if "in_use" in entry:
+                MEM_DEVICE_BYTES.labels(dev_id).set(entry["in_use"])
+            if "peak" in entry:
+                MEM_DEVICE_PEAK_BYTES.labels(dev_id).set(entry["peak"])
+            if "limit" in entry:
+                MEM_DEVICE_LIMIT_BYTES.labels(dev_id).set(entry["limit"])
+
+    fp = _executable_footprint()
+    MEM_CACHED_EXECUTABLES.set(fp.get("count", 0))
+    for key, nbytes in (fp.get("groups") or {}).items():
+        MEM_EXECUTABLE_BYTES.labels(key).set(nbytes)
+    out["cached_executables"] = fp.get("count", 0)
+
+    disk: Dict[str, int] = {}
+    free: Optional[int] = None
+    for what, path in sorted(watched().items()):
+        nbytes = resources.dir_bytes(path)
+        if nbytes is not None:
+            DISK_USAGE_BYTES.labels(what).set(nbytes)
+            disk[what] = nbytes
+        if what == "spool":
+            q = _quarantine_bytes(path)
+            if q is not None:
+                DISK_USAGE_BYTES.labels("quarantine").set(q)
+                disk["quarantine"] = q
+        if free is None:
+            free = resources.fs_free_bytes(path)
+    if disk:
+        out["disk_bytes"] = disk
+    if free is not None:
+        DISK_FREE_BYTES.set(free)
+        out["disk_free_bytes"] = free
+
+    MEM_SAMPLES.inc()
+    with _lock:
+        _last_summary.clear()
+        _last_summary.update(out)
+    _last_sample_mono[0] = time.monotonic()
+    return out
+
+
+def maybe_sample() -> Optional[dict]:
+    """Piggyback entry point for hosts with their own periodic (the server's
+    observatory beat): sample iff memwatch is on and a full interval has
+    elapsed since the last sample."""
+    secs = interval_secs()
+    if secs <= 0:
+        return None
+    if time.monotonic() - _last_sample_mono[0] < secs:
+        return None
+    try:
+        return sample()
+    except Exception:  # noqa: BLE001 — sampling must never hurt the host
+        log.exception("memwatch sample failed")
+        return None
+
+
+def summary() -> dict:
+    """The most recent sample (empty before the first one) — telemetry
+    piggybacks this, /status and the resource stream kind serve it."""
+    with _lock:
+        return dict(_last_summary)
+
+
+def maybe_start_sampler(interval: Optional[float] = None) -> bool:
+    """Start the background sampling thread once per process (client +
+    daemon; the server samples on the writer periodic instead). Returns
+    True when the sampler is running. NICE_TPU_MEMWATCH_SECS=0 disables —
+    no thread is created at all."""
+    global _sampler_started
+    secs = interval_secs() if interval is None else interval
+    if not secs or secs <= 0:
+        return False
+    with _sampler_lock:
+        if _sampler_started:
+            return True
+        _sampler_started = True
+
+    def _run():
+        while True:
+            time.sleep(secs)
+            try:
+                sample()
+            except Exception:  # noqa: BLE001 — keep sampling
+                log.exception("memwatch sample failed")
+
+    threading.Thread(target=_run, name="nice-memwatch", daemon=True).start()
+    log.info("memwatch sampler started (every %.1fs)", secs)
+    return True
+
+
+# --- leak trend + exhaustion forecast -------------------------------------
+
+# A slope needs this many points before it is evidence rather than jitter.
+MIN_TREND_POINTS = 4
+
+# Series the trend/forecast math watches, with how each maps to a resource.
+_RSS_SERIES = "nice_mem_rss_bytes"
+_DISK_SERIES = "nice_disk_usage_bytes"
+_DISK_FREE_SERIES = "nice_disk_free_bytes"
+_HBM_SERIES = "nice_mem_device_bytes"
+_HBM_LIMIT_SERIES = "nice_mem_device_limit_bytes"
+
+
+def slope_per_sec(points: List[Tuple[float, float]]) -> Optional[float]:
+    """Least-squares growth rate (units/sec) of [(unix_ts, value), ...];
+    None when the window can't support a fit."""
+    n = len(points)
+    if n < 2:
+        return None
+    t0 = points[0][0]
+    xs = [t - t0 for t, _v in points]
+    ys = [v for _t, v in points]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    den = sum((x - mx) ** 2 for x in xs)
+    if den <= 0:
+        return None
+    num = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    return num / den
+
+
+def _series_points(store, name: str, since: float) -> List[Tuple[float, float]]:
+    """Timestamped points for one history series over the window, coarse
+    tiers first so a window longer than the raw ring still has a spine."""
+    snap = store.query(name, since=since, tiers=("15m", "1m", "raw"))
+    if not snap:
+        return []
+    merged: Dict[float, float] = {}
+    for tier in ("15m", "1m", "raw"):
+        # raw points are [ts, value]; coarse tiers carry
+        # [bucket_ts, mean, min, max, last, n] — take (ts, mean).
+        for p in snap.get(tier, []) or []:
+            merged[p[0]] = p[1]
+    return sorted(merged.items())
+
+
+def _last_value(store, name: str, since: float) -> Optional[float]:
+    pts = _series_points(store, name, since)
+    return pts[-1][1] if pts else None
+
+
+def trend(store, since: float) -> Dict[str, float]:
+    """Per-series growth slope (bytes/sec) over the window for every
+    resident-set and watched-disk series with enough points."""
+    out: Dict[str, float] = {}
+    for name in store.series_names():
+        if not (name.startswith(_RSS_SERIES)
+                or name.startswith(_DISK_SERIES)):
+            continue
+        pts = _series_points(store, name, since)
+        if len(pts) < MIN_TREND_POINTS:
+            continue
+        s = slope_per_sec(pts)
+        if s is not None:
+            out[name] = s
+    return out
+
+
+def forecast(store, since: float,
+             horizon_secs: Optional[float] = None) -> Dict[str, dict]:
+    """Time-to-exhaustion forecast per resource. For each of rss / disk /
+    hbm with a fitted growth slope and known headroom:
+
+    ``ratio``    = slope * horizon / headroom — the detector value; >= 1
+                   means the resource runs out inside the horizon;
+    ``tte_secs`` = headroom / slope (None when not growing).
+    """
+    horizon = (
+        float(knobs.MEMWATCH_HORIZON_SECS.get())
+        if horizon_secs is None else float(horizon_secs)
+    )
+    out: Dict[str, dict] = {}
+
+    def _emit(resource: str, pts, headroom: Optional[float]) -> None:
+        if len(pts) < MIN_TREND_POINTS or headroom is None or headroom <= 0:
+            return
+        s = slope_per_sec(pts)
+        if s is None:
+            return
+        entry = {
+            "slope_bytes_per_sec": s,
+            "headroom_bytes": headroom,
+            "horizon_secs": horizon,
+        }
+        if s > 0:
+            entry["tte_secs"] = headroom / s
+            entry["ratio"] = s * horizon / headroom
+        else:
+            entry["tte_secs"] = None
+            entry["ratio"] = 0.0
+        out[resource] = entry
+
+    rss_pts = _series_points(store, _RSS_SERIES, since)
+    total = resources.host_memory_total_bytes()
+    if rss_pts and total:
+        _emit("rss", rss_pts, max(0.0, float(total) - rss_pts[-1][1]))
+
+    # Disk: the aggregate usage series (sum over watched paths) against the
+    # filesystem's free bytes, or the deterministic capacity override.
+    disk_pts = _series_points(store, _DISK_SERIES, since)
+    cap = knobs.MEMWATCH_DISK_CAPACITY.get()
+    if disk_pts:
+        if cap:
+            headroom = max(0.0, float(cap) - disk_pts[-1][1])
+        else:
+            headroom = _last_value(store, _DISK_FREE_SERIES, since)
+        _emit("disk", disk_pts, headroom)
+
+    hbm_pts = _series_points(store, _HBM_SERIES, since)
+    limit = _last_value(store, _HBM_LIMIT_SERIES, since)
+    if hbm_pts and limit:
+        _emit("hbm", hbm_pts, max(0.0, float(limit) - hbm_pts[-1][1]))
+    return out
+
+
+def reset_for_tests() -> None:
+    """Drop registered paths + the last summary (NOT the started-thread
+    guard: threads are process-lifetime)."""
+    with _lock:
+        _watched.clear()
+        _last_summary.clear()
+    _last_sample_mono[0] = 0.0

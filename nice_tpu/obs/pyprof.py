@@ -1,0 +1,288 @@
+"""Always-on statistical wall-clock profiler with threadspec attribution.
+
+Answers the question the segment taxonomies can't: *which code* is the
+``host_other`` / ``unaccounted`` residual. A sampler thread walks
+``sys._current_frames()`` ``NICE_TPU_PYPROF_HZ`` times per second and
+attributes every sampled stack to its owning **threadspec root** — the
+PR 15 ThreadRegistry (analysis/threadspec.py) names every long-lived
+thread in the tree, so profiles come out labelled ``db-writer``,
+``mesh-feed``, ``telemetry-report``, … instead of ``Thread-7``. The main
+thread profiles as ``main``; a thread no ThreadRoot names lands in
+``unattributed`` (which the memprof smoke bounds at <10%).
+
+Aggregation is a bounded folded-stack table per root (frame labels are
+``file:function`` — no line numbers, so loops don't explode the key
+space); past ``NICE_TPU_PYPROF_MAX_STACKS`` distinct stacks, new shapes
+collapse into the per-root ``(other)`` bucket. Serving:
+
+* ``GET /debug/profile?fmt=folded|json`` on the API server and on the
+  client/daemon metrics port (obs/serve.py) — ``folded`` is the classic
+  flamegraph.pl input, ``json`` feeds web/fleet.html's zero-dependency
+  flamegraph pane;
+* the top-K stacks ride on every telemetry snapshot
+  (``obs/telemetry.py``), and ``GET /profile/fleet`` rolls the fleet up.
+
+``NICE_TPU_PYPROF_HZ=0`` means off with **zero overhead**: no sampler
+thread is created and ``sample_count()`` stays 0 — the same provable
+off-state discipline as stepprof's fence count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .series import PYPROF_OVERFLOW, PYPROF_SAMPLES, PYPROF_STACKS
+from nice_tpu.utils import knobs, lockdep
+
+log = logging.getLogger("nice_tpu.obs")
+
+__all__ = [
+    "hz",
+    "sample_count",
+    "attribute",
+    "take_sample",
+    "maybe_start",
+    "snapshot",
+    "render_folded",
+    "top_stacks",
+    "handle_query",
+    "reset_for_tests",
+]
+
+_lock = lockdep.make_lock("obs.pyprof._lock")
+_tables: Dict[str, Dict[str, int]] = {}  # root -> folded stack -> samples
+_root_samples: Dict[str, int] = {}
+_total_samples = 0
+_distinct_stacks = 0
+
+_started_lock = lockdep.make_lock("obs.pyprof._started_lock")
+_started = False
+
+_OTHER = "(other)"
+MAIN_ROOT = "main"
+UNATTRIBUTED = "unattributed"
+
+# Runtime thread-name prefixes that differ from their threadspec root —
+# ThreadPoolExecutor prefixes are short ("nice-srv_0") while the registry
+# names the pool by role ("async-workers"). Checked after the direct root
+# scan, longest prefix first.
+_RUNTIME_ALIASES: Tuple[Tuple[str, str], ...] = (
+    ("nice-srv", "async-workers"),
+    ("nice-api", "nice-api-pool"),
+)
+
+_root_names_cache: Optional[Tuple[str, ...]] = None
+
+
+def hz() -> float:
+    """Sampling rate; <= 0 means the profiler is off."""
+    try:
+        return float(knobs.PYPROF_HZ.get())
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def sample_count() -> int:
+    """Total stacks sampled this process. Stays 0 whenever the profiler is
+    disabled — the zero-overhead-off guarantee, testable."""
+    return _total_samples
+
+
+def _root_names() -> Tuple[str, ...]:
+    """Registered threadspec root names, longest first so prefix matching
+    prefers the most specific root."""
+    global _root_names_cache
+    if _root_names_cache is None:
+        from nice_tpu.analysis.threadspec import THREAD_ROOTS
+
+        _root_names_cache = tuple(
+            sorted((r.name for r in THREAD_ROOTS), key=len, reverse=True)
+        )
+    return _root_names_cache
+
+
+def attribute(thread_name: str) -> Optional[str]:
+    """Owning threadspec root for a runtime thread name (pools spawn
+    "<root>_0"-style workers, hence the prefix match); "main" for the main
+    thread; None for a thread the registry doesn't know."""
+    if thread_name == "MainThread":
+        return MAIN_ROOT
+    for name in _root_names():
+        if thread_name == name or thread_name.startswith(name):
+            return name
+    for prefix, root in _RUNTIME_ALIASES:
+        if thread_name.startswith(prefix):
+            return root
+    return None
+
+
+def _fold(frame, depth: int) -> str:
+    """Folded-stack key, outermost first: "file:func;file:func;...". No
+    line numbers on purpose — a hot loop should be ONE key."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < depth:
+        co = f.f_code
+        parts.append(f"{os.path.basename(co.co_filename)}:{co.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+def take_sample() -> int:
+    """Walk every live thread's current frame once; returns stacks sampled.
+    Called by the sampler thread, and directly by tests/the smoke."""
+    global _total_samples, _distinct_stacks
+    try:
+        depth = max(1, int(knobs.PYPROF_DEPTH.get()))
+    except (TypeError, ValueError):
+        depth = 24
+    try:
+        max_stacks = max(1, int(knobs.PYPROF_MAX_STACKS.get()))
+    except (TypeError, ValueError):
+        max_stacks = 2000
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    sampled = 0
+    overflowed = 0
+    per_root: Dict[str, int] = {}
+    frames = sys._current_frames()
+    try:
+        for ident, frame in frames.items():
+            if ident == me:
+                continue  # never profile the profiler
+            root = attribute(names.get(ident, "")) or UNATTRIBUTED
+            folded = _fold(frame, depth)
+            with _lock:
+                table = _tables.setdefault(root, {})
+                if folded not in table and _distinct_stacks >= max_stacks:
+                    table[_OTHER] = table.get(_OTHER, 0) + 1
+                    overflowed += 1
+                else:
+                    if folded not in table:
+                        _distinct_stacks += 1
+                    table[folded] = table.get(folded, 0) + 1
+                _root_samples[root] = _root_samples.get(root, 0) + 1
+                _total_samples += 1
+            per_root[root] = per_root.get(root, 0) + 1
+            sampled += 1
+    finally:
+        del frames  # drop frame references promptly
+    for root, n in per_root.items():
+        PYPROF_SAMPLES.labels(root).inc(n)
+    if overflowed:
+        PYPROF_OVERFLOW.inc(overflowed)
+    with _lock:
+        PYPROF_STACKS.set(_distinct_stacks)
+    return sampled
+
+
+def maybe_start(rate: Optional[float] = None) -> bool:
+    """Start the sampler thread once per process. NICE_TPU_PYPROF_HZ=0
+    disables — no thread is created at all (zero overhead off)."""
+    global _started
+    r = hz() if rate is None else rate
+    if not r or r <= 0:
+        return False
+    interval = 1.0 / float(r)
+    with _started_lock:
+        if _started:
+            return True
+        _started = True
+
+    def _run():
+        while True:
+            time.sleep(interval)
+            try:
+                take_sample()
+            except Exception:  # noqa: BLE001 — keep sampling
+                log.exception("pyprof sample failed")
+
+    threading.Thread(target=_run, name="nice-pyprof", daemon=True).start()
+    log.info("pyprof sampler started (%.1f Hz)", r)
+    return True
+
+
+# --- reporting ------------------------------------------------------------
+
+
+def snapshot(top_k: Optional[int] = None) -> dict:
+    """JSON-shaped profile: per-root sample totals + the hottest stacks
+    (all stacks when top_k is None)."""
+    with _lock:
+        tables = {root: dict(t) for root, t in _tables.items()}
+        root_samples = dict(_root_samples)
+        total = _total_samples
+    roots = {}
+    for root, table in sorted(tables.items()):
+        stacks = sorted(table.items(), key=lambda kv: (-kv[1], kv[0]))
+        if top_k is not None:
+            stacks = stacks[:top_k]
+        roots[root] = {
+            "samples": root_samples.get(root, 0),
+            "stacks": [{"stack": s, "count": c} for s, c in stacks],
+        }
+    return {"hz": hz(), "samples": total, "roots": roots}
+
+
+def render_folded() -> str:
+    """flamegraph.pl-compatible folded stacks, the root name as the base
+    frame: "root;file:func;file:func count"."""
+    with _lock:
+        tables = {root: dict(t) for root, t in _tables.items()}
+    lines = []
+    for root in sorted(tables):
+        for stack, count in sorted(tables[root].items()):
+            lines.append(f"{root};{stack} {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def top_stacks(k: Optional[int] = None) -> List[dict]:
+    """The k hottest stacks fleet-rollup style: [{root, stack, count}],
+    hottest first. Default k = NICE_TPU_PYPROF_TOPK."""
+    if k is None:
+        try:
+            k = max(1, int(knobs.PYPROF_TOPK.get()))
+        except (TypeError, ValueError):
+            k = 10
+    with _lock:
+        flat = [
+            {"root": root, "stack": stack, "count": count}
+            for root, table in _tables.items()
+            for stack, count in table.items()
+        ]
+    flat.sort(key=lambda e: (-e["count"], e["root"], e["stack"]))
+    return flat[:k]
+
+
+def handle_query(query: str) -> Tuple[int, bytes, str]:
+    """Shared GET /debug/profile handler for the API server and the local
+    metrics endpoint: (status, body, content-type). fmt=folded|json."""
+    import json
+    from urllib.parse import parse_qs
+
+    fmt = (parse_qs(query or "").get("fmt") or ["json"])[0]
+    if fmt == "folded":
+        return 200, render_folded().encode("utf-8"), "text/plain"
+    if fmt == "json":
+        body = json.dumps(snapshot(top_k=50)).encode("utf-8")
+        return 200, body, "application/json"
+    body = json.dumps(
+        {"error": f"unknown fmt {fmt!r}", "known": ["folded", "json"]}
+    ).encode("utf-8")
+    return 400, body, "application/json"
+
+
+def reset_for_tests() -> None:
+    """Clear aggregated samples (NOT the started-thread guard)."""
+    global _total_samples, _distinct_stacks
+    with _lock:
+        _tables.clear()
+        _root_samples.clear()
+        _total_samples = 0
+        _distinct_stacks = 0

@@ -128,7 +128,8 @@ COMPILE_CACHE_EVENTS = metrics.counter(
     "nice_compile_cache_events_total",
     "Compilation-cache traffic: the jax persistent cache (layer=persistent,"
     " event=hit/request) and the in-process AOT executable cache"
-    " (layer=executable, event=hit/miss).",
+    " (layer=executable, event=hit/miss/evicted — evictions are the LRU"
+    " cap NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES biting).",
     labelnames=("layer", "event"),
 )
 
@@ -239,6 +240,12 @@ SPOOL_REPLAYS = metrics.counter(
     "Spooled submissions replayed, by outcome (accepted / duplicate / "
     "rejected 4xx / failed-will-retry).",
     labelnames=("outcome",),
+)
+SPOOL_QUARANTINE_PRUNED = metrics.counter(
+    "nice_spool_quarantine_pruned_bytes_total",
+    "Bytes of quarantined (.rejected) spool entries deleted by the "
+    "size/age retention sweep (NICE_TPU_SPOOL_QUARANTINE_MAX_BYTES / "
+    "_MAX_AGE_SECS).",
 )
 
 # --- server (server/app.py, server/db.py) --------------------------------
@@ -508,7 +515,7 @@ STREAM_SUBSCRIBERS = metrics.gauge(
 STREAM_EVENTS = metrics.counter(
     "nice_stream_events_total",
     "Events fanned out to stream subscribers, by event kind (journal /"
-    " anomaly / slo / critpath / heartbeat).",
+    " anomaly / slo / critpath / heartbeat / sched / resource).",
     labelnames=("kind",),
 )
 STREAM_DROPPED = metrics.counter(
@@ -545,6 +552,89 @@ DAEMON_RESTART_BACKOFF = metrics.gauge(
     "Crash-loop protection: the restart delay imposed after the client's "
     "latest short-lived nonzero exit (0 = no backoff; resets after a "
     "healthy run).",
+)
+
+# --- resource observatory (obs/memwatch.py, obs/pyprof.py) ----------------
+MEM_RSS_BYTES = metrics.gauge(
+    "nice_mem_rss_bytes",
+    "Host resident set of this process at the last memwatch sample "
+    "(utils/resources backend ladder: /proc -> psutil -> rusage peak).",
+)
+MEM_RSS_PEAK_BYTES = metrics.gauge(
+    "nice_mem_rss_peak_bytes",
+    "Process-lifetime peak resident set (getrusage ru_maxrss).",
+)
+MEM_DEVICE_BYTES = metrics.gauge(
+    "nice_mem_device_bytes",
+    "Accelerator bytes in use per device (device.memory_stats; absent "
+    "stats report live-array bytes on that device instead).",
+    labelnames=("device",),
+)
+MEM_DEVICE_PEAK_BYTES = metrics.gauge(
+    "nice_mem_device_peak_bytes",
+    "Accelerator peak bytes in use per device since process start "
+    "(device.memory_stats peak_bytes_in_use where the backend exposes it).",
+    labelnames=("device",),
+)
+MEM_DEVICE_LIMIT_BYTES = metrics.gauge(
+    "nice_mem_device_limit_bytes",
+    "Accelerator memory capacity per device (device.memory_stats "
+    "bytes_limit; the exhaustion forecaster's HBM ceiling).",
+    labelnames=("device",),
+)
+MEM_LIVE_ARRAYS = metrics.gauge(
+    "nice_mem_live_arrays",
+    "jax.live_arrays() population at the last memwatch sample.",
+)
+MEM_LIVE_ARRAY_BYTES = metrics.gauge(
+    "nice_mem_live_array_bytes",
+    "Total nbytes of jax.live_arrays() at the last memwatch sample.",
+)
+MEM_CACHED_EXECUTABLES = metrics.gauge(
+    "nice_mem_cached_executables",
+    "AOT executables held by the in-process compile cache "
+    "(bounded by NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES).",
+)
+MEM_EXECUTABLE_BYTES = metrics.gauge(
+    "nice_mem_executable_bytes",
+    "Best-effort AOT executable footprint per compile-cache (mode, base) "
+    "group: generated code size where XLA exposes it, else 0.",
+    labelnames=("key",),
+)
+MEM_SAMPLES = metrics.counter(
+    "nice_mem_samples_total",
+    "Memwatch samples taken (stays 0 with NICE_TPU_MEMWATCH_SECS=0 — the "
+    "memwatch-off proof, like stepprof's fence count).",
+)
+DISK_USAGE_BYTES = metrics.gauge(
+    "nice_disk_usage_bytes",
+    "On-disk footprint of each watched path (spool, quarantined spool "
+    "entries, checkpoint dir, trace sink, SQLite ledger incl. the "
+    "repl_ops journal).",
+    labelnames=("what",),
+)
+DISK_FREE_BYTES = metrics.gauge(
+    "nice_disk_free_bytes",
+    "Free bytes on the filesystem holding the watched paths (statvfs; the "
+    "exhaustion forecaster's disk headroom unless "
+    "NICE_TPU_MEMWATCH_DISK_CAPACITY overrides it).",
+)
+PYPROF_SAMPLES = metrics.counter(
+    "nice_pyprof_samples_total",
+    "Thread-stack samples taken by the statistical profiler, attributed "
+    "to the owning threadspec root ('unattributed' = a thread no "
+    "ThreadRoot names; stays 0 with NICE_TPU_PYPROF_HZ=0).",
+    labelnames=("root",),
+)
+PYPROF_STACKS = metrics.gauge(
+    "nice_pyprof_stacks",
+    "Distinct folded stacks currently retained across all roots "
+    "(bounded by NICE_TPU_PYPROF_MAX_STACKS).",
+)
+PYPROF_OVERFLOW = metrics.counter(
+    "nice_pyprof_overflow_total",
+    "Samples collapsed into a root's (other) bucket because the folded-"
+    "stack table hit NICE_TPU_PYPROF_MAX_STACKS.",
 )
 
 # --- replication & failover (server/repl.py) -----------------------------
@@ -592,7 +682,8 @@ for _kind in ("nm", "count", "survivors", "survivors-dense", "stats",
 for _mode in ("detailed",):
     ENGINE_STATS_TRANSFERS.labels(_mode)
 for _layer, _event in (("persistent", "hit"), ("persistent", "request"),
-                       ("executable", "hit"), ("executable", "miss")):
+                       ("executable", "hit"), ("executable", "miss"),
+                       ("executable", "evicted")):
     COMPILE_CACHE_EVENTS.labels(_layer, _event)
 for _reason in ("sliver", "host-route", "limbs"):
     ENGINE_HOST_FALLBACK.labels(_reason)
@@ -639,7 +730,8 @@ for _slo in ("claim_p99", "submit_success", "feed_idle_p95",
              "spot_check_fail"):
     SLO_STATE.labels(_slo)
 for _detector in ("stuck_fields", "claim_churn", "lease_expiry_storm",
-                  "trust_slash_burst", "throughput_cliff"):
+                  "trust_slash_burst", "throughput_cliff",
+                  "mem_leak_trend", "resource_exhaustion"):
     ANOMALY_STATE.labels(_detector)
 for _kind in ("generated", "queued", "claimed", "block_claimed", "renewed",
               "lease_expired", "submit_accepted", "submit_duplicate",
@@ -657,8 +749,13 @@ for _seg in ("queue_wait", "claim_rtt", "ckpt_resume", "h2d_feed",
     CRITPATH_SEGMENT_P95.labels(_seg)
 for _resource in ("writer_busy", "device_busy", "feed_idle"):
     CRITPATH_UTILIZATION.labels(_resource)
-for _kind in ("journal", "anomaly", "slo", "critpath", "heartbeat", "sched"):
+for _kind in ("journal", "anomaly", "slo", "critpath", "heartbeat", "sched",
+              "resource"):
     STREAM_EVENTS.labels(_kind)
+for _what in ("spool", "quarantine", "ckpt", "trace", "ledger"):
+    DISK_USAGE_BYTES.labels(_what)
+PYPROF_SAMPLES.labels("unattributed")
+del _what
 
 # --- multi-tenant scheduler (sched/) ------------------------------------
 # Tenant labels are operator-chosen names, so nothing here is pre-seeded:
@@ -748,7 +845,12 @@ FLIGHT_KNOWN_KINDS = ("dispatch_error", "retry", "fault", "checkpoint",
                       # multi-tenant scheduler (sched/): a tenant lost its
                       # turn at a segment boundary, or the anti-starvation
                       # bound fired for a skipped tenant.
-                      "sched_preemption", "tenant_starved")
+                      "sched_preemption", "tenant_starved",
+                      # resource observatory: the spool's quarantine
+                      # retention sweep deleted .rejected entries
+                      # (obs/memwatch rides anomaly_transition for leak /
+                      # exhaustion state changes).
+                      "quarantine_pruned")
 for _kind in FLIGHT_KNOWN_KINDS:
     FLIGHT_EVENTS.labels(_kind)
 for _reason in ("crash", "sigusr2", "quarantine", "manual"):

@@ -1,5 +1,5 @@
-"""Local /metrics + /debug/flight + /history HTTP endpoint for processes
-that aren't the API server.
+"""Local /metrics + /debug/flight + /history + /debug/profile HTTP endpoint
+for processes that aren't the API server.
 
 The client and daemon run hot loops with no HTTP surface of their own; a
 tiny stdlib ThreadingHTTPServer on a localhost port makes their registry
@@ -21,7 +21,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from . import flight, history, metrics, series
+from . import flight, history, metrics, pyprof, series
 from nice_tpu.utils import knobs, lockdep
 
 log = logging.getLogger("nice_tpu.obs")
@@ -52,12 +52,15 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             status, payload = history.handle_query(history.STORE, query)
             body = json.dumps(payload, default=repr).encode("utf-8")
             ctype = "application/json"
+        elif path == "/debug/profile":
+            status, body, ctype = pyprof.handle_query(query)
         else:
             status = 404
             body = json.dumps(
                 {
                     "error": f"unknown path {path!r}",
-                    "known": ["/metrics", "/debug/flight", "/history"],
+                    "known": ["/metrics", "/debug/flight", "/history",
+                              "/debug/profile"],
                 }
             ).encode("utf-8")
             ctype = "application/json"

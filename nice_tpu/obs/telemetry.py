@@ -110,6 +110,25 @@ def snapshot(
     }
     if phase_breakdown:
         out["phase_breakdown"] = phase_breakdown
+    # Resource observatory piggyback: the latest memwatch watermarks and
+    # the profiler's per-root sample totals + top-K folded stacks. Both
+    # omitted when their subsystem is off (zero samples) so the wire shape
+    # is unchanged for fleets running with the knobs at 0.
+    from . import memwatch, pyprof
+
+    mem = memwatch.summary()
+    if mem:
+        out["mem"] = mem
+    if pyprof.sample_count() > 0:
+        prof = pyprof.snapshot(top_k=0)
+        out["pyprof"] = {
+            "samples": prof["samples"],
+            "roots": {
+                root: entry["samples"]
+                for root, entry in prof["roots"].items()
+            },
+            "top": pyprof.top_stacks(),
+        }
     # Client-side audit events (ckpt save/resume, downgrade, spool replay)
     # piggyback on the snapshot; the server merges them into the same
     # field_events timeline (obs/journal.py). Omitted when empty to keep

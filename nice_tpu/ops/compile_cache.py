@@ -29,14 +29,18 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import OrderedDict
 
 from nice_tpu.obs import stepprof
 from nice_tpu.obs.series import COMPILE_CACHE_EVENTS
-from nice_tpu.utils import lockdep
+from nice_tpu.utils import knobs, lockdep
 
 _lock = lockdep.make_lock("ops.compile_cache._lock")
 _setup_done = [False]
-_executables: dict = {}
+# Insertion/hit-ordered so the NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES cap
+# can evict least-recently-hit executables (a long-lived multi-tenant
+# process warms a new (mode, plan, batch) key per tenant forever otherwise).
+_executables: "OrderedDict" = OrderedDict()
 
 # jax.monitoring event names -> our counter labels. Both exist in jax 0.4.x;
 # "request" counts every compilation that consulted the persistent cache,
@@ -90,11 +94,32 @@ def aot(jitted, *args, **kwargs):
     return jitted.lower(*args, **kwargs).compile()
 
 
+def _max_executables() -> int:
+    try:
+        return max(0, int(knobs.COMPILE_CACHE_MAX_EXECUTABLES.get()))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _evict_over_cap_locked() -> int:
+    """Drop least-recently-hit executables past the cap (caller holds
+    _lock). 0 = unbounded."""
+    cap = _max_executables()
+    evicted = 0
+    if cap > 0:
+        while len(_executables) > cap:
+            _executables.popitem(last=False)
+            evicted += 1
+    return evicted
+
+
 def executable(key, build):
     """Get-or-build a compiled executable. ``build`` runs outside the lock
     (compiles can take seconds); a racing duplicate build is discarded."""
     with _lock:
         ex = _executables.get(key)
+        if ex is not None:
+            _executables.move_to_end(key)
     if ex is not None:
         COMPILE_CACHE_EVENTS.labels("executable", "hit").inc()
         return ex
@@ -105,6 +130,12 @@ def executable(key, build):
         prior = _executables.get(key)
         if prior is None:
             _executables[key] = ex
+            evicted = _evict_over_cap_locked()
+        else:
+            _executables.move_to_end(key)
+            evicted = 0
+    if evicted:
+        COMPILE_CACHE_EVENTS.labels("executable", "evicted").inc(evicted)
     if prior is None:
         COMPILE_CACHE_EVENTS.labels("executable", "miss").inc()
         return ex
@@ -120,7 +151,48 @@ def counts() -> dict:
         "persistent_requests": c.value(("persistent", "request")),
         "executable_hits": c.value(("executable", "hit")),
         "executable_misses": c.value(("executable", "miss")),
+        "executable_evictions": c.value(("executable", "evicted")),
     }
+
+
+def _group_of(key) -> str:
+    """Stable per-(mode, base) grouping label for a cache key: the leading
+    kind string plus the base of any limb plan riding in the key."""
+    if isinstance(key, tuple) and key:
+        kind = str(key[0])
+        for el in key[1:]:
+            base = getattr(el, "base", None)
+            if base is not None:
+                return f"{kind}|b{base}"
+        return kind
+    return str(key)
+
+
+def _executable_nbytes(ex) -> int:
+    """Best-effort AOT footprint: XLA's generated code size where the
+    compiled artifact exposes memory_analysis(), else 0 (the count is still
+    meaningful evidence)."""
+    try:
+        ma = ex.memory_analysis()
+    except Exception:  # noqa: BLE001 — analysis is backend-optional
+        return 0
+    for attr in ("generated_code_size_in_bytes", "temp_size_in_bytes"):
+        v = getattr(ma, attr, None)
+        if v:
+            return int(v)
+    return 0
+
+
+def footprint() -> dict:
+    """Memwatch feed: executable count + per-(mode, base) byte estimate,
+    {"count": n, "groups": {"detailed-mega|b13": bytes, ...}}."""
+    with _lock:
+        entries = list(_executables.items())
+    groups: dict = {}
+    for key, ex in entries:
+        g = _group_of(key)
+        groups[g] = groups.get(g, 0) + _executable_nbytes(ex)
+    return {"count": len(entries), "groups": groups}
 
 
 def reset_for_tests() -> None:

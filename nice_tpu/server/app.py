@@ -276,6 +276,15 @@ class ApiContext:
         # full states keep being served by /slo and /anomalies pulls).
         self._last_slo_states: dict = {}
         self._last_anomaly_states: dict = {}
+        # Resource observatory: the ledger file is the server's dominant
+        # on-disk footprint; registering it lets memwatch's disk series and
+        # the exhaustion forecaster cover it. Sampling itself piggybacks on
+        # the history tick below (maybe_sample throttles internally to
+        # NICE_TPU_MEMWATCH_SECS) — no extra thread on the server.
+        obs.memwatch.watch_path("ledger", db.path)
+        # The statistical profiler serves GET /debug/profile below; with
+        # NICE_TPU_PYPROF_HZ=0 this is a no-op and no thread exists.
+        obs.pyprof.maybe_start()
         history_secs = obs.history.sample_interval_secs()
         if history_secs > 0 and role == "primary":
             # Standbys skip the observatory beat: metric_history rows
@@ -298,6 +307,12 @@ class ApiContext:
             self.critpath.evaluate()
         except Exception:  # noqa: BLE001 — attribution must not stop the beat
             log.exception("critpath evaluation failed")
+        # Resource gauges refresh before the registry sample for the same
+        # reason; maybe_sample() throttles itself to NICE_TPU_MEMWATCH_SECS
+        # and is a no-op (zero overhead) when the knob is 0.
+        mem_summary = obs.memwatch.maybe_sample()
+        if mem_summary:
+            self.stream.publish("resource", mem_summary)
         self.history.sample_registries(
             [obs.REGISTRY, self.metrics.registry]
         )
@@ -1646,7 +1661,8 @@ NOT_FOUND_MESSAGE = (
 _SPAN_SEGS = frozenset(
     {"claim", "claim_block", "submit", "submit_block", "renew_claim",
      "status", "metrics", "stats", "query", "telemetry", "debug", "admin",
-     "root", "token", "history", "fields", "events", "critpath", "repl"}
+     "root", "token", "history", "fields", "events", "critpath", "repl",
+     "profile"}
 )
 
 
@@ -1914,6 +1930,7 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "fleet": ctx.cached_fleet_block(),
                     "slo": ctx.slo.last(),
                     "anomalies": ctx.anomaly.last(),
+                    "resources": obs.memwatch.summary(),
                     "tenants": ctx.db.tenant_rollup(),
                     "repl": ctx.repl.status_block(),
                 },
@@ -2024,6 +2041,45 @@ def route_request(ctx: ApiContext, request: Request) -> Response:
                     "capacity": obs.flight.RECORDER.capacity,
                     "total_recorded": obs.flight.RECORDER.total_recorded(),
                     "events": obs.flight.snapshot(),
+                },
+            )
+        if method == "GET" and path == "/debug/profile":
+            # This process's statistical profile (obs/pyprof.py):
+            # ?fmt=folded for flamegraph.pl input, ?fmt=json (default) for
+            # the fleet.html flamegraph pane.
+            status, body, ctype = obs.pyprof.handle_query(parsed.query)
+            return Response(
+                status=status,
+                headers={"Content-Type": ctype, **_CORS_HEADERS},
+                body=body,
+            )
+        if method == "GET" and path == "/profile/fleet":
+            # Fleet profile rollup: the server's own snapshot plus the
+            # top-K stacks each active client piggybacked on telemetry.
+            local = obs.pyprof.snapshot(top_k=50)
+            clients = ctx.db.get_client_resource_snapshots(
+                fleet_active_secs()
+            )
+            merged: dict = {}
+            for c in clients:
+                for entry in (c.get("pyprof") or {}).get("top") or []:
+                    key = (entry.get("root", ""), entry.get("stack", ""))
+                    merged[key] = merged.get(key, 0) + int(
+                        entry.get("count", 0)
+                    )
+            top = sorted(
+                (
+                    {"root": root, "stack": stack, "count": count}
+                    for (root, stack), count in merged.items()
+                ),
+                key=lambda e: (-e["count"], e["root"], e["stack"]),
+            )[:50]
+            return _json_response(
+                200,
+                {
+                    "server": local,
+                    "clients": clients,
+                    "fleet_top": top,
                 },
             )
         if method == "GET" and path == "/metrics":

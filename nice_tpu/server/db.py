@@ -1468,6 +1468,35 @@ class Db:
             )
         return out
 
+    def get_client_resource_snapshots(
+        self, active_secs: float = 900.0
+    ) -> list[dict]:
+        """client_id + the resource-observatory payloads (pyprof rollup,
+        memwatch watermarks) parsed out of each active client's latest
+        snapshot. Clients running with both knobs at 0 send neither key and
+        are skipped."""
+        cutoff = ts(now_utc() - timedelta(seconds=active_secs))
+        with self._read_conn() as conn:
+            rows = conn.execute(
+                "SELECT client_id, snapshot FROM client_telemetry"
+                " WHERE last_seen >= ? ORDER BY last_seen DESC",
+                (cutoff,),
+            ).fetchall()
+        out = []
+        for r in rows:
+            try:
+                snap = json.loads(r["snapshot"] or "{}")
+            except (ValueError, TypeError):
+                continue
+            entry: dict = {"client_id": r["client_id"]}
+            if isinstance(snap.get("pyprof"), dict):
+                entry["pyprof"] = snap["pyprof"]
+            if isinstance(snap.get("mem"), dict):
+                entry["mem"] = snap["mem"]
+            if len(entry) > 1:
+                out.append(entry)
+        return out
+
     def get_fleet_claim_stats(self, slowest_limit: int = 10) -> dict:
         """Claim-side fleet health: active leases, expired-but-unsubmitted
         claims (lost work the expiry predicate will hand out again), total

@@ -316,6 +316,14 @@ CKPT_SECS = _k(
     owner="ops/engine.py", group="engine",
     default_doc="CKPT_EVERY_SECS (30)",
 )
+COMPILE_CACHE_MAX_EXECUTABLES = _k(
+    "NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES", "int", 64,
+    "LRU cap on the in-process AOT executable cache: past this many"
+    " distinct (mode, backend, plan, shape) keys the least-recently-hit"
+    " executable is dropped (counted as layer=executable, event=evicted in"
+    " nice_compile_cache_events_total; 0 = unbounded).",
+    owner="ops/compile_cache.py", group="engine",
+)
 
 # -- client ----------------------------------------------------------------
 CLAIM_BLOCK = _k(
@@ -327,6 +335,22 @@ PREFETCH = _k(
     "NICE_TPU_PREFETCH", "bool", True,
     "AOT-warm the next field's executable while the current one scans.",
     owner="client/main.py", group="client",
+)
+SPOOL_QUARANTINE_MAX_BYTES = _k(
+    "NICE_TPU_SPOOL_QUARANTINE_MAX_BYTES", "int", 64 * 1024 * 1024,
+    "Retention cap on quarantined (.rejected) spool entries: oldest"
+    " entries are pruned once their total size exceeds this many bytes"
+    " (0 = keep forever). Pruned bytes land in"
+    " nice_spool_quarantine_pruned_bytes_total plus a quarantine_pruned"
+    " flight event.",
+    owner="faults/spool.py", group="client",
+)
+SPOOL_QUARANTINE_MAX_AGE_SECS = _k(
+    "NICE_TPU_SPOOL_QUARANTINE_MAX_AGE_SECS", "float", 7 * 24 * 3600.0,
+    "Age bound on quarantined (.rejected) spool entries: entries older"
+    " than this are pruned on the next quarantine or replay pass"
+    " (0 = no age bound).",
+    owner="faults/spool.py", group="client",
 )
 
 # -- server coordination tier ----------------------------------------------
@@ -640,6 +664,56 @@ STREAM_MAX_DROPS = _k(
     "Slow-consumer eviction threshold: a subscriber that has dropped this"
     " many events is disconnected (it can resume via Last-Event-ID).",
     owner="obs/stream.py", group="obs",
+)
+MEMWATCH_SECS = _k(
+    "NICE_TPU_MEMWATCH_SECS", "float", 30.0,
+    "Resource-watch sampling cadence: device memory, host RSS and watched"
+    " on-disk footprints land in the nice_mem_* / nice_disk_* series each"
+    " interval (0 disables — zero threads, zero samples). The server"
+    " samples on its observatory beat instead of a thread.",
+    owner="obs/memwatch.py", group="obs",
+)
+MEMWATCH_HORIZON_SECS = _k(
+    "NICE_TPU_MEMWATCH_HORIZON_SECS", "float", 3600.0,
+    "Time-to-exhaustion forecast horizon: the resource_exhaustion detector"
+    " pages when the observed leak slope would exhaust HBM/RSS/disk"
+    " headroom within this many seconds.",
+    owner="obs/memwatch.py", group="obs",
+)
+MEMWATCH_DISK_CAPACITY = _k(
+    "NICE_TPU_MEMWATCH_DISK_CAPACITY", "int", None,
+    "Override the watched filesystem's capacity in bytes for the"
+    " exhaustion forecaster (unset = statvfs free space). Lets harness"
+    " runs inject a deterministic headroom.",
+    owner="obs/memwatch.py", group="obs",
+    default_doc="statvfs free bytes",
+)
+PYPROF_HZ = _k(
+    "NICE_TPU_PYPROF_HZ", "float", 5.0,
+    "Statistical wall-clock profiler sampling rate: a sampler thread walks"
+    " sys._current_frames() this many times per second and aggregates"
+    " folded stacks per threadspec root (0 disables — zero threads, zero"
+    " per-batch overhead).",
+    owner="obs/pyprof.py", group="obs",
+)
+PYPROF_TOPK = _k(
+    "NICE_TPU_PYPROF_TOPK", "int", 10,
+    "How many of the hottest folded stacks ride on each telemetry snapshot"
+    " for the fleet profile rollup (GET /profile/fleet).",
+    owner="obs/pyprof.py", group="obs",
+)
+PYPROF_MAX_STACKS = _k(
+    "NICE_TPU_PYPROF_MAX_STACKS", "int", 2000,
+    "Bound on distinct folded stacks retained across all roots; past the"
+    " cap new stacks collapse into the per-root (other) bucket (counted in"
+    " nice_pyprof_overflow_total).",
+    owner="obs/pyprof.py", group="obs",
+)
+PYPROF_DEPTH = _k(
+    "NICE_TPU_PYPROF_DEPTH", "int", 24,
+    "Deepest frames kept per sampled stack (outermost frames beyond the"
+    " cap are elided).",
+    owner="obs/pyprof.py", group="obs",
 )
 
 # -- chaos / fault injection -----------------------------------------------

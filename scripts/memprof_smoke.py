@@ -1,0 +1,364 @@
+#!/usr/bin/env python
+"""Resource-observatory smoke: forced leak -> page -> recover, for real.
+
+One short REAL run of the memwatch + pyprof stack against an in-process
+server (the perf_gate harness pattern: port 0, manual history ticks), with
+a disk leak injected at a known rate into a watched spool directory:
+
+  baseline   ticks with no leak: memwatch samples flow into the history
+             store, ``mem_leak_trend`` / ``resource_exhaustion`` stay ok.
+  leak       a fixed chunk appended to the watched dir every tick (a known
+             bytes/sec rate) with ``NICE_TPU_MEMWATCH_DISK_CAPACITY``
+             pinned so the forecaster's headroom is deterministic. Both
+             detectors must reach **page**, with the transition visible in
+             the ``nice_anomaly_state`` gauge, the ``anomaly_transition``
+             flight events, and the SSE stream ("resource" + "anomaly"
+             kinds). The forecaster's fitted slope and time-to-exhaustion
+             are cross-checked against the injected rate.
+  recover    the leaked file is deleted and the capacity override lifted:
+             both detectors must return to **ok** on live evaluation.
+
+Throughout, ``pyprof.take_sample()`` runs once per tick (PYPROF_HZ=0, so
+no sampler thread races the assertions) and >= 90% of sampled stacks must
+attribute to named threadspec roots. The report lands in
+``MEMWATCH_r01.json``; its ``pyprof.root_shares`` block is the baseline
+scripts/perf_gate.py diffs fresh profiles against.
+
+Usage:
+    python scripts/memprof_smoke.py --out MEMWATCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# Knobs for the short run — set BEFORE nice_tpu imports. Manual ticks only
+# (the writer periodic is parked at 1h), shrunken history buckets, memwatch
+# sampling on every 0.25 s tick, a short anomaly window so the recovery
+# phase slides the leak out of view in seconds, and leak-trend thresholds
+# far above RSS jitter but far below the injected rate.
+SMOKE_ENV = {
+    "NICE_TPU_HISTORY_SECS": "3600",
+    "NICE_TPU_HISTORY_1M_SECS": "2",
+    "NICE_TPU_HISTORY_15M_SECS": "10",
+    "NICE_TPU_MEMWATCH_SECS": "0.2",
+    "NICE_TPU_PYPROF_HZ": "0",
+    "NICE_TPU_MEMWATCH_HORIZON_SECS": "600",
+    "NICE_TPU_ANOMALY_WINDOW_SECS": "8",
+    "NICE_TPU_ANOMALY_WINDOW_SCALE": "1",
+    "NICE_TPU_ANOMALY_MEM_LEAK_TREND_WARN": str(4 * 1024 * 1024),
+    "NICE_TPU_ANOMALY_MEM_LEAK_TREND_PAGE": str(8 * 1024 * 1024),
+}
+for _k, _v in SMOKE_ENV.items():
+    os.environ[_k] = _v
+
+TICK_SECS = 0.25
+BASELINE_TICKS = 16
+LEAK_TICKS = 40
+RECOVER_TICKS = 16
+LEAK_CHUNK = 4 * 1024 * 1024          # ~16 MiB/s at the tick cadence
+DISK_CAPACITY_HEADROOM = 2 << 30      # capacity = usage at leak start + 2 GiB
+FORECAST_REL_TOL = 0.35               # slope/tte vs injected rate
+MIN_ATTRIBUTED_FRAC = 0.90
+
+
+def _get_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _detector_states(ctx) -> dict:
+    return {d["detector"]: d["state"] for d in ctx.anomaly.last()
+            if d["detector"] in ("mem_leak_trend", "resource_exhaustion")}
+
+
+def _drain_stream(sub, sse: dict) -> None:
+    for evt in sub.pop_all():
+        sse["kinds"][evt.kind] = sse["kinds"].get(evt.kind, 0) + 1
+        if evt.kind == "anomaly":
+            sse["anomaly_events"].append(
+                {"name": evt.data.get("name"), "from": evt.data.get("from"),
+                 "to": evt.data.get("to")}
+            )
+
+
+def _drive(ctx, base_url, sub, sse, ticks: int, leak_path=None) -> None:
+    """Tick the observatory `ticks` times: optional leak append, one
+    /status fetch (real traffic keeps the worker pool alive), one history
+    tick (memwatch samples inside it), one profiler sweep."""
+    from nice_tpu.obs import pyprof
+
+    for _ in range(ticks):
+        if leak_path is not None:
+            with open(leak_path, "ab") as f:
+                f.write(b"\0" * LEAK_CHUNK)
+        _get_json(f"{base_url}/status")
+        ctx.history_tick()
+        pyprof.take_sample()
+        _drain_stream(sub, sse)
+        time.sleep(TICK_SECS)
+
+
+def _check_forecast(report, problems, ctx, leak_points) -> None:
+    """Cross-check the forecaster against the injected rate: fit OUR OWN
+    append log with the same least-squares the detector uses, then require
+    the forecaster's slope and time-to-exhaustion to agree."""
+    from nice_tpu.obs import anomaly, memwatch
+
+    since = time.time() - anomaly.window_secs()
+    windowed = [(t, v) for t, v in leak_points if t >= since]
+    injected = memwatch.slope_per_sec(windowed)
+    fc = memwatch.forecast(ctx.history, since)
+    block = report["phases"]["leak"]["forecast"] = {
+        "injected_slope_bytes_per_sec": injected,
+        "forecast": fc,
+    }
+    disk = fc.get("disk")
+    if not disk or not injected:
+        problems.append("forecaster produced no disk entry during the leak")
+        return
+    slope = disk["slope_bytes_per_sec"]
+    slope_err = abs(slope - injected) / injected
+    expected_tte = disk["headroom_bytes"] / injected
+    tte = disk.get("tte_secs")
+    tte_err = abs(tte - expected_tte) / expected_tte if tte else None
+    block["checks"] = {
+        "slope_rel_err": round(slope_err, 4),
+        "expected_tte_secs": round(expected_tte, 2),
+        "tte_secs": tte,
+        "tte_rel_err": round(tte_err, 4) if tte_err is not None else None,
+        "ratio": disk["ratio"],
+    }
+    if slope_err > FORECAST_REL_TOL:
+        problems.append(
+            f"forecast slope {slope / 1e6:.1f}MB/s vs injected "
+            f"{injected / 1e6:.1f}MB/s ({slope_err:.0%} off, "
+            f"> {FORECAST_REL_TOL:.0%})"
+        )
+    if tte_err is None or tte_err > FORECAST_REL_TOL:
+        problems.append(
+            f"forecast tte {tte} vs expected {expected_tte:.0f}s "
+            f"(> {FORECAST_REL_TOL:.0%} off the injected rate)"
+        )
+    if disk["ratio"] < 1.0:
+        problems.append(
+            f"leak-phase exhaustion ratio {disk['ratio']:.2f} < 1.0 — the "
+            "forecast never predicted exhaustion inside the horizon"
+        )
+
+
+def _check_pyprof(report, problems) -> None:
+    from nice_tpu.obs import pyprof
+
+    snap = pyprof.snapshot(top_k=5)
+    total = snap["samples"]
+    if not total:
+        problems.append("pyprof collected no samples")
+        report["pyprof"] = {"samples": 0}
+        return
+    shares = {root: entry["samples"] / total
+              for root, entry in snap["roots"].items()}
+    unattributed = shares.get(pyprof.UNATTRIBUTED, 0.0)
+    attributed = 1.0 - unattributed
+    report["pyprof"] = {
+        "samples": total,
+        "root_shares": {r: round(s, 4) for r, s in sorted(shares.items())},
+        "attributed_frac": round(attributed, 4),
+        "top_stacks": pyprof.top_stacks(5),
+    }
+    if attributed < MIN_ATTRIBUTED_FRAC:
+        problems.append(
+            f"only {attributed:.0%} of {total} pyprof samples attributed "
+            f"to named threadspec roots (need >= "
+            f"{MIN_ATTRIBUTED_FRAC:.0%})"
+        )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="MEMWATCH_r01.json")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on any problem (default: warn only)")
+    args = p.parse_args(argv)
+
+    from nice_tpu import obs
+    from nice_tpu.obs.series import ANOMALY_STATE, MEM_SAMPLES
+    from nice_tpu.server import app as server_app
+    from nice_tpu.server.db import Db
+
+    report: dict = {
+        "run": "memprof-smoke",
+        "generated_ts": time.time(),
+        "smoke_env": SMOKE_ENV,
+        "phases": {},
+        "problems": [],
+    }
+    problems: list = []
+    sse = {"kinds": {}, "anomaly_events": []}
+
+    with tempfile.TemporaryDirectory(prefix="memprof-smoke-") as workdir:
+        db_path = os.path.join(workdir, "smoke.db")
+        db = Db(db_path)
+        db.seed_base(30, field_size=5_000_000)
+        db.close()
+        leak_dir = os.path.join(workdir, "spool")
+        os.makedirs(leak_dir)
+        leak_path = os.path.join(leak_dir, "leak.bin")
+
+        srv = server_app.serve(db_path, host="127.0.0.1", port=0)
+        threading.Thread(
+            target=srv.serve_forever, name="memprof-smoke-httpd", daemon=True
+        ).start()
+        ctx = srv.context
+        obs.memwatch.watch_path("spool", leak_dir)
+        base_url = f"http://127.0.0.1:{srv.server_address[1]}"
+        sub = ctx.stream.subscribe()
+        try:
+            # -- baseline: everything ok ---------------------------------
+            print("== baseline: memwatch sampling, detectors ok ==")
+            _drive(ctx, base_url, sub, sse, BASELINE_TICKS)
+            states = _detector_states(ctx)
+            report["phases"]["baseline"] = {
+                "ticks": BASELINE_TICKS,
+                "states": states,
+                "mem_samples": int(MEM_SAMPLES.value()),
+            }
+            for det, state in states.items():
+                if state != "ok":
+                    problems.append(f"baseline: {det} is {state}, not ok")
+            if MEM_SAMPLES.value() < BASELINE_TICKS / 2:
+                problems.append(
+                    f"baseline took only {int(MEM_SAMPLES.value())} "
+                    f"memwatch samples across {BASELINE_TICKS} ticks"
+                )
+
+            # -- leak: page within the window ----------------------------
+            print("== leak: injecting %.0f MB/s into the watched spool ==" %
+                  (LEAK_CHUNK / TICK_SECS / 1e6))
+            usage = sum(
+                (obs.memwatch.summary().get("disk_bytes") or {}).values()
+            )
+            os.environ["NICE_TPU_MEMWATCH_DISK_CAPACITY"] = str(
+                int(usage) + DISK_CAPACITY_HEADROOM
+            )
+            leak_points: list = []
+            from nice_tpu.obs import pyprof  # noqa: F401 (driven in _drive)
+
+            for _ in range(LEAK_TICKS):
+                _drive(ctx, base_url, sub, sse, 1, leak_path=leak_path)
+                leak_points.append(
+                    (time.time(), os.path.getsize(leak_path))
+                )
+            states = _detector_states(ctx)
+            report["phases"]["leak"] = {
+                "ticks": LEAK_TICKS,
+                "leak_chunk_bytes": LEAK_CHUNK,
+                "disk_capacity_bytes": int(
+                    os.environ["NICE_TPU_MEMWATCH_DISK_CAPACITY"]
+                ),
+                "states": states,
+                "gauge_levels": {
+                    det: ANOMALY_STATE.value((det,))
+                    for det in ("mem_leak_trend", "resource_exhaustion")
+                },
+            }
+            for det, state in states.items():
+                if state != "page":
+                    problems.append(f"leak: {det} is {state}, not page")
+            _check_forecast(report, problems, ctx, leak_points)
+
+            # -- recover: back to ok -------------------------------------
+            print("== recover: leak deleted, capacity override lifted ==")
+            os.remove(leak_path)
+            os.environ.pop("NICE_TPU_MEMWATCH_DISK_CAPACITY", None)
+            _drive(ctx, base_url, sub, sse, RECOVER_TICKS)
+            states = _detector_states(ctx)
+            report["phases"]["recover"] = {
+                "ticks": RECOVER_TICKS,
+                "states": states,
+                "gauge_levels": {
+                    det: ANOMALY_STATE.value((det,))
+                    for det in ("mem_leak_trend", "resource_exhaustion")
+                },
+            }
+            for det, state in states.items():
+                if state != "ok":
+                    problems.append(f"recover: {det} is {state}, not ok")
+
+            # -- evidence: flight, SSE, /status, telemetry surface -------
+            flights = [
+                e for e in obs.flight.snapshot()
+                if e.get("kind") == "anomaly_transition"
+                and e.get("detector") in ("mem_leak_trend",
+                                          "resource_exhaustion")
+            ]
+            report["transitions"] = {
+                "flight_events": flights,
+                "sse_kinds": sse["kinds"],
+                "sse_anomaly_events": sse["anomaly_events"],
+            }
+            paged = {e["detector"] for e in flights
+                     if e.get("to_state") == "page"}
+            recovered = {e["detector"] for e in flights
+                         if e.get("to_state") == "ok"}
+            for det in ("mem_leak_trend", "resource_exhaustion"):
+                if det not in paged:
+                    problems.append(f"no flight event for {det} -> page")
+                if det not in recovered:
+                    problems.append(f"no flight event for {det} -> ok")
+            if sse["kinds"].get("resource", 0) < 10:
+                problems.append(
+                    f"only {sse['kinds'].get('resource', 0)} SSE resource "
+                    "events reached the subscriber"
+                )
+            sse_paged = {e["name"] for e in sse["anomaly_events"]
+                         if e.get("to") == "page"}
+            if "resource_exhaustion" not in sse_paged:
+                problems.append(
+                    "SSE anomaly stream never carried the "
+                    "resource_exhaustion page transition"
+                )
+
+            status = _get_json(f"{base_url}/status")
+            report["status_resources"] = status.get("resources")
+            if not (status.get("resources") or {}).get("rss_bytes"):
+                problems.append("/status resources block has no rss_bytes")
+            if "spool" not in (
+                (status.get("resources") or {}).get("disk_bytes") or {}
+            ):
+                problems.append(
+                    "/status resources never picked up the watched spool"
+                )
+            prof = _get_json(f"{base_url}/debug/profile?fmt=json")
+            report["debug_profile_roots"] = sorted(prof.get("roots", {}))
+
+            _check_pyprof(report, problems)
+        finally:
+            ctx.stream.unsubscribe(sub)
+            srv.shutdown()
+
+    report["problems"] = problems
+    report["ok"] = not problems
+    Path(args.out).write_text(json.dumps(report, indent=1, sort_keys=True))
+    print(f"wrote {args.out}")
+    for prob in problems:
+        print(f"FAIL: {prob}")
+    if problems:
+        return 1 if args.strict else 0
+    print("memprof smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

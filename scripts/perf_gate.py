@@ -62,6 +62,12 @@ GATE_ENV = {
     "NICE_TPU_HISTORY_1M_SECS": "2",
     "NICE_TPU_HISTORY_15M_SECS": "10",
     "NICE_TPU_SLO_CLAIM_P99_THRESHOLD": "0.0",
+    # Resource observatory: memwatch samples on every history tick (the
+    # 0.5 s tick cadence outruns this 1 s throttle, so ~half the ticks
+    # sample); pyprof stays thread-less — the driver calls take_sample()
+    # itself so the profile is deterministic per tick.
+    "NICE_TPU_MEMWATCH_SECS": "1",
+    "NICE_TPU_PYPROF_HZ": "0",
 }
 for _k, _v in GATE_ENV.items():
     os.environ[_k] = _v
@@ -120,7 +126,9 @@ def run_observatory(report: dict, problems: list) -> None:
         db.seed_base(30, field_size=5_000_000)
         db.close()
         srv = server_app.serve(db_path, host="127.0.0.1", port=0)
-        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=srv.serve_forever, name="perf-gate-httpd", daemon=True
+        ).start()
         ctx = srv.context
         base_url = f"http://127.0.0.1:{srv.server_address[1]}"
         try:
@@ -146,6 +154,12 @@ def _drive_and_tick(report, problems, base_url, ctx, client_version):
             pass  # seeded fields can run out near the end; ticks continue
         _get_json(f"{base_url}/status")
         ctx.history_tick()
+        # One profiler sweep per tick (NICE_TPU_PYPROF_HZ=0 keeps the
+        # sampler thread off; driving it here makes the per-root profile
+        # deterministic enough to diff against the MEMWATCH baseline).
+        from nice_tpu.obs import pyprof
+
+        pyprof.take_sample()
         time.sleep(TICK_SECS)
     report["history"]["traffic"] = {
         "claims": claims,
@@ -239,6 +253,72 @@ def _check_slo(report, problems, base_url, ctx, obs):
         if e.get("kind") == "slo_transition"
     ]
     report["slo"]["flight_transition_events"] = len(events)
+
+
+def run_resource_gate(report: dict, problems: list) -> None:
+    """Resource-observatory leg: the memwatch samples taken on the section-1
+    history ticks must exist, and the per-root profile collected there is
+    diffed against the committed MEMWATCH_r01.json smoke baseline — a root
+    whose share of samples moved by more than REGRESSION_TOLERANCE
+    (absolute) means the process's time went somewhere new."""
+    from nice_tpu.obs import memwatch, pyprof
+    from nice_tpu.obs.series import MEM_SAMPLES
+
+    gate = report["resources"] = {}
+    gate["mem_samples"] = int(MEM_SAMPLES.value())
+    gate["memwatch_summary"] = memwatch.summary()
+    if gate["mem_samples"] < 2:
+        problems.append(
+            f"memwatch took only {gate['mem_samples']} samples across "
+            f"{TICKS} history ticks (NICE_TPU_MEMWATCH_SECS=1)"
+        )
+
+    snap = pyprof.snapshot(top_k=5)
+    total = snap["samples"]
+    shares = {
+        root: entry["samples"] / total
+        for root, entry in snap["roots"].items()
+    } if total else {}
+    gate["pyprof"] = {
+        "samples": total,
+        "root_shares": {r: round(s, 4) for r, s in sorted(shares.items())},
+    }
+    if not total:
+        problems.append("pyprof collected no samples during the drive")
+        return
+
+    try:
+        baseline = json.loads((ROOT / "MEMWATCH_r01.json").read_text())
+    except (OSError, ValueError):
+        gate["pyprof"]["note"] = (
+            "no MEMWATCH_r01.json baseline; profile-shift diff skipped"
+        )
+        return
+    old_shares = (baseline.get("pyprof") or {}).get("root_shares")
+    if not isinstance(old_shares, dict):
+        gate["pyprof"]["note"] = (
+            "baseline has no pyprof.root_shares; profile-shift diff starts "
+            "with the next committed MEMWATCH record"
+        )
+        return
+    shifts = {}
+    for root in sorted(set(old_shares) | set(shares)):
+        if root.endswith("-httpd"):
+            # Harness-specific serve threads (memprof-smoke-httpd here,
+            # perf-gate-httpd there) differ between runs by design.
+            continue
+        a = float(old_shares.get(root, 0.0))
+        b = float(shares.get(root, 0.0))
+        if abs(b - a) > REGRESSION_TOLERANCE:
+            shifts[root] = {"baseline": round(a, 4), "current": round(b, 4)}
+    gate["pyprof"]["baseline"] = "MEMWATCH_r01.json"
+    gate["pyprof"]["shifted_roots"] = shifts
+    for root, move in shifts.items():
+        problems.append(
+            f"pyprof root {root} share moved "
+            f"{move['baseline']:.0%} -> {move['current']:.0%} "
+            f"(> {REGRESSION_TOLERANCE:.0%} shift vs MEMWATCH baseline)"
+        )
 
 
 # -- section 2: device-step profiler A/B ------------------------------------
@@ -487,6 +567,39 @@ def run_bench_gate(report: dict, problems: list, budget: int) -> None:
                 f"{REGRESSION_TOLERANCE:.0%})"
             )
     _critpath_diff(gate, problems, baseline, headline)
+    _mem_diff(gate, problems, baseline, headline)
+
+
+def _mem_diff(
+    gate: dict, problems: list, baseline: dict, headline: dict
+) -> None:
+    """Diff the bench suite's peak-RSS watermark between rounds: throughput
+    can hold steady while the run quietly doubles its resident set."""
+    block = gate["peak_mem"] = {}
+    new_mem = headline.get("peak_mem")
+    if not new_mem:
+        block["note"] = "fresh run carried no peak_mem block; diff skipped"
+        return
+    block["current"] = new_mem
+    old_mem = baseline.get("peak_mem")
+    if not old_mem or not old_mem.get("peak_rss_bytes"):
+        block["note"] = (
+            "baseline round predates peak_mem accounting; memory diff "
+            "starts with the next committed bench record"
+        )
+        return
+    block["baseline"] = old_mem
+    old_peak = float(old_mem["peak_rss_bytes"])
+    new_peak = float(new_mem.get("peak_rss_bytes") or 0)
+    growth = (new_peak - old_peak) / old_peak if old_peak else 0.0
+    block["growth_frac"] = round(growth, 4)
+    block["regressed"] = growth > REGRESSION_TOLERANCE
+    if block["regressed"]:
+        problems.append(
+            f"bench peak RSS {new_peak / 1e6:.0f}MB vs baseline "
+            f"{old_peak / 1e6:.0f}MB ({growth:.0%} growth > "
+            f"{REGRESSION_TOLERANCE:.0%})"
+        )
 
 
 def _critpath_diff(
@@ -606,6 +719,8 @@ def main(argv=None) -> int:
 
     print("== observatory: history + SLO against a live server ==")
     run_observatory(report, problems)
+    print("== resources: memwatch samples + profile-shift diff ==")
+    run_resource_gate(report, problems)
     print("== stepprof: profiler A/B engine runs ==")
     run_stepprof(report, problems, args.reps)
     print("== stepprof: megaloop feed-idle gate ==")

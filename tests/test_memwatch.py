@@ -1,0 +1,424 @@
+"""Resource observatory tests: memwatch sampling + trend/forecast math,
+pyprof attribution + bounded tables, the shared utils/resources backends,
+the compile-cache LRU cap, and spool quarantine retention.
+
+The zero-overhead-off contracts (knob=0 -> no thread created, counters
+stay 0) are asserted here the same way stepprof's fence count is: the off
+state must be provable, not assumed.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from nice_tpu.obs import memwatch, pyprof
+from nice_tpu.obs.series import MEM_SAMPLES
+from nice_tpu.ops import compile_cache
+from nice_tpu.utils import resources
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    memwatch.reset_for_tests()
+    pyprof.reset_for_tests()
+    yield
+    memwatch.reset_for_tests()
+    pyprof.reset_for_tests()
+
+
+# -- zero-overhead off -------------------------------------------------------
+
+
+def test_memwatch_off_means_no_thread_and_no_samples(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MEMWATCH_SECS", "0")
+    before_threads = {t.name for t in threading.enumerate()}
+    before_samples = MEM_SAMPLES.value()
+    assert memwatch.maybe_start_sampler() is False
+    assert memwatch.maybe_sample() is None
+    assert memwatch.summary() == {}
+    assert MEM_SAMPLES.value() == before_samples
+    after_threads = {t.name for t in threading.enumerate()}
+    assert "nice-memwatch" not in after_threads - before_threads
+
+
+def test_pyprof_off_means_no_thread_and_no_samples(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_PYPROF_HZ", "0")
+    before_threads = {t.name for t in threading.enumerate()}
+    before = pyprof.sample_count()
+    assert pyprof.maybe_start() is False
+    assert pyprof.sample_count() == before
+    after_threads = {t.name for t in threading.enumerate()}
+    assert "nice-pyprof" not in after_threads - before_threads
+
+
+# -- memwatch sampling -------------------------------------------------------
+
+
+def test_sample_reads_rss_and_watched_paths(tmp_path, monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MEMWATCH_SECS", "1")
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    (spool / "a.json").write_bytes(b"x" * 1000)
+    (spool / "b.json.rejected").write_bytes(b"y" * 500)
+    memwatch.watch_path("spool", str(spool))
+    memwatch.watch_path("missing", str(tmp_path / "nope"))
+    memwatch.watch_path("ckpt", None)  # ignored, not an error
+
+    out = memwatch.sample()
+    assert out["rss_bytes"] > 0
+    # Peak comes from ru_maxrss, whose accounting can trail /proc VmRSS by
+    # a little — same order of magnitude is the contract.
+    assert out["rss_peak_bytes"] >= out["rss_bytes"] * 0.5
+    # The .rejected entry counts in BOTH the spool footprint (it lives in
+    # the dir) and its own quarantine watermark.
+    assert out["disk_bytes"]["spool"] == 1500
+    assert out["disk_bytes"]["quarantine"] == 500
+    assert "missing" not in out["disk_bytes"]
+    assert out["disk_free_bytes"] > 0
+    assert memwatch.summary() == out
+
+
+def test_maybe_sample_throttles_to_interval(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_MEMWATCH_SECS", "5")
+    first = memwatch.maybe_sample()
+    assert first is not None
+    # Inside the interval: throttled.
+    assert memwatch.maybe_sample() is None
+
+
+# -- trend + forecast math ---------------------------------------------------
+
+
+class FakeStore:
+    """Minimal history-store stand-in: series -> [(unix_ts, value)]."""
+
+    def __init__(self, series):
+        self._series = series
+
+    def series_names(self):
+        return list(self._series)
+
+    def query(self, name, since=0.0, tiers=("raw",)):
+        pts = [(t, v) for t, v in self._series.get(name, []) if t >= since]
+        return {"raw": pts}
+
+
+def test_slope_per_sec_fits_a_line():
+    pts = [(0.0, 10.0), (1.0, 20.0), (2.0, 30.0), (3.0, 40.0)]
+    assert memwatch.slope_per_sec(pts) == pytest.approx(10.0)
+    assert memwatch.slope_per_sec([(0.0, 1.0)]) is None
+    assert memwatch.slope_per_sec([(5.0, 1.0), (5.0, 2.0)]) is None
+
+
+def test_trend_reports_growing_series_only():
+    now = time.time()
+    grow = [(now - 30 + i * 10, 1000.0 * i) for i in range(4)]
+    flat = [(now - 30 + i * 10, 5000.0) for i in range(4)]
+    short = [(now - 10, 1.0), (now, 2.0)]
+    store = FakeStore({
+        "nice_mem_rss_bytes": grow,
+        "nice_disk_usage_bytes": flat,
+        "nice_disk_usage_bytes{what=\"spool\"}": short,  # < MIN_TREND_POINTS
+        "nice_fleet_numbers_per_sec": grow,  # not a resource series
+    })
+    slopes = memwatch.trend(store, since=now - 60)
+    assert slopes["nice_mem_rss_bytes"] == pytest.approx(100.0)
+    assert slopes["nice_disk_usage_bytes"] == pytest.approx(0.0)
+    assert "nice_disk_usage_bytes{what=\"spool\"}" not in slopes
+    assert "nice_fleet_numbers_per_sec" not in slopes
+
+
+def test_forecast_ratio_and_tte(monkeypatch):
+    """Disk growing at a known rate against a deterministic capacity: the
+    forecaster's tte must equal headroom/rate and the ratio must cross 1.0
+    exactly when tte < horizon."""
+    now = time.time()
+    rate = 100.0  # bytes/sec
+    pts = [(now - 30 + i * 10, 1000.0 + rate * (i * 10)) for i in range(4)]
+    store = FakeStore({"nice_disk_usage_bytes": pts})
+    last = pts[-1][1]
+    monkeypatch.setenv(
+        "NICE_TPU_MEMWATCH_DISK_CAPACITY", str(int(last + 50_000))
+    )
+    fc = memwatch.forecast(store, since=now - 60, horizon_secs=600.0)
+    disk = fc["disk"]
+    assert disk["slope_bytes_per_sec"] == pytest.approx(rate)
+    assert disk["headroom_bytes"] == pytest.approx(50_000)
+    assert disk["tte_secs"] == pytest.approx(50_000 / rate)
+    # 600 s horizon, 500 s to exhaustion -> ratio 1.2 (pages at >= 1.0).
+    assert disk["ratio"] == pytest.approx(600.0 * rate / 50_000)
+    assert disk["ratio"] > 1.0
+
+
+def test_forecast_not_growing_means_zero_ratio(monkeypatch):
+    now = time.time()
+    pts = [(now - 30 + i * 10, 9000.0 - i) for i in range(4)]
+    store = FakeStore({"nice_disk_usage_bytes": pts})
+    monkeypatch.setenv("NICE_TPU_MEMWATCH_DISK_CAPACITY", "1000000")
+    fc = memwatch.forecast(store, since=now - 60, horizon_secs=600.0)
+    assert fc["disk"]["ratio"] == 0.0
+    assert fc["disk"]["tte_secs"] is None
+
+
+def test_anomaly_detectors_ride_on_memwatch(monkeypatch):
+    """mem_leak_trend and resource_exhaustion map the memwatch math onto
+    the ok/warn/page ladder."""
+    from nice_tpu.obs import anomaly
+
+    now = time.time()
+    # 3 MiB/s growth: past the 2 MiB/s page default.
+    rate = 3 * 1024 * 1024.0
+    pts = [(now - 30 + i * 10, rate * i * 10) for i in range(4)]
+    store = FakeStore({"nice_mem_rss_bytes": pts})
+
+    class FakeEngine:
+        pass
+
+    eng = FakeEngine()
+    eng.store = store
+    dets = {d.name: d for d in anomaly.default_detectors()}
+    res = dets["mem_leak_trend"].evaluate(eng, now)
+    assert res["state"] == "page"
+    assert res["value"] == pytest.approx(rate, rel=0.01)
+    # No resource series at all -> no_data -> ok.
+    eng.store = FakeStore({})
+    assert dets["mem_leak_trend"].evaluate(eng, now)["no_data"]
+    assert dets["resource_exhaustion"].evaluate(eng, now)["state"] == "ok"
+
+
+# -- pyprof ------------------------------------------------------------------
+
+
+def test_attribute_maps_thread_names_to_roots():
+    assert pyprof.attribute("MainThread") == "main"
+    assert pyprof.attribute("db-writer") == "db-writer"
+    # Pool workers spawn "<root>_N"-style names: prefix match.
+    assert pyprof.attribute("nice-api-pool_3") == "nice-api-pool"
+    # Executor prefixes that differ from their threadspec root go through
+    # the runtime alias table.
+    assert pyprof.attribute("nice-srv_2") == "async-workers"
+    assert pyprof.attribute("nice-api_0") == "nice-api-pool"
+    assert pyprof.attribute("Thread-7") is None
+
+
+def test_take_sample_attributes_a_named_thread(monkeypatch):
+    stop = threading.Event()
+
+    def _spin():
+        while not stop.is_set():
+            time.sleep(0.01)
+
+    t = threading.Thread(target=_spin, name="nice-memwatch", daemon=True)
+    t.start()
+    try:
+        n = pyprof.take_sample()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert n >= 1
+    snap = pyprof.snapshot()
+    assert "nice-memwatch" in snap["roots"]
+    stacks = snap["roots"]["nice-memwatch"]["stacks"]
+    assert stacks and any("_spin" in s["stack"] for s in stacks)
+    # Frames fold as basename:func with no line numbers.
+    assert all(os.sep not in s["stack"] for s in stacks)
+    assert pyprof.sample_count() == n
+
+
+def test_folded_render_and_query_formats():
+    with pyprof._lock:
+        pyprof._tables["main"] = {"a.py:f;b.py:g": 3}
+        pyprof._root_samples["main"] = 3
+    folded = pyprof.render_folded()
+    assert folded == "main;a.py:f;b.py:g 3\n"
+    status, body, ctype = pyprof.handle_query("fmt=folded")
+    assert (status, ctype) == (200, "text/plain")
+    assert body.decode() == folded
+    status, body, ctype = pyprof.handle_query("")
+    assert (status, ctype) == (200, "application/json")
+    status, body, _ = pyprof.handle_query("fmt=svg")
+    assert status == 400
+    assert b"folded" in body
+
+
+def test_stack_table_is_bounded(monkeypatch):
+    """Past NICE_TPU_PYPROF_MAX_STACKS distinct shapes, new stacks collapse
+    into the per-root (other) bucket instead of growing the table. With the
+    cap at 1 and a table pre-seeded to the cap, every stack a real sample
+    sees is a NEW shape and must land in (other)."""
+    from nice_tpu.obs.series import PYPROF_OVERFLOW
+
+    monkeypatch.setenv("NICE_TPU_PYPROF_MAX_STACKS", "1")
+    with pyprof._lock:
+        pyprof._tables["main"] = {"pre.py:seeded": 1}
+        pyprof._distinct_stacks = 1
+    ov0 = PYPROF_OVERFLOW.value()
+    stop = threading.Event()
+
+    def _spin():
+        while not stop.is_set():
+            time.sleep(0.01)
+
+    t = threading.Thread(target=_spin, name="nice-memwatch", daemon=True)
+    t.start()
+    time.sleep(0.05)
+    try:
+        n = pyprof.take_sample()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert n >= 1
+    assert PYPROF_OVERFLOW.value() >= ov0 + 1
+    with pyprof._lock:
+        assert pyprof._distinct_stacks == 1  # table did not grow
+        assert pyprof._tables["nice-memwatch"] == {
+            pyprof._OTHER: pyprof._tables["nice-memwatch"][pyprof._OTHER]
+        }
+        assert pyprof._tables["nice-memwatch"][pyprof._OTHER] >= 1
+
+
+def test_top_stacks_orders_hottest_first():
+    with pyprof._lock:
+        pyprof._tables["main"] = {"a.py:f": 5, "b.py:g": 9}
+        pyprof._tables["db-writer"] = {"c.py:h": 7}
+    top = pyprof.top_stacks(k=2)
+    assert [e["count"] for e in top] == [9, 7]
+    assert top[0]["root"] == "main"
+
+
+# -- utils/resources ---------------------------------------------------------
+
+
+def test_rss_backends_agree_on_this_process():
+    backend = resources.pick_rss_backend()
+    assert backend in ("proc", "psutil", "rusage")  # never "none" on linux/mac
+    rss = resources.rss_bytes()
+    assert rss is not None and rss > 1024 * 1024  # a python process is >1MB
+    peak = resources.peak_rss_bytes()
+    assert peak is not None and peak >= rss * 0.5  # peak from rusage scale
+    total = resources.host_memory_total_bytes()
+    assert total is not None and total > rss
+
+
+def test_dir_bytes_and_fs_free(tmp_path):
+    d = tmp_path / "d"
+    d.mkdir()
+    (d / "f1").write_bytes(b"a" * 100)
+    sub = d / "sub"
+    sub.mkdir()
+    (sub / "f2").write_bytes(b"b" * 50)
+    assert resources.dir_bytes(str(d)) >= 150  # dirs may add lstat size
+    assert resources.dir_bytes(str(tmp_path / "missing")) is None
+    # A file path counts as itself.
+    assert resources.dir_bytes(str(d / "f1")) == 100
+    assert resources.fs_free_bytes(str(d)) > 0
+
+
+def test_cpu_monitor_moved_but_unchanged():
+    """The daemon's CPU sampler now lives in utils/resources; the daemon
+    re-exports it (tests/test_daemon.py covers the monkeypatch contract)."""
+    from nice_tpu.daemon import main as daemon
+
+    assert daemon.read_cpu_times is resources.read_cpu_times
+    assert daemon.pick_cpu_backend is resources.pick_cpu_backend
+    assert issubclass(daemon.CpuMonitor, resources.CpuMonitor)
+
+
+# -- compile-cache LRU cap ---------------------------------------------------
+
+
+def test_executable_cache_evicts_least_recently_hit(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES", "2")
+    compile_cache.reset_for_tests()
+    ev0 = compile_cache.counts()["executable_evictions"]
+    builds = []
+
+    def build(name):
+        def _b():
+            builds.append(name)
+            return name
+
+        return _b
+
+    assert compile_cache.executable(("a",), build("A")) == "A"
+    assert compile_cache.executable(("b",), build("B")) == "B"
+    # Hit "a" so it becomes most-recently-used; inserting "c" evicts "b".
+    assert compile_cache.executable(("a",), build("A2")) == "A"
+    assert compile_cache.executable(("c",), build("C")) == "C"
+    assert compile_cache.counts()["executable_evictions"] == ev0 + 1
+    assert compile_cache.executable(("a",), build("A3")) == "A"  # survived
+    assert compile_cache.executable(("b",), build("B2")) == "B2"  # rebuilt
+    assert builds == ["A", "B", "C", "B2"]
+    compile_cache.reset_for_tests()
+
+
+def test_executable_cache_unbounded_at_zero(monkeypatch):
+    monkeypatch.setenv("NICE_TPU_COMPILE_CACHE_MAX_EXECUTABLES", "0")
+    compile_cache.reset_for_tests()
+    ev0 = compile_cache.counts()["executable_evictions"]
+    for i in range(20):
+        compile_cache.executable(("k", i), lambda i=i: i)
+    assert compile_cache.counts()["executable_evictions"] == ev0
+    assert compile_cache.footprint()["count"] == 20
+    compile_cache.reset_for_tests()
+
+
+def test_footprint_groups_by_kind_and_base():
+    compile_cache.reset_for_tests()
+
+    class Plan:
+        base = 13
+
+    compile_cache.executable(("detailed", Plan(), 64), lambda: object())
+    compile_cache.executable(("niceonly", 1 << 20), lambda: object())
+    fp = compile_cache.footprint()
+    assert fp["count"] == 2
+    assert set(fp["groups"]) == {"detailed|b13", "niceonly"}
+    compile_cache.reset_for_tests()
+
+
+# -- spool quarantine retention ----------------------------------------------
+
+
+def _mk_rejected(spool_dir, name, size, age_secs):
+    path = os.path.join(spool_dir, name + ".json.rejected")
+    with open(path, "wb") as f:
+        f.write(b"x" * size)
+    old = time.time() - age_secs
+    os.utime(path, (old, old))
+    return path
+
+
+def test_quarantine_prunes_by_age_then_size(tmp_path, monkeypatch):
+    from nice_tpu.faults.spool import SubmissionSpool
+    from nice_tpu.obs.series import SPOOL_QUARANTINE_PRUNED
+
+    spool = SubmissionSpool(str(tmp_path))
+    monkeypatch.setenv("NICE_TPU_SPOOL_QUARANTINE_MAX_BYTES", "250")
+    monkeypatch.setenv("NICE_TPU_SPOOL_QUARANTINE_MAX_AGE_SECS", "3600")
+    ancient = _mk_rejected(str(tmp_path), "ancient", 10, age_secs=7200)
+    old = _mk_rejected(str(tmp_path), "old", 200, age_secs=300)
+    new = _mk_rejected(str(tmp_path), "new", 200, age_secs=10)
+    c0 = SPOOL_QUARANTINE_PRUNED.value()
+
+    out = spool.prune_quarantine()
+    # ancient violates the age bound; then old (oldest survivor) must go
+    # for the remaining 400 bytes to fit the 250-byte cap.
+    assert out == {"entries": 2, "bytes": 210}
+    assert not os.path.exists(ancient)
+    assert not os.path.exists(old)
+    assert os.path.exists(new)
+    assert SPOOL_QUARANTINE_PRUNED.value() == c0 + 210
+
+
+def test_quarantine_retention_disabled_at_zero(tmp_path, monkeypatch):
+    from nice_tpu.faults.spool import SubmissionSpool
+
+    spool = SubmissionSpool(str(tmp_path))
+    monkeypatch.setenv("NICE_TPU_SPOOL_QUARANTINE_MAX_BYTES", "0")
+    monkeypatch.setenv("NICE_TPU_SPOOL_QUARANTINE_MAX_AGE_SECS", "0")
+    path = _mk_rejected(str(tmp_path), "keep", 1 << 20, age_secs=10 ** 8)
+    assert spool.prune_quarantine() == {"entries": 0, "bytes": 0}
+    assert os.path.exists(path)

@@ -212,3 +212,44 @@ def test_lagged_event_reports_gap_and_eviction_closes(monkeypatch):
     assert journal_ids == [4, 5]
     assert info["cursor"] == 5
     assert hub.subscriber_count() == 0
+
+
+def test_hub_memory_bounded_under_subscriber_churn(monkeypatch):
+    """100 subscribe/overflow/evict/unsubscribe cycles leave the hub with
+    an empty subscriber table and no retained Subscriber objects — the SSE
+    hub must be memory-bounded under connection churn (dashboards reconnect
+    forever; the server process does not restart)."""
+    import gc
+
+    def live_subscribers():
+        gc.collect()
+        return sum(
+            1 for o in gc.get_objects()
+            if isinstance(o, stream.Subscriber)
+        )
+
+    monkeypatch.setenv("NICE_TPU_STREAM_QUEUE", "4")
+    monkeypatch.setenv("NICE_TPU_STREAM_MAX_DROPS", "2")
+    hub = stream.StreamHub()
+    baseline = live_subscribers()
+    for cycle in range(100):
+        polite = hub.subscribe()
+        rude = hub.subscribe()
+        # 4 buffered + 8 dropped on each queue: both subscribers blow past
+        # the drop cap and get marked evicted mid-cycle.
+        for i in range(12):
+            hub.publish(
+                "journal", {"cycle": cycle, "i": i},
+                event_id=cycle * 12 + i + 1,
+            )
+        assert rude.evicted
+        hub.unsubscribe(polite)
+        hub.unsubscribe(rude)
+        assert hub.subscriber_count() == 0
+    assert hub._subs == []
+    del polite, rude
+    alive = live_subscribers()
+    assert alive <= baseline, (
+        f"{alive - baseline} churned subscribers still referenced "
+        f"after 100 cycles"
+    )
